@@ -1,0 +1,26 @@
+"""downloader_tpu — a from-scratch rebuild of tritonmedia/downloader.
+
+A message-driven media staging pipeline: consume ``Download`` jobs from a
+queue, fetch media (torrent / http / file / bucket), filter for convertible
+media files, stage them into an object store under ``<id>/original/`` with a
+``done`` idempotency marker, emit telemetry + metrics, and publish ``Convert``
+jobs for a downstream converter.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``app``            — entrypoint & lifecycle (reference index.js)
+- ``orchestrator``   — job runtime: consume, decode, idempotency, stage loop,
+                       ack/nack, publish (reference lib/main.js)
+- ``stages``         — download / process / upload plugins (reference lib/*.js)
+- ``platform``       — config, logging, tracing, metrics, telemetry, service
+                       discovery (reference's external triton-core package)
+- ``mq`` / ``store`` — queue + object-store abstractions with hermetic
+                       in-memory implementations (the reference's RabbitMQ +
+                       MinIO surface)
+- ``torrent``        — pure-asyncio BitTorrent client (reference's webtorrent)
+- ``compute``        — optional JAX/TPU demo of the downstream converter stage
+                       the pipeline feeds (the reference itself has no tensor
+                       compute; see SURVEY.md §7)
+"""
+
+__version__ = "0.1.0"

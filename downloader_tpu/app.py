@@ -1,0 +1,90 @@
+"""Service entrypoint & lifecycle.
+
+Capability-equivalent to /root/reference/index.js: load config
+(index.js:18), build logger + tracer (index.js:12-15), start the
+orchestrator (index.js:19), install signal/unhandled-error handlers that run
+the termination handler and exit 0/1 (index.js:21-35).
+
+Run with ``python -m downloader_tpu``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from . import schemas  # noqa: F401  (ensures schemas import before serving)
+from .health import start_server
+from .mq.memory import InMemoryBroker, MemoryQueue
+from .orchestrator import Orchestrator
+from .platform import metrics as prom
+from .platform.config import load_config
+from .platform.logging import get_logger
+from .platform.telemetry import Telemetry
+from .platform.tracing import init_tracer
+from .store import new_client
+
+
+def build_service(config=None, broker=None, store=None):
+    """Wire the service graph; returns (orchestrator, metrics, telemetry).
+
+    Factored out of :func:`main` so tests and benchmarks can assemble the
+    exact production object graph against hermetic backends.
+    """
+    config = config or load_config("converter")
+    logger = get_logger("downloader")
+    tracer = init_tracer("downloader", logger)
+    metrics = prom.new("downloader")
+
+    # cap redeliveries so a deterministically-failing (poison) job cannot
+    # hot-loop at the head of the queue and starve the worker; RabbitMQ
+    # would need a dead-letter policy for the same guarantee
+    broker = broker or InMemoryBroker(max_redeliveries=5)
+    mq = MemoryQueue(broker)
+    telem_mq = MemoryQueue(broker)
+    telemetry = Telemetry(telem_mq, metrics)
+
+    store = store if store is not None else new_client(config)
+
+    orchestrator = Orchestrator(
+        config=config,
+        mq=mq,
+        store=store,
+        telemetry=telemetry,
+        metrics=metrics,
+        tracer=tracer,
+        logger=logger,
+    )
+    return orchestrator, metrics, telemetry
+
+
+async def run(config=None) -> None:
+    logger = get_logger("downloader")
+    orchestrator, metrics, _telemetry = build_service(config)
+
+    await orchestrator.start()
+    runner = await start_server(orchestrator, metrics)
+    logger.info("initialized")
+
+    stop = asyncio.Event()
+
+    def _on_signal() -> None:
+        logger.info("signal received, shutting down")
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, _on_signal)
+
+    await stop.wait()
+    await orchestrator.shutdown()
+    await runner.cleanup()
+    logger.info("shutdown complete")
+
+
+def main() -> None:
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""HTTP surface: ``GET /health`` + ``GET /metrics``.
+
+``/health`` has behavioral parity with /root/reference/lib/main.js:174-194,
+including the reference's deliberate inverted semantics: a worker with zero
+active jobs answers 500 ``Not Running Jobs`` (it is expected to always be
+busy); otherwise 200 with ``{metadata: {success, host}, data: {active}}``.
+Because the orchestrator here actually removes finished jobs (the reference's
+``slice`` bug made ``activeJobs`` grow forever, lib/main.js:169), the
+endpoint is now truthful.
+
+``/metrics`` exposes the Prometheus registry (reference ``Prom.expose()``,
+lib/main.js:44).
+
+Default port 3401, overridable via ``$PORT`` (reference lib/main.js:194).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from aiohttp import web
+
+from .orchestrator import Orchestrator
+from .platform.metrics import Metrics
+
+DEFAULT_PORT = 3401
+
+
+def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> web.Application:
+    app = web.Application()
+
+    async def health(_request: web.Request) -> web.Response:
+        active = len(orchestrator.active_jobs)
+        if active == 0:
+            return web.json_response({"message": "Not Running Jobs"}, status=500)
+        return web.json_response(
+            {
+                "metadata": {"success": True, "host": socket.gethostname()},
+                "data": {"active": active},
+            }
+        )
+
+    async def prom(_request: web.Request) -> web.Response:
+        body = metrics.render() if metrics is not None else b""
+        return web.Response(body=body, content_type="text/plain")
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", prom)
+    return app
+
+
+async def start_server(
+    orchestrator: Orchestrator,
+    metrics: Optional[Metrics] = None,
+    port: Optional[int] = None,
+) -> web.AppRunner:
+    """Bind the HTTP surface; returns the runner (caller cleans up)."""
+    app = build_app(orchestrator, metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    resolved = port if port is not None else int(os.environ.get("PORT", DEFAULT_PORT))
+    site = web.TCPSite(runner, "0.0.0.0", resolved)
+    await site.start()
+    return runner

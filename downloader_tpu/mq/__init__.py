@@ -1,0 +1,15 @@
+"""Message-queue abstraction.
+
+The reference's "distributed communication backend" is RabbitMQ via
+``triton-core/amqp`` (SURVEY.md §5).  This package defines the exact queue
+surface the reference consumes — ``connect`` / ``listen`` / ``publish`` /
+``close`` with per-message ``ack``/``nack`` and consumer prefetch
+(/root/reference/lib/main.js:46-47,145-150,164,172,200) — plus a hermetic
+in-process broker so the whole pipeline is testable without a RabbitMQ
+server (the reference's biggest test gap, SURVEY.md §4).
+"""
+
+from .base import Delivery, MessageQueue
+from .memory import InMemoryBroker, MemoryQueue
+
+__all__ = ["Delivery", "MessageQueue", "InMemoryBroker", "MemoryQueue"]

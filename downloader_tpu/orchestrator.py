@@ -1,0 +1,238 @@
+"""Job orchestrator: consume download jobs, run the stage pipeline, publish
+convert jobs.
+
+Capability-equivalent to /root/reference/lib/main.js:40-205:
+
+- consumes ``v1.download`` (lib/main.js:172), decodes protobuf ``Download``
+  (lib/main.js:63)
+- emits status ``DOWNLOADING`` (=2) on receipt (lib/main.js:68)
+- tracks active jobs for the health endpoint (lib/main.js:70-73) — with the
+  reference's ``activeJobs.slice`` no-op bug fixed (lib/main.js:169; see
+  SURVEY.md §7 step 6): completed jobs are actually removed here
+- per-job EventEmitter registered in an emitter table (lib/main.js:26,81)
+- loads the stage plugins dynamically by name and validates the contract
+  (lib/main.js:99-115)
+- idempotency probe against ``triton-staging/<jobId>/original/done``
+  (lib/main.js:119-124): if present, skip the stages but still publish the
+  convert message (lib/main.js:153-167)
+- sequential stage loop threading ``last_stage`` (lib/main.js:126-140)
+- error policy: ``ERRDLSTALL`` -> ack (drop job) (lib/main.js:144-146);
+  any other stage error -> status ``ERRORED`` (=6) + nack for redelivery
+  (lib/main.js:148-150)
+- publishes protobuf ``Convert`` to ``v1.convert`` then acks
+  (lib/main.js:157-168)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import time
+from typing import Dict, List, Optional
+
+from . import schemas
+from .mq.base import Delivery, MessageQueue
+from .platform.logging import Logger, get_logger
+from .platform.metrics import Metrics
+from .platform.telemetry import NullTelemetry, Telemetry
+from .platform.tracing import NullTracer, Tracer
+from .stages.base import STAGES, Job, StageContext, load_stages
+from .stages.upload import STAGING_BUCKET, done_marker_name
+from .store.base import ObjectNotFound, ObjectStore
+from .utils import EventEmitter
+
+
+def _utcnow_iso() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="milliseconds")
+        .replace("+00:00", "Z")
+    )
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        config,
+        mq: MessageQueue,
+        store: ObjectStore,
+        telemetry: Optional[Telemetry] = None,
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
+        logger: Optional[Logger] = None,
+        stages: Optional[List[str]] = None,
+        prefetch: int = 1,
+    ):
+        self.config = config
+        self.mq = mq
+        self.store = store
+        self.telemetry = telemetry or NullTelemetry()
+        self.metrics = metrics
+        self.tracer = tracer or NullTracer()
+        self.logger = logger or get_logger("orchestrator")
+        self.stage_names = stages or list(STAGES)
+        self.prefetch = prefetch
+
+        # (reference EmitterTable / activeJobs, lib/main.js:26,34)
+        self.emitter_table: Dict[str, EventEmitter] = {}
+        self.active_jobs: List[dict] = []
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """Connect and begin consuming (reference lib/main.js:47,172)."""
+        await self.mq.connect()
+        await self.telemetry.connect()
+        await self.mq.listen(
+            schemas.DOWNLOAD_QUEUE, self.processor, prefetch=self.prefetch
+        )
+        self.logger.info("successfully connected to queue")
+
+    async def shutdown(self, grace_seconds: float = 30.0) -> None:
+        """Stop consuming; wait for in-flight jobs to settle.
+
+        The reference's termination closure refuses a clean exit while jobs
+        are active (lib/main.js:197-204); here we stop pulling new work
+        first, then actually drain the in-flight jobs.
+        """
+        await self.mq.stop_consuming()
+        try:
+            async with asyncio.timeout(grace_seconds):
+                while self.active_jobs:
+                    await asyncio.sleep(0.05)
+        except TimeoutError:
+            self.logger.warn(
+                "shutdown grace period expired with active jobs",
+                active=len(self.active_jobs),
+            )
+        await self.mq.close()
+
+    # ------------------------------------------------------------------
+    async def processor(self, delivery: Delivery) -> None:
+        """Handle one ``v1.download`` delivery (reference lib/main.js:62-170)."""
+        msg = schemas.decode(schemas.Download, delivery.body)
+        file_id = msg.media.creator_id  # (reference lib/main.js:64)
+        job_id = msg.media.id           # (reference lib/main.js:65)
+
+        if self.metrics is not None:
+            self.metrics.jobs_consumed.inc()
+            self.metrics.jobs_active.inc()
+
+        # set DOWNLOADING status (reference lib/main.js:68)
+        await self.telemetry.emit_status(
+            job_id, schemas.TelemetryStatus.Value("DOWNLOADING")
+        )
+
+        job_entry = {"cardId": file_id, "jobId": job_id}
+        self.active_jobs.append(job_entry)
+
+        child = self.logger.child(jobId=job_id, fileId=file_id)
+        # keyed by the unique job id — the reference keys its EmitterTable by
+        # creator/file id (lib/main.js:81), which collides when two jobs from
+        # the same creator run concurrently
+        emitter = self.emitter_table[job_id] = EventEmitter()
+
+        try:
+            with self.tracer.span("job", jobId=job_id, fileId=file_id):
+                await self._run_job(msg, delivery, child, emitter)
+        finally:
+            # remove the finished job (fixes reference lib/main.js:169,
+            # which called Array.slice — a no-op — so activeJobs only grew)
+            try:
+                self.active_jobs.remove(job_entry)
+            except ValueError:
+                pass
+            self.emitter_table.pop(job_id, None)
+            if self.metrics is not None:
+                self.metrics.jobs_active.dec()
+
+    async def _run_job(
+        self,
+        msg: schemas.Download,
+        delivery: Delivery,
+        logger: Logger,
+        emitter: EventEmitter,
+    ) -> None:
+        job_id = msg.media.id
+
+        # build the stage table for this job (reference lib/main.js:99-115)
+        ctx = StageContext(
+            config=self.config,
+            emitter=emitter,
+            logger=logger,
+            telemetry=self.telemetry,
+            metrics=self.metrics,
+            store=self.store,
+            tracer=self.tracer,
+        )
+        stage_table = await load_stages(ctx, self.stage_names)
+
+        # idempotency probe (reference lib/main.js:119-124)
+        already_staged = True
+        try:
+            logger.info("checking staging bucket for existing files", jobId=job_id)
+            await self.store.get_object(STAGING_BUCKET, done_marker_name(job_id))
+        except ObjectNotFound:
+            already_staged = False
+
+        if not already_staged:
+            logger.info("starting main processor after successful stage init")
+            last_stage_data: object = {}
+            try:
+                for name in self.stage_names:
+                    job = Job(media=msg.media, last_stage=last_stage_data)
+                    logger.info("invoking stage", stage=name)
+                    started = time.monotonic()
+                    try:
+                        last_stage_data = await stage_table[name](job)
+                    finally:
+                        if self.metrics is not None:
+                            self.metrics.stage_seconds.labels(stage=name).observe(
+                                time.monotonic() - started
+                            )
+                    emitter.emit("progress", 0)
+            except Exception as err:
+                logger.error("failed to invoke stage", error=str(err))
+
+                # permanent stall -> drop the job (reference lib/main.js:144-146)
+                if getattr(err, "code", None) == "ERRDLSTALL":
+                    if self.metrics is not None:
+                        self.metrics.jobs_failed.labels(reason="stalled").inc()
+                    await delivery.ack()
+                    return
+
+                # anything else -> ERRORED + redelivery
+                # (reference lib/main.js:148-150)
+                if self.metrics is not None:
+                    self.metrics.jobs_failed.labels(reason="stage_error").inc()
+                await self.telemetry.emit_status(
+                    job_id, schemas.TelemetryStatus.Value("ERRORED")
+                )
+                await delivery.nack()
+                return
+            logger.info("creating convert job")
+        else:
+            logger.warn("skipping download due to files existing in triton-staging")
+            if self.metrics is not None:
+                self.metrics.jobs_skipped.inc()
+
+        # publish the convert message even when staging was skipped
+        # (reference lib/main.js:153-167)
+        payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
+        try:
+            await self.mq.publish(schemas.CONVERT_QUEUE, schemas.encode(payload))
+            if self.metrics is not None:
+                self.metrics.messages_published.labels(
+                    queue=schemas.CONVERT_QUEUE
+                ).inc()
+        except Exception as err:
+            # the reference logs and returns without settling
+            # (lib/main.js:161-166), which leaks the delivery; nack instead so
+            # the message is redelivered — the idempotency marker makes the
+            # retry skip straight to re-publishing the convert message
+            logger.error("failed to create job", error=str(err))
+            await delivery.nack()
+            return
+
+        await delivery.ack()
+        if self.metrics is not None:
+            self.metrics.jobs_completed.inc()

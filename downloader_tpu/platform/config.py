@@ -1,0 +1,121 @@
+"""YAML config loading with env overrides.
+
+Capability-equivalent to the reference's ``triton-core/config``:
+``Config('converter')`` loads the YAML config for the shared service key
+(/root/reference/index.js:18), and the only key the reference consumes
+in-tree is ``config.instance.download_path``
+(/root/reference/lib/download.js:235,240).
+
+Config files live in ``$CONFIG_PATH`` (default ``./config``) as
+``<service>.yaml``.  Missing files fall back to built-in defaults so the
+service boots hermetically.  Nested keys are exposed with attribute access
+(``config.instance.download_path``) to keep call sites readable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+import yaml
+
+DEFAULTS: dict = {
+    "instance": {
+        # Relative paths are resolved against the repo root at use time,
+        # matching the reference's relative-path fixup
+        # (/root/reference/lib/download.js:234-240).
+        "download_path": "downloading",
+    },
+    "minio": {
+        "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),
+        "access_key": os.environ.get("MINIO_ACCESS_KEY", ""),
+        "secret_key": os.environ.get("MINIO_SECRET_KEY", ""),
+        "ssl": False,
+    },
+    "services": {
+        # service-discovery name -> address map consumed by dyn()
+        "rabbitmq": os.environ.get("RABBITMQ", "amqp://localhost"),
+        "minio": os.environ.get("MINIO", "http://localhost:9000"),
+    },
+}
+
+
+class ConfigNode(Mapping):
+    """Read-only mapping with attribute access over a nested dict."""
+
+    def __init__(self, data: dict):
+        self._data = data
+
+    def __getattr__(self, key: str) -> Any:
+        try:
+            value = self._data[key]
+        except KeyError:
+            raise AttributeError(key) from None
+        return ConfigNode(value) if isinstance(value, dict) else value
+
+    def __getitem__(self, key: str) -> Any:
+        value = self._data[key]
+        return ConfigNode(value) if isinstance(value, dict) else value
+
+    def __iter__(self):
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        value = self._data.get(key, default)
+        return ConfigNode(value) if isinstance(value, dict) else value
+
+    def to_dict(self) -> dict:
+        return dict(self._data)
+
+    def __repr__(self) -> str:
+        return f"ConfigNode({self._data!r})"
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for key, value in override.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def load_config(service: str = "converter", path: Optional[str] = None) -> ConfigNode:
+    """Load ``<service>.yaml`` from the config dir, merged over defaults.
+
+    Mirrors ``Config('converter')`` (/root/reference/index.js:18): the
+    downloader shares the converter service's config file.
+    """
+    config_dir = path or os.environ.get("CONFIG_PATH", "config")
+    config_file = os.path.join(config_dir, f"{service}.yaml")
+    data: dict = {}
+    if os.path.exists(config_file):
+        with open(config_file, "r", encoding="utf-8") as fh:
+            loaded = yaml.safe_load(fh) or {}
+            if not isinstance(loaded, dict):
+                raise ValueError(f"config file {config_file} must contain a mapping")
+            data = loaded
+    return ConfigNode(_deep_merge(DEFAULTS, data))
+
+
+def dyn(name: str, config: Optional[ConfigNode] = None) -> str:
+    """Service-discovery: resolve a service name to an address.
+
+    Capability-equivalent to ``triton-core/dynamics``' ``dyn('rabbitmq')``
+    (/root/reference/lib/main.js:46,49).  Resolution order: env var
+    ``<NAME>`` (uppercased), then the config ``services`` map, then
+    ``localhost``.
+    """
+    env = os.environ.get(name.upper())
+    if env:
+        return env
+    if config is not None:
+        services = config.get("services")
+        if services is not None and name in services:
+            return services[name]
+    defaults = DEFAULTS["services"]
+    return defaults.get(name, "localhost")

@@ -1,0 +1,91 @@
+"""Prometheus metrics.
+
+Capability-equivalent to ``triton-core/prom``: a named registry
+(``Prom.new('downloader')``) and an exposed ``/metrics`` endpoint
+(``Prom.expose()``) at /root/reference/lib/main.js:43-44, plus the counters
+the platform lib kept for AMQP/telemetry internals (the prom handle is
+passed into both at lib/main.js:46,49).
+
+Unlike the reference (whose in-tree code records nothing itself), the
+pipeline here records job/stage outcomes, durations, and byte counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+
+class Metrics:
+    """The downloader's metric set, bound to its own registry."""
+
+    def __init__(self, service: str = "downloader",
+                 registry: Optional[CollectorRegistry] = None):
+        self.service = service
+        self.registry = registry or CollectorRegistry()
+        ns = service.replace("-", "_")
+        self.jobs_consumed = Counter(
+            f"{ns}_jobs_consumed_total",
+            "Download jobs consumed from the queue",
+            registry=self.registry,
+        )
+        self.jobs_completed = Counter(
+            f"{ns}_jobs_completed_total",
+            "Jobs fully staged and acked",
+            registry=self.registry,
+        )
+        self.jobs_failed = Counter(
+            f"{ns}_jobs_failed_total",
+            "Jobs that errored (nacked or dropped)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.jobs_skipped = Counter(
+            f"{ns}_jobs_skipped_total",
+            "Jobs skipped via the staging-bucket idempotency marker",
+            registry=self.registry,
+        )
+        self.jobs_active = Gauge(
+            f"{ns}_jobs_active",
+            "Jobs currently being processed",
+            registry=self.registry,
+        )
+        self.stage_seconds = Histogram(
+            f"{ns}_stage_seconds",
+            "Wall-clock seconds per pipeline stage",
+            ["stage"],
+            registry=self.registry,
+        )
+        self.bytes_downloaded = Counter(
+            f"{ns}_bytes_downloaded_total",
+            "Bytes fetched by the download stage",
+            ["protocol"],
+            registry=self.registry,
+        )
+        self.bytes_uploaded = Counter(
+            f"{ns}_bytes_uploaded_total",
+            "Bytes staged by the upload stage",
+            registry=self.registry,
+        )
+        self.messages_published = Counter(
+            f"{ns}_messages_published_total",
+            "Queue messages published",
+            ["queue"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        """Prometheus text exposition of the registry."""
+        return generate_latest(self.registry)
+
+
+def new(service: str = "downloader") -> Metrics:
+    """(reference ``Prom.new('downloader')``, lib/main.js:43)"""
+    return Metrics(service)

@@ -1,0 +1,70 @@
+"""Job telemetry: status + progress events published to the queue.
+
+Capability-equivalent to ``triton-core/telemetry``: ``emitStatus(jobId, 2)``
+(/root/reference/lib/main.js:68) and
+``emitProgress(id, DOWNLOADING, percent)``
+(/root/reference/lib/download.js:85,255,272, lib/upload.js:51), delivered
+over RabbitMQ (lib/main.js:49-50).
+
+Events are protobuf (``TelemetryStatusEvent`` / ``TelemetryProgressEvent``)
+on the ``v1.telemetry.status`` / ``v1.telemetry.progress`` queues.  The
+reference stores its telemetry client in ``global.telem`` (lib/main.js:52,
+self-annotated ``// BAD``); here the client is passed explicitly to every
+stage (SURVEY.md §7 step 6 lists that global as a bug to fix).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import schemas
+from ..mq.base import MessageQueue
+
+STATUS_QUEUE = "v1.telemetry.status"
+PROGRESS_QUEUE = "v1.telemetry.progress"
+
+
+class Telemetry:
+    """Publishes job status/progress events.
+
+    ``metrics`` is optional, mirroring how the reference passes its prom
+    handle into Telemetry for internal counters (lib/main.js:49).
+    """
+
+    def __init__(self, mq: MessageQueue, metrics=None):
+        self._mq = mq
+        self._metrics = metrics
+
+    async def connect(self) -> None:
+        """(reference lib/main.js:50)"""
+        await self._mq.connect()
+
+    async def emit_status(self, media_id: str, status: int) -> None:
+        event = schemas.TelemetryStatusEvent(media_id=media_id, status=status)
+        await self._mq.publish(STATUS_QUEUE, schemas.encode(event))
+        if self._metrics is not None:
+            self._metrics.messages_published.labels(queue=STATUS_QUEUE).inc()
+
+    async def emit_progress(self, media_id: str, status: int, percent: int) -> None:
+        event = schemas.TelemetryProgressEvent(
+            media_id=media_id, status=status, percent=int(percent)
+        )
+        await self._mq.publish(PROGRESS_QUEUE, schemas.encode(event))
+        if self._metrics is not None:
+            self._metrics.messages_published.labels(queue=PROGRESS_QUEUE).inc()
+
+
+class NullTelemetry(Telemetry):
+    """Telemetry sink that drops everything (hermetic stage tests)."""
+
+    def __init__(self) -> None:  # noqa: D401
+        super().__init__(mq=None)  # type: ignore[arg-type]
+
+    async def connect(self) -> None:
+        pass
+
+    async def emit_status(self, media_id: str, status: int) -> None:
+        pass
+
+    async def emit_progress(self, media_id: str, status: int, percent: int) -> None:
+        pass

@@ -1,0 +1,130 @@
+"""Distributed tracing.
+
+The reference plumbs a Jaeger tracer (/root/reference/index.js:10,15) and
+imports the opentracing symbols (/root/reference/lib/main.js:20) but never
+creates a span — SURVEY.md §5 flags tracing as "plumbed-but-unused" and the
+build plan (§7 step 7) says to wire it for real.  This module is a small
+OpenTracing-style tracer: nested spans with tags and timings, kept in an
+in-memory buffer and optionally exported as JSON lines for offline analysis
+(no Jaeger agent required).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "current_span", default=None
+)
+
+
+class Span:
+    __slots__ = (
+        "tracer", "name", "trace_id", "span_id", "parent_id",
+        "start", "end", "tags", "error",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional["Span"] = None, **tags: Any):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent.span_id if parent else None
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.tags: Dict[str, Any] = dict(tags)
+        self.error: Optional[str] = None
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self.end is not None:
+            return
+        self.end = time.time()
+        if error is not None:
+            self.error = f"{type(error).__name__}: {error}"
+        self.tracer._record(self)
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startTime": self.start,
+            "duration": self.duration,
+            "tags": self.tags,
+            "error": self.error,
+        }
+
+
+class Tracer:
+    """Span factory + buffer.  ``export_path`` (or ``$TRACE_EXPORT``) appends
+    each finished span as one JSON line."""
+
+    def __init__(self, service: str, export_path: Optional[str] = None,
+                 max_buffer: int = 10_000):
+        self.service = service
+        self.export_path = export_path or os.environ.get("TRACE_EXPORT")
+        self.finished: List[Span] = []
+        self._max_buffer = max_buffer
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags: Any):
+        parent = _current_span.get()
+        span = Span(self, name, parent, **tags)
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.finish(error=exc)
+            raise
+        finally:
+            _current_span.reset(token)
+            span.finish()
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.finished.append(span)
+            if len(self.finished) > self._max_buffer:
+                del self.finished[: len(self.finished) - self._max_buffer]
+        if self.export_path:
+            line = json.dumps({"service": self.service, **span.to_dict()})
+            with self._lock, open(self.export_path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            return [s for s in self.finished if name is None or s.name == name]
+
+
+class NullTracer(Tracer):
+    """Tracer that records nothing (for perf-sensitive or minimal runs)."""
+
+    def __init__(self) -> None:
+        super().__init__("null")
+
+    def _record(self, span: Span) -> None:
+        pass
+
+
+def init_tracer(service: str, logger=None) -> Tracer:
+    """(reference ``Tracer('downloader', logger)``, index.js:15)"""
+    tracer = Tracer(service)
+    if logger is not None:
+        logger.debug("tracer initialized", service=service)
+    return tracer

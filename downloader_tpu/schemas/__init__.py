@@ -1,0 +1,78 @@
+"""Wire schemas and enum helpers for the downloader pipeline.
+
+Capability-equivalent to the reference's `triton-core/proto` registry usage:
+``proto.load`` / ``proto.encode`` / ``proto.decode`` (/root/reference/lib/main.js:55-63,161)
+and ``proto.enumToString`` / ``proto.stringToEnum``
+(/root/reference/lib/download.js:243, /root/reference/lib/process.js:53).
+
+Messages are real protobuf (see ``downloader.proto``), so the wire format is
+binary protobuf just like the reference's, and the generated classes are the
+single source of truth for field names and enum values.
+"""
+
+from __future__ import annotations
+
+from typing import Type, Union
+
+from google.protobuf.message import Message
+
+from .downloader_pb2 import (  # noqa: F401  (re-exported)
+    Convert,
+    Download,
+    Media,
+    MediaType,
+    SourceType,
+    TelemetryProgressEvent,
+    TelemetryStatus,
+    TelemetryStatusEvent,
+)
+
+# Queue names (reference lib/main.js:164,172).
+DOWNLOAD_QUEUE = "v1.download"
+CONVERT_QUEUE = "v1.convert"
+
+_MESSAGE_TYPES = {
+    "downloader.Download": Download,
+    "downloader.Convert": Convert,
+    "downloader.Media": Media,
+    "downloader.TelemetryStatusEvent": TelemetryStatusEvent,
+    "downloader.TelemetryProgressEvent": TelemetryProgressEvent,
+}
+
+
+def load(name: str) -> Type[Message]:
+    """Look up a message class by registry name.
+
+    Mirrors the reference's ``proto.load('api.Download')`` surface
+    (/root/reference/lib/main.js:55) with our own registry names.
+    """
+    try:
+        return _MESSAGE_TYPES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown message type {name!r}; known: {sorted(_MESSAGE_TYPES)}"
+        ) from None
+
+
+def encode(msg: Message) -> bytes:
+    """Serialize a message to its binary wire format."""
+    return msg.SerializeToString()
+
+
+def decode(msg_type: Type[Message], data: bytes) -> Message:
+    """Parse binary wire format into a message instance."""
+    msg = msg_type()
+    msg.ParseFromString(data)
+    return msg
+
+
+def enum_to_string(enum_type, value: int) -> str:
+    """Enum numeric value -> name (reference ``proto.enumToString``,
+    /root/reference/lib/download.js:243)."""
+    return enum_type.Name(value)
+
+
+def string_to_enum(enum_type, name: str) -> int:
+    """Enum name -> numeric value (reference ``proto.stringToEnum``,
+    /root/reference/lib/process.js:53)."""
+    return enum_type.Value(name)

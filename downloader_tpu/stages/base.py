@@ -1,0 +1,101 @@
+"""The stage plugin contract.
+
+Reference contract: every stage module exports
+``async (config, emitter, logger) => async (job) => result``
+(/root/reference/lib/download.js:30,230, lib/process.js:101-103,
+lib/upload.js:14-17).  The orchestrator loads stages by name from the
+``stages`` list, validates the factory returned a callable
+(lib/main.js:99-115), and threads each result to the next stage as
+``job.lastStage`` (lib/main.js:129-140).
+
+Differences from the reference, per SURVEY.md §7 step 6 (bug fixes):
+- telemetry is an explicit ``StageContext`` field, not a ``global.telem``
+- the tracer is threaded through and actually used
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from .. import schemas
+from ..platform.logging import Logger
+from ..platform.telemetry import NullTelemetry, Telemetry
+from ..platform.tracing import NullTracer, Tracer
+from ..utils import EventEmitter
+
+# Fixed stage order (reference lib/main.js:28-32).
+STAGES = ["download", "process", "upload"]
+
+
+@dataclasses.dataclass
+class Job:
+    """What a stage receives: the decoded message plus the previous stage's
+    result (reference ``_.create(msg, {lastStage})``, lib/main.js:131-133)."""
+
+    media: schemas.Media
+    last_stage: Any = None
+
+
+@dataclasses.dataclass
+class StageContext:
+    """Everything a stage factory may need.
+
+    ``config``/``emitter``/``logger`` mirror the reference factory args;
+    the rest replaces its globals and module-level singletons.
+    """
+
+    config: Any
+    emitter: EventEmitter
+    logger: Logger
+    telemetry: Telemetry = dataclasses.field(default_factory=NullTelemetry)
+    metrics: Any = None
+    store: Any = None
+    tracer: Tracer = dataclasses.field(default_factory=NullTracer)
+    # Optional override for the download stage's ad-hoc ``bucket://`` client
+    # (tests inject a fake; default builds an S3 client).
+    bucket_client_factory: Optional[Callable] = None
+
+StageFn = Callable[[Job], Awaitable[Any]]
+StageFactory = Callable[[StageContext], Awaitable[StageFn]]
+
+_REGISTRY: Dict[str, str] = {
+    "download": "downloader_tpu.stages.download",
+    "process": "downloader_tpu.stages.process",
+    "upload": "downloader_tpu.stages.upload",
+}
+
+
+def register_stage(name: str, module: str) -> None:
+    """Register an out-of-tree stage module (must expose ``stage_factory``)."""
+    _REGISTRY[name] = module
+
+
+def get_stage_factory(name: str) -> StageFactory:
+    """Resolve a stage name to its factory.
+
+    Mirrors the reference's dynamic ``require(path.join(__dirname,
+    `${stage}.js`))`` loading (lib/main.js:101-106).
+    """
+    try:
+        module_name = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown stage {name!r}; known: {sorted(_REGISTRY)}") from None
+    module = importlib.import_module(module_name)
+    return module.stage_factory
+
+
+async def load_stages(ctx: StageContext, names: Optional[list] = None) -> Dict[str, StageFn]:
+    """Instantiate each stage and validate the contract
+    (reference lib/main.js:99-115)."""
+    table: Dict[str, StageFn] = {}
+    for name in names or STAGES:
+        factory = get_stage_factory(name)
+        fn = await factory(ctx)
+        if not callable(fn):
+            raise TypeError(
+                f"Invalid stage {name!r}: factory return value was not callable"
+            )
+        table[name] = fn
+    return table

@@ -1,0 +1,231 @@
+"""Download stage: protocol-dispatched media fetch.
+
+Behavioral parity with /root/reference/lib/download.js:
+
+- download dir = ``<config.instance.download_path>/<media.id>``, with
+  relative paths resolved against the repo root (lib/download.js:234-240)
+- protocol chosen by the ``SourceType`` enum name, lowercased
+  (lib/download.js:243,256-260); unsupported -> ``Protocol not supported.``
+- progress 0 emitted before the fetch and 50 after (lib/download.js:255,272)
+- methods:
+  * ``torrent`` — magnet/metainfo fetch with the 240 s metadata timeout and
+    240 s no-progress stall watchdog raising ``ERRDLSTALL``
+    (lib/download.js:43-123); progress maps to 0-50%
+  * ``http``   — streaming download; ``.torrent`` URLs chain to the torrent
+    method (lib/download.js:134-167)
+  * ``file``   — gated by ``ALLOW_FILE_URLS=true``; ``file://`` copy
+    (lib/download.js:177-189)
+  * ``bucket`` — ``bucket://endpoint,bucket,accessKey,secretKey,subFolder``
+    fan-in from another object store (lib/download.js:199-227)
+- returns ``{"path": download_path}`` (lib/download.js:273-275)
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import urllib.parse
+import urllib.request
+
+import aiohttp
+
+from .. import schemas
+from ..utils.watchdog import STALL_TIMEOUT_SECONDS, StallWatchdog
+from .base import Job, StageContext, StageFn
+
+# Repo root, for resolving relative download paths the way the reference
+# resolves against ``path.join(__dirname, '..')`` (lib/download.js:234-240).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Progress telemetry interval (reference: 30 s, lib/download.js:88).
+PROGRESS_INTERVAL_SECONDS = 30.0
+
+_CHUNK = 1 << 20  # 1 MiB read chunks for streaming HTTP
+
+
+def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
+                       ssl: bool = True):
+    """Default factory for the ``bucket`` method's ad-hoc client
+    (reference builds a MinIO client inline, lib/download.js:210-215)."""
+    try:
+        from ..store.s3 import S3ObjectStore
+    except ImportError as err:
+        raise NotImplementedError(
+            "bucket:// downloads need the S3 driver "
+            "(downloader_tpu.store.s3) or an injected "
+            "StageContext.bucket_client_factory"
+        ) from err
+
+    scheme = "https" if ssl else "http"
+    return S3ObjectStore(f"{scheme}://{endpoint}", access_key, secret_key)
+
+
+def parse_bucket_uri(resource_url: str) -> dict:
+    """Parse ``bucket://endpoint,bucket,accessKey,secretKey,subFolder``
+    (reference lib/download.js:201-207)."""
+    params = resource_url.split(",")
+    if len(params) < 5:
+        raise ValueError(
+            "bucket URI must be bucket://endpoint,bucket,accessKey,secretKey,subFolder"
+        )
+    return {
+        "endpoint": params[0].replace("bucket://", "", 1),
+        "bucket": params[1],
+        "access_key": params[2],
+        "secret_key": params[3],
+        "sub_folder": params[4],
+    }
+
+
+async def stage_factory(ctx: StageContext) -> StageFn:
+    logger = ctx.logger
+    telemetry = ctx.telemetry
+    downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
+    bucket_client_factory = getattr(ctx, "bucket_client_factory", None) or make_bucket_client
+
+    async def torrent(resource_url: str, file_id: str, download_path: str, job: Job):
+        try:
+            from ..torrent import TorrentClient
+        except ImportError as err:
+            raise NotImplementedError(
+                "torrent downloads need downloader_tpu.torrent"
+            ) from err
+
+        logger.info("torrent", url=resource_url[:25] + "...")
+        client = TorrentClient(logger=logger)
+
+        last_emitted = [None]
+
+        async def on_progress(fraction: float) -> None:
+            # download occupies the 0-50% band; only emit on integer change
+            # (reference lib/download.js:80-87)
+            percent = int(fraction * 100 / 2)
+            if percent != last_emitted[0]:
+                last_emitted[0] = percent
+                await telemetry.emit_progress(file_id, downloading, percent)
+
+        await client.download(
+            resource_url,
+            download_path,
+            metadata_timeout=STALL_TIMEOUT_SECONDS,
+            stall_timeout=STALL_TIMEOUT_SECONDS,
+            progress_interval=PROGRESS_INTERVAL_SECONDS,
+            on_progress=on_progress,
+        )
+
+    async def http(resource_url: str, file_id: str, download_path: str, job: Job):
+        logger.info("http", url=resource_url)
+        parsed = urllib.parse.urlparse(resource_url)
+        filename = posixpath.basename(parsed.path)
+
+        # .torrent files chain to the torrent downloader
+        # (reference lib/download.js:144-155)
+        if posixpath.splitext(parsed.path)[1] == ".torrent":
+            logger.info("downloading a .torrent, chaining to torrent downloader")
+            return await torrent(resource_url, file_id, download_path, job)
+
+        os.makedirs(download_path, exist_ok=True)
+        output = os.path.join(download_path, filename)
+
+        watchdog = StallWatchdog(STALL_TIMEOUT_SECONDS)
+
+        async def _fetch() -> int:
+            total = 0
+            async with aiohttp.ClientSession() as session:
+                async with session.get(resource_url) as resp:
+                    resp.raise_for_status()
+                    with open(output, "wb") as fh:
+                        async for chunk in resp.content.iter_chunked(_CHUNK):
+                            fh.write(chunk)
+                            total += len(chunk)
+                            watchdog.feed(total)
+            return total
+
+        total = await watchdog.watch(_fetch())
+        if ctx.metrics is not None:
+            ctx.metrics.bytes_downloaded.labels(protocol="http").inc(total)
+
+    async def file(resource_url: str, file_id: str, download_path: str, job: Job):
+        # (reference lib/download.js:177-189)
+        if os.environ.get("ALLOW_FILE_URLS") != "true":
+            raise PermissionError("File URLs are not allowed.")
+
+        qualified = urllib.request.url2pathname(
+            urllib.parse.urlparse(resource_url).path
+        )
+        output = os.path.join(download_path, os.path.basename(qualified))
+        logger.debug("file copy", src=qualified, dst=output)
+        os.makedirs(download_path, exist_ok=True)
+        import shutil
+
+        shutil.copyfile(qualified, output)
+        if ctx.metrics is not None:
+            ctx.metrics.bytes_downloaded.labels(protocol="file").inc(
+                os.path.getsize(output)
+            )
+
+    async def bucket(resource_url: str, file_id: str, download_path: str, job: Job):
+        # (reference lib/download.js:199-227)
+        logger.info("bucket", url=resource_url)
+        params = parse_bucket_uri(resource_url)
+        logger.info("bucket endpoint", endpoint=params["endpoint"])
+
+        client = bucket_client_factory(
+            params["endpoint"], params["access_key"], params["secret_key"]
+        )
+        sub_folder = params["sub_folder"]
+        prefix = sub_folder.rstrip("/") + "/"
+        total = 0
+        async for item in client.list_objects(params["bucket"], prefix):
+            if not item.name:
+                continue
+            # strip the subFolder prefix from the local path
+            # (reference lib/download.js:223)
+            local = os.path.join(
+                download_path, item.name.replace(sub_folder, "", 1).lstrip("/")
+            )
+            logger.info("bucket fetch", object=item.name, to=local)
+            await client.fget_object(params["bucket"], item.name, local)
+            total += item.size
+        if ctx.metrics is not None:
+            ctx.metrics.bytes_downloaded.labels(protocol="bucket").inc(total)
+
+    methods = {"torrent": torrent, "http": http, "file": file, "bucket": bucket}
+
+    async def download(job: Job):
+        media = job.media
+        file_id = media.id
+
+        configured = ctx.config.instance.download_path
+        prefix = "" if os.path.isabs(configured) else _REPO_ROOT
+        download_path = os.path.join(prefix, configured, file_id)
+
+        url = media.source_uri
+        protocol = schemas.enum_to_string(schemas.SourceType, media.source)
+
+        try:
+            os.makedirs(download_path, exist_ok=True)
+            logger.info("created downloadPath", path=download_path)
+        except OSError as err:
+            logger.error("Failed to create directory", error=str(err))
+
+        logger.info("starting download", protocol=protocol, url=url)
+
+        await telemetry.emit_progress(file_id, downloading, 0)
+
+        method = methods.get(protocol.lower())
+        if method is None:
+            raise ValueError("Protocol not supported.")
+
+        with ctx.tracer.span("stage.download", protocol=protocol, mediaId=file_id):
+            try:
+                await method(url, file_id, download_path, job)
+            except Exception as err:
+                logger.error("Download error", error=str(err))
+                raise
+
+        logger.info("finished download")
+        await telemetry.emit_progress(file_id, downloading, 50)
+        return {"path": download_path}
+
+    return download

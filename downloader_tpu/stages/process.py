@@ -1,0 +1,125 @@
+"""Process stage: walk the download directory and select convertible media.
+
+Behavioral parity with /root/reference/lib/process.js:
+
+- extension whitelist ``.mp4 .mkv .mov .webm`` (lib/process.js:15-20,70-72)
+- a sole top-level directory is always traversed (lib/process.js:40-48)
+- MOVIE mode keeps every directory (lib/process.js:53-55)
+- paths containing ``/extras`` or ``/commentary`` (case-insensitive) are
+  rejected (lib/process.js:59-61)
+- directory names containing ``season`` or ``s<digits>`` (case-insensitive)
+  are accepted (lib/process.js:64-66)
+- anything else is rejected; rejected directories are not descended into
+- zero matches raises ``Failed to find any suitable media files``
+  (lib/process.js:109-111)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+from typing import List
+
+from .. import schemas
+from .base import Job, StageContext, StageFn
+
+# (reference lib/process.js:15-20)
+MEDIA_EXTS = {".mp4", ".mkv", ".mov", ".webm"}
+
+# (reference lib/process.js:59-66) — substring matches, like JS regex.test
+_SKIP_PATH_RE = re.compile(r"/extras|/commentary", re.IGNORECASE)
+_SEASON_RE = re.compile(r"s\d+|season", re.IGNORECASE)
+
+
+class NoMediaFilesError(Exception):
+    """Raised when the walk finds nothing convertible
+    (reference lib/process.js:109-111)."""
+
+
+def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
+    name = os.path.basename(dir_path)
+
+    # Sole top-level directory is always traversed (lib/process.js:40-48).
+    # The reference checks the *name* against the root listing, so a nested
+    # directory sharing the sole top-level dir's name is also allowed —
+    # preserved as-is for parity.
+    try:
+        if os.path.exists(os.path.join(root, name)):
+            entries = os.listdir(root)
+            if len(entries) == 1 and entries[0] == name:
+                logger.info(
+                    "directory allowed: only top level directory", path=dir_path
+                )
+                return True
+    except OSError:
+        pass
+
+    # In movie mode, assume the best (lib/process.js:53-55).
+    if is_movie:
+        return True
+
+    # Explicitly skip extras/commentary anywhere in the path
+    # (lib/process.js:59-61).
+    if _SKIP_PATH_RE.search(dir_path.replace(os.sep, "/")):
+        return False
+
+    # Allow season-like directory names (lib/process.js:64-66).
+    return bool(_SEASON_RE.search(name))
+
+
+def find_media_files(root: str, media: schemas.Media, logger) -> List[str]:
+    """Depth-first walk honoring the filter; returns kept file paths.
+
+    (reference ``findMediaFiles``, lib/process.js:29-99 — klaw walk with a
+    filter callback; only files are collected, directories are traversal
+    decisions)
+    """
+    is_movie = media.type == schemas.MediaType.Value("MOVIE")
+    files: List[str] = []
+
+    def _walk(dir_path: str) -> None:
+        try:
+            entries = sorted(os.scandir(dir_path), key=lambda e: e.name)
+        except FileNotFoundError:
+            raise
+        for entry in entries:
+            rel = os.path.relpath(entry.path, root)
+            if entry.is_dir(follow_symlinks=False):
+                if _dir_allowed(root, entry.path, is_movie, logger):
+                    logger.info(f"including directory '{rel}'")
+                    _walk(entry.path)
+                else:
+                    logger.warn(f"skipping directory '{rel}'")
+            else:
+                ext = os.path.splitext(entry.name)[1]
+                if ext in MEDIA_EXTS:
+                    logger.info(f"including file '{rel}'")
+                    files.append(entry.path)
+                else:
+                    logger.warn(f"skipping file '{rel}'")
+
+    _walk(root)
+    return files
+
+
+async def stage_factory(ctx: StageContext) -> StageFn:
+    logger = ctx.logger
+
+    async def process(job: Job):
+        last = job.last_stage
+        download_path = last["path"] if isinstance(last, dict) else last.path
+        logger.info("processing directory", path=download_path)
+
+        with ctx.tracer.span("stage.process", path=download_path):
+            found = await asyncio.to_thread(
+                find_media_files, download_path, job.media, logger
+            )
+
+        if len(found) == 0:
+            raise NoMediaFilesError("Failed to find any suitable media files")
+
+        logger.info("found media files", count=len(found))
+        return {"files": found, "downloadPath": download_path}
+
+    return process

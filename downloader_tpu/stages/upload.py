@@ -1,0 +1,97 @@
+"""Upload stage: push filtered files into the staging object store.
+
+Behavioral parity with /root/reference/lib/upload.js:
+
+- validates ``files`` is a list (lib/upload.js:21-23)
+- ensures bucket ``triton-staging`` exists (lib/upload.js:29-31)
+- object name = ``<media.id>/original/<base64(basename)>``
+  (lib/upload.js:43-44)
+- per-file existence check; missing file is an error (lib/upload.js:38-41)
+- progress telemetry mapped to 50-100% (lib/upload.js:47-51)
+- writes ``<media.id>/original/done`` = ``"true"`` — the idempotency marker
+  the orchestrator probes (lib/upload.js:55, lib/main.js:120)
+- best-effort removal of the download directory (lib/upload.js:60-64)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import posixpath
+import shutil
+
+from .. import schemas
+from .base import Job, StageContext, StageFn
+
+STAGING_BUCKET = "triton-staging"
+DONE_MARKER = "done"
+
+
+def object_name(media_id: str, file_path: str) -> str:
+    """``<id>/original/<base64(basename)>`` (reference lib/upload.js:43-44)."""
+    encoded = base64.b64encode(os.path.basename(file_path).encode("utf-8")).decode("ascii")
+    return posixpath.join(media_id, "original", encoded)
+
+
+def done_marker_name(media_id: str) -> str:
+    """``<id>/original/done`` (reference lib/upload.js:55)."""
+    return posixpath.join(media_id, "original", DONE_MARKER)
+
+
+async def stage_factory(ctx: StageContext) -> StageFn:
+    logger = ctx.logger
+    store = ctx.store
+    if store is None:
+        raise ValueError("upload stage requires a StageContext.store")
+    downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
+
+    async def upload(job: Job):
+        last = job.last_stage
+        files = last["files"] if isinstance(last, dict) else last.files
+        download_path = (
+            last["downloadPath"] if isinstance(last, dict) else last.downloadPath
+        )
+
+        if not isinstance(files, list):
+            raise TypeError(
+                f"Invalid files data type, expected list, got {type(files).__name__!r}"
+            )
+
+        logger.info("starting file upload", count=len(files))
+        media_id = job.media.id
+
+        with ctx.tracer.span("stage.upload", mediaId=media_id, files=len(files)):
+            if not await store.bucket_exists(STAGING_BUCKET):
+                await store.make_bucket(STAGING_BUCKET)
+
+            for i, file_path in enumerate(files, start=1):
+                logger.info("upload", file=os.path.basename(file_path))
+                if not os.path.exists(file_path):
+                    logger.error("failed to upload file, not found", file=file_path)
+                    raise FileNotFoundError(f"{file_path} not found.")
+
+                name = object_name(media_id, file_path)
+                await store.fput_object(STAGING_BUCKET, name, file_path)
+                if ctx.metrics is not None:
+                    ctx.metrics.bytes_uploaded.inc(os.path.getsize(file_path))
+
+                # upload occupies the 50-100% progress band
+                # (reference lib/upload.js:48)
+                percent = (i / len(files) * 50) + 50
+                await ctx.telemetry.emit_progress(media_id, downloading, int(percent))
+
+            await store.put_object(
+                STAGING_BUCKET, done_marker_name(media_id), b"true"
+            )
+
+        logger.info("finished uploading all files")
+
+        # best-effort cleanup (reference lib/upload.js:60-64)
+        try:
+            await asyncio.to_thread(shutil.rmtree, download_path)
+        except OSError as err:
+            logger.warn("failed to clean up directory", error=str(err))
+        return {}
+
+    return upload

@@ -1,0 +1,40 @@
+"""Object-store abstraction.
+
+The reference's bulk-data backend is S3/MinIO via ``triton-core/minio``
+(SURVEY.md §5).  This package defines the exact object-store surface the
+pipeline uses — ``getObject`` / ``fGetObject`` / ``fPutObject`` /
+``putObject`` / ``bucketExists`` / ``makeBucket`` / ``getObjects``
+(/root/reference/lib/main.js:120, lib/upload.js:29-55,
+lib/download.js:217-225) — with hermetic in-memory and filesystem-backed
+implementations.
+"""
+
+from .base import ObjectInfo, ObjectNotFound, ObjectStore
+from .fs import FilesystemObjectStore
+from .memory import InMemoryObjectStore
+
+__all__ = [
+    "ObjectInfo",
+    "ObjectNotFound",
+    "ObjectStore",
+    "FilesystemObjectStore",
+    "InMemoryObjectStore",
+]
+
+
+def new_client(config) -> ObjectStore:
+    """Build the staging object store from config.
+
+    Capability-equivalent to ``minio.newClient(config)``
+    (/root/reference/lib/main.js:41, lib/upload.js:20).  The backend is
+    selected by ``config.minio.backend``: ``memory`` (default, hermetic) or
+    ``fs`` (rooted at ``config.minio.root``).
+    """
+    minio_cfg = config.get("minio") if config is not None else None
+    backend = (minio_cfg.get("backend", "memory") if minio_cfg is not None else "memory")
+    if backend == "fs":
+        root = minio_cfg.get("root", "object-store")
+        return FilesystemObjectStore(root)
+    if backend == "memory":
+        return InMemoryObjectStore()
+    raise ValueError(f"unknown object-store backend {backend!r}")

@@ -1,0 +1,64 @@
+"""Object-store interface: the MinIO surface the pipeline actually uses."""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import AsyncIterator
+
+
+class ObjectNotFound(KeyError):
+    """Raised when a bucket/object does not exist.
+
+    The orchestrator's idempotency probe relies on catching this
+    (reference catches the MinIO getObject error at
+    /root/reference/lib/main.js:119-124)."""
+
+    def __init__(self, bucket: str, name: str):
+        super().__init__(f"{bucket}/{name}")
+        self.bucket = bucket
+        self.name = name
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectInfo:
+    """Listing entry (reference iterates ``item.name``/``item.size`` from
+    ``getObjects``, /root/reference/lib/download.js:217-222)."""
+
+    name: str
+    size: int
+
+
+class ObjectStore(abc.ABC):
+    """Async object-store client."""
+
+    @abc.abstractmethod
+    async def bucket_exists(self, bucket: str) -> bool:
+        """(reference lib/upload.js:29)"""
+
+    @abc.abstractmethod
+    async def make_bucket(self, bucket: str) -> None:
+        """(reference lib/upload.js:30)"""
+
+    @abc.abstractmethod
+    async def get_object(self, bucket: str, name: str) -> bytes:
+        """Fetch an object's bytes; raises :class:`ObjectNotFound`
+        (reference lib/main.js:120)."""
+
+    @abc.abstractmethod
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        """Store bytes as an object (reference lib/upload.js:55)."""
+
+    @abc.abstractmethod
+    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+        """Download an object to a local file, creating parent dirs
+        (reference lib/download.js:225)."""
+
+    @abc.abstractmethod
+    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+        """Upload a local file as an object (reference lib/upload.js:45)."""
+
+    @abc.abstractmethod
+    def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
+        """Iterate objects under ``prefix`` (reference ``getObjects``,
+        lib/download.js:217)."""

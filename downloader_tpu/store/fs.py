@@ -1,0 +1,101 @@
+"""Filesystem-backed object store.
+
+A durable local backend with the same interface as the in-memory fake:
+objects live at ``<root>/<bucket>/<name>`` with ``/`` in object names mapped
+to directories.  Useful for running the full service on one machine without
+a MinIO server, and for tests that want to inspect staged bytes on disk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+from typing import AsyncIterator
+
+from .base import ObjectInfo, ObjectNotFound, ObjectStore
+
+
+def _safe_parts(name: str) -> list:
+    parts = [p for p in name.split("/") if p not in ("", ".")]
+    if any(p == ".." for p in parts):
+        raise ValueError(f"object name {name!r} escapes the bucket")
+    return parts
+
+
+class FilesystemObjectStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _bucket_path(self, bucket: str) -> str:
+        (part,) = _safe_parts(bucket) or [""]
+        return os.path.join(self.root, part)
+
+    def _object_path(self, bucket: str, name: str) -> str:
+        return os.path.join(self._bucket_path(bucket), *_safe_parts(name))
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        return await asyncio.to_thread(os.path.isdir, self._bucket_path(bucket))
+
+    async def make_bucket(self, bucket: str) -> None:
+        await asyncio.to_thread(os.makedirs, self._bucket_path(bucket), exist_ok=True)
+
+    async def get_object(self, bucket: str, name: str) -> bytes:
+        path = self._object_path(bucket, name)
+        try:
+            return await asyncio.to_thread(_read_file, path)
+        except (FileNotFoundError, IsADirectoryError):
+            raise ObjectNotFound(bucket, name) from None
+
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        path = self._object_path(bucket, name)
+        await asyncio.to_thread(_write_file_atomic, path, data)
+
+    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+        src = self._object_path(bucket, name)
+        if not await asyncio.to_thread(os.path.isfile, src):
+            raise ObjectNotFound(bucket, name)
+        os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
+        await asyncio.to_thread(shutil.copyfile, src, file_path)
+
+    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+        dst = self._object_path(bucket, name)
+        await asyncio.to_thread(_copy_file_atomic, file_path, dst)
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
+        bucket_path = self._bucket_path(bucket)
+
+        def _walk() -> list:
+            found = []
+            for dirpath, _dirnames, filenames in os.walk(bucket_path):
+                for filename in filenames:
+                    full = os.path.join(dirpath, filename)
+                    key = os.path.relpath(full, bucket_path).replace(os.sep, "/")
+                    if key.startswith(prefix):
+                        found.append(ObjectInfo(name=key, size=os.path.getsize(full)))
+            found.sort(key=lambda info: info.name)
+            return found
+
+        for info in await asyncio.to_thread(_walk):
+            yield info
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def _write_file_atomic(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, path)
+
+
+def _copy_file_atomic(src: str, dst: str) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    tmp = dst + ".tmp"
+    shutil.copyfile(src, tmp)
+    os.replace(tmp, dst)

@@ -1,0 +1,64 @@
+"""Hermetic in-memory object store (the test fake for MinIO/S3)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator, Dict
+
+from .base import ObjectInfo, ObjectNotFound, ObjectStore
+
+
+class InMemoryObjectStore(ObjectStore):
+    def __init__(self) -> None:
+        self._buckets: Dict[str, Dict[str, bytes]] = {}
+        self._lock = asyncio.Lock()
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        return bucket in self._buckets
+
+    async def make_bucket(self, bucket: str) -> None:
+        async with self._lock:
+            self._buckets.setdefault(bucket, {})
+
+    def _bucket(self, bucket: str, name: str = "") -> Dict[str, bytes]:
+        try:
+            return self._buckets[bucket]
+        except KeyError:
+            raise ObjectNotFound(bucket, name) from None
+
+    async def get_object(self, bucket: str, name: str) -> bytes:
+        objects = self._bucket(bucket, name)
+        try:
+            return objects[name]
+        except KeyError:
+            raise ObjectNotFound(bucket, name) from None
+
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        async with self._lock:
+            self._buckets.setdefault(bucket, {})[name] = bytes(data)
+
+    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+        data = await self.get_object(bucket, name)
+        os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
+        await asyncio.to_thread(_write_file, file_path, data)
+
+    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+        data = await asyncio.to_thread(_read_file, file_path)
+        await self.put_object(bucket, name, data)
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
+        objects = self._buckets.get(bucket, {})
+        for name in sorted(objects):
+            if name.startswith(prefix):
+                yield ObjectInfo(name=name, size=len(objects[name]))
+
+
+def _write_file(path: str, data: bytes) -> None:
+    with open(path, "wb") as fh:
+        fh.write(data)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as fh:
+        return fh.read()

@@ -1,0 +1,5 @@
+"""Small shared utilities."""
+
+from .events import EventEmitter
+
+__all__ = ["EventEmitter"]

@@ -1,0 +1,10 @@
+"""Test-only stage plugins, loaded through the stage registry."""
+
+from downloader_tpu.utils.watchdog import DownloadStalledError
+
+
+async def stage_factory(ctx):
+    async def stall(job):
+        raise DownloadStalledError()
+
+    return stall

@@ -1,0 +1,177 @@
+"""Download-stage tests: protocol dispatch, http streaming, file gating,
+bucket fan-in (reference /root/reference/lib/download.js)."""
+
+import os
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
+from downloader_tpu.stages.base import Job, StageContext
+from downloader_tpu.stages.download import parse_bucket_uri, stage_factory
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.utils import EventEmitter
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def broker():
+    return InMemoryBroker()
+
+
+def make_config(tmp_path):
+    return ConfigNode(
+        {"instance": {"download_path": str(tmp_path / "downloads")}}
+    )
+
+
+async def make_stage(tmp_path, broker, bucket_client_factory=None):
+    mq = MemoryQueue(broker)
+    await mq.connect()
+    ctx = StageContext(
+        config=make_config(tmp_path),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+        telemetry=Telemetry(mq),
+        bucket_client_factory=bucket_client_factory,
+    )
+    return await stage_factory(ctx)
+
+
+def make_job(source: str, uri: str, media_id: str = "job-1") -> Job:
+    return Job(
+        media=schemas.Media(
+            id=media_id,
+            source=schemas.SourceType.Value(source),
+            source_uri=uri,
+        )
+    )
+
+
+@pytest.fixture
+async def http_server():
+    app = web.Application()
+    payload = b"M" * (1 << 20)  # 1 MiB
+
+    async def serve(request):
+        return web.Response(body=payload)
+
+    async def missing(request):
+        return web.Response(status=404)
+
+    app.router.add_get("/media/file.mkv", serve)
+    app.router.add_get("/media/missing.mkv", missing)
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    yield f"http://127.0.0.1:{port}", payload
+    await runner.cleanup()
+
+
+async def test_http_download_streams_to_disk(tmp_path, broker, http_server):
+    base, payload = http_server
+    stage = await make_stage(tmp_path, broker)
+    result = await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    expected_dir = str(tmp_path / "downloads" / "job-1")
+    assert result == {"path": expected_dir}
+    with open(os.path.join(expected_dir, "file.mkv"), "rb") as fh:
+        assert fh.read() == payload
+
+
+async def test_http_emits_progress_0_and_50(tmp_path, broker, http_server):
+    base, _ = http_server
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    events = [
+        schemas.decode(schemas.TelemetryProgressEvent, raw)
+        for raw in broker.published(PROGRESS_QUEUE)
+    ]
+    # (reference lib/download.js:255,272)
+    assert [e.percent for e in events] == [0, 50]
+
+
+async def test_http_error_status_raises(tmp_path, broker, http_server):
+    base, _ = http_server
+    stage = await make_stage(tmp_path, broker)
+    with pytest.raises(Exception):
+        await stage(make_job("HTTP", f"{base}/media/missing.mkv"))
+
+
+async def test_file_urls_gated_by_env(tmp_path, broker, monkeypatch):
+    src = tmp_path / "local.mkv"
+    src.write_bytes(b"local-bytes")
+    uri = src.as_uri()
+    stage = await make_stage(tmp_path, broker)
+
+    monkeypatch.delenv("ALLOW_FILE_URLS", raising=False)
+    with pytest.raises(PermissionError):
+        await stage(make_job("FILE", uri))
+
+    monkeypatch.setenv("ALLOW_FILE_URLS", "true")
+    result = await stage(make_job("FILE", uri))
+    out = os.path.join(result["path"], "local.mkv")
+    with open(out, "rb") as fh:
+        assert fh.read() == b"local-bytes"
+
+
+async def test_bucket_download_strips_subfolder(tmp_path, broker):
+    remote = InMemoryObjectStore()
+    await remote.make_bucket("media")
+    await remote.put_object("media", "show/ep1.mkv", b"ep1")
+    await remote.put_object("media", "show/sub/ep2.mkv", b"ep2")
+    await remote.put_object("media", "other/ep3.mkv", b"nope")
+
+    captured = {}
+
+    def factory(endpoint, access_key, secret_key, ssl=True):
+        captured.update(
+            endpoint=endpoint, access_key=access_key, secret_key=secret_key
+        )
+        return remote
+
+    stage = await make_stage(tmp_path, broker, bucket_client_factory=factory)
+    uri = "bucket://minio.example:9000,media,AKIA,SECRET,show"
+    result = await stage(make_job("BUCKET", uri))
+
+    assert captured == {
+        "endpoint": "minio.example:9000",
+        "access_key": "AKIA",
+        "secret_key": "SECRET",
+    }
+    root = result["path"]
+    with open(os.path.join(root, "ep1.mkv"), "rb") as fh:
+        assert fh.read() == b"ep1"
+    with open(os.path.join(root, "sub", "ep2.mkv"), "rb") as fh:
+        assert fh.read() == b"ep2"
+    assert not os.path.exists(os.path.join(root, "ep3.mkv"))
+
+
+def test_parse_bucket_uri():
+    parsed = parse_bucket_uri("bucket://e:9000,b,ak,sk,folder/")
+    assert parsed == {
+        "endpoint": "e:9000",
+        "bucket": "b",
+        "access_key": "ak",
+        "secret_key": "sk",
+        "sub_folder": "folder/",
+    }
+    with pytest.raises(ValueError):
+        parse_bucket_uri("bucket://missing,parts")
+
+
+async def test_unsupported_protocol_raises(tmp_path, broker):
+    stage = await make_stage(tmp_path, broker)
+    job = make_job("HTTP", "http://x/file.mkv")
+    job.media.source = 17  # not a known SourceType
+    with pytest.raises(ValueError):
+        await stage(job)

@@ -1,0 +1,110 @@
+"""In-memory broker semantics: the AMQP slice the pipeline relies on
+(at-least-once delivery, ack/nack, prefetch; SURVEY.md §5)."""
+
+import asyncio
+
+import pytest
+
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_publish_consume_ack():
+    broker = InMemoryBroker()
+    conn = MemoryQueue(broker)
+    await conn.connect()
+
+    got = []
+
+    async def handler(delivery):
+        got.append(delivery.body)
+        await delivery.ack()
+
+    await conn.listen("q", handler)
+    await conn.publish("q", b"one")
+    await conn.publish("q", b"two")
+    await broker.join("q")
+
+    assert got == [b"one", b"two"]
+    assert broker.idle("q")
+    await conn.close()
+
+
+async def test_nack_redelivers_with_flag():
+    broker = InMemoryBroker()
+    conn = MemoryQueue(broker)
+    await conn.connect()
+
+    seen = []
+
+    async def handler(delivery):
+        seen.append(delivery.redelivered)
+        if not delivery.redelivered:
+            await delivery.nack()
+        else:
+            await delivery.ack()
+
+    await conn.listen("q", handler)
+    await conn.publish("q", b"msg")
+    await broker.join("q")
+
+    assert seen == [False, True]
+    await conn.close()
+
+
+async def test_crashed_handler_redelivers():
+    broker = InMemoryBroker(max_redeliveries=1)
+    conn = MemoryQueue(broker)
+    await conn.connect()
+
+    calls = []
+
+    async def handler(delivery):
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    await conn.listen("q", handler)
+    await conn.publish("q", b"msg")
+    await broker.join("q")
+
+    # delivered, crashed, redelivered (max 1 redelivery), then dropped
+    assert len(calls) == 2
+    assert broker.dropped == [("q", b"msg")]
+    await conn.close()
+
+
+async def test_prefetch_bounds_concurrency():
+    broker = InMemoryBroker()
+    conn = MemoryQueue(broker)
+    await conn.connect()
+
+    active = 0
+    peak = 0
+
+    async def handler(delivery):
+        nonlocal active, peak
+        active += 1
+        peak = max(peak, active)
+        await asyncio.sleep(0.02)
+        active -= 1
+        await delivery.ack()
+
+    await conn.listen("q", handler, prefetch=2)
+    for i in range(6):
+        await conn.publish("q", str(i).encode())
+    await broker.join("q")
+
+    assert peak <= 2
+    await conn.close()
+
+
+async def test_published_introspection():
+    broker = InMemoryBroker()
+    conn = MemoryQueue(broker)
+    await conn.connect()
+    await conn.publish("out", b"a")
+    await conn.publish("out", b"b")
+    assert broker.published("out") == [b"a", b"b"]
+    assert broker.depth("out") == 2
+    await conn.close()

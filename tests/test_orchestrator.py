@@ -1,0 +1,190 @@
+"""End-to-end orchestrator tests against hermetic fakes.
+
+One ``Download`` message in -> files staged with a ``done`` marker -> one
+``Convert`` message out (the "minimum end-to-end slice" from SURVEY.md §7),
+plus the idempotency and error policies of /root/reference/lib/main.js.
+"""
+
+import os
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.telemetry import STATUS_QUEUE, Telemetry
+from downloader_tpu.stages.base import register_stage
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+async def http_server():
+    app = web.Application()
+    payload = b"V" * 4096
+
+    async def serve(request):
+        return web.Response(body=payload)
+
+    app.router.add_get("/show.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    yield f"http://127.0.0.1:{port}", payload
+    await runner.cleanup()
+
+
+def make_download_msg(uri: str, job_id: str = "job-1") -> bytes:
+    return schemas.encode(
+        schemas.Download(
+            media=schemas.Media(
+                id=job_id,
+                creator_id="card-1",
+                name="A Show",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=uri,
+            )
+        )
+    )
+
+
+async def make_orchestrator(tmp_path, broker, store, **kwargs):
+    config = ConfigNode(
+        {"instance": {"download_path": str(tmp_path / "downloads")}}
+    )
+    mq = MemoryQueue(broker)
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config,
+        mq=mq,
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new("test"),
+        logger=NullLogger(),
+        **kwargs,
+    )
+    await orchestrator.start()
+    return orchestrator
+
+
+async def test_end_to_end_slice(tmp_path, http_server):
+    base, payload = http_server
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+
+    broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(f"{base}/show.mkv"))
+    await broker.join(schemas.DOWNLOAD_QUEUE)
+
+    # staged object + done marker
+    staged = await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "show.mkv")
+    )
+    assert staged == payload
+    assert await store.get_object(STAGING_BUCKET, "job-1/original/done") == b"true"
+
+    # convert message published (reference lib/main.js:157-164)
+    converts = broker.published(schemas.CONVERT_QUEUE)
+    assert len(converts) == 1
+    convert = schemas.decode(schemas.Convert, converts[0])
+    assert convert.media.id == "job-1"
+    assert convert.created_at  # ISO timestamp set
+
+    # DOWNLOADING status emitted on receipt (reference lib/main.js:68)
+    statuses = [
+        schemas.decode(schemas.TelemetryStatusEvent, raw)
+        for raw in broker.published(STATUS_QUEUE)
+    ]
+    assert statuses[0].status == schemas.TelemetryStatus.Value("DOWNLOADING")
+
+    # download dir cleaned up by the upload stage
+    assert not os.path.exists(str(tmp_path / "downloads" / "job-1"))
+
+    # active-jobs bookkeeping shrank back (reference bug fixed)
+    assert orchestrator.active_jobs == []
+    await orchestrator.shutdown(grace_seconds=1)
+
+
+async def test_duplicate_job_skips_but_still_publishes_convert(
+    tmp_path, http_server
+):
+    base, _ = http_server
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+
+    msg = make_download_msg(f"{base}/show.mkv")
+    broker.publish(schemas.DOWNLOAD_QUEUE, msg)
+    await broker.join(schemas.DOWNLOAD_QUEUE)
+    broker.publish(schemas.DOWNLOAD_QUEUE, msg)
+    await broker.join(schemas.DOWNLOAD_QUEUE)
+
+    # second run skipped the stages (idempotency marker), but the convert
+    # message was still published (reference lib/main.js:153-167)
+    assert len(broker.published(schemas.CONVERT_QUEUE)) == 2
+    assert orchestrator.metrics.jobs_skipped._value.get() == 1
+    await orchestrator.shutdown(grace_seconds=1)
+
+
+async def test_stage_error_nacks_and_emits_errored(tmp_path):
+    broker = InMemoryBroker(max_redeliveries=1)
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+
+    # HTTP fetch against a closed port -> download stage error
+    broker.publish(
+        schemas.DOWNLOAD_QUEUE,
+        make_download_msg("http://127.0.0.1:1/nope.mkv", job_id="job-err"),
+    )
+    await broker.join(schemas.DOWNLOAD_QUEUE)
+
+    # nacked -> redelivered until the test broker dropped it
+    assert broker.dropped and broker.dropped[0][0] == schemas.DOWNLOAD_QUEUE
+    statuses = [
+        schemas.decode(schemas.TelemetryStatusEvent, raw)
+        for raw in broker.published(STATUS_QUEUE)
+    ]
+    assert any(
+        s.status == schemas.TelemetryStatus.Value("ERRORED") for s in statuses
+    )
+    # no convert message for a failed job
+    assert broker.published(schemas.CONVERT_QUEUE) == []
+    await orchestrator.shutdown(grace_seconds=1)
+
+
+async def test_stall_error_acks_and_drops(tmp_path):
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+
+    register_stage("stall", "tests.fake_stages")
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store, stages=["stall"]
+    )
+
+    broker.publish(
+        schemas.DOWNLOAD_QUEUE, make_download_msg("http://x/", job_id="job-stall")
+    )
+    await broker.join(schemas.DOWNLOAD_QUEUE)
+
+    # ERRDLSTALL -> acked (dropped), no redelivery, no convert, no ERRORED
+    # (reference lib/main.js:144-146)
+    assert broker.idle(schemas.DOWNLOAD_QUEUE)
+    assert broker.published(schemas.CONVERT_QUEUE) == []
+    statuses = [
+        schemas.decode(schemas.TelemetryStatusEvent, raw)
+        for raw in broker.published(STATUS_QUEUE)
+    ]
+    assert all(
+        s.status != schemas.TelemetryStatus.Value("ERRORED") for s in statuses
+    )
+    await orchestrator.shutdown(grace_seconds=1)

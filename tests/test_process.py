@@ -1,0 +1,86 @@
+"""Process-stage filter parity tests.
+
+Mirrors the reference's fixture-tree test strategy
+(/root/reference/test/process/filter_dirs.js, SURVEY.md §4) with equivalent
+on-disk trees under tests/fixtures/filter_dirs/.
+"""
+
+import os
+
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.stages.base import Job, StageContext
+from downloader_tpu.stages.process import NoMediaFilesError, stage_factory
+from downloader_tpu.utils import EventEmitter
+
+from conftest import FIXTURES
+
+pytestmark = pytest.mark.anyio
+
+FILTER_DIRS = os.path.join(FIXTURES, "filter_dirs")
+
+
+def make_media(media_type: str) -> schemas.Media:
+    return schemas.Media(
+        id="<uuid>", type=schemas.MediaType.Value(media_type)
+    )
+
+
+@pytest.fixture
+async def process():
+    ctx = StageContext(config={}, emitter=EventEmitter(), logger=NullLogger())
+    return await stage_factory(ctx)
+
+
+async def run(process, base_dir: str, media_type: str):
+    path = os.path.join(FILTER_DIRS, base_dir)
+    return await process(
+        Job(media=make_media(media_type), last_stage={"path": path})
+    )
+
+
+async def test_filters_non_season_directories(process):
+    # TV mode: Extras/Commentary rejected, S1 + Season 1 kept, non-media
+    # files rejected (reference test/process/filter_dirs.js:22-41)
+    res = await run(process, "tv_mixed", "TV")
+    assert len(res["files"]) == 2
+    assert res["files"][0] == os.path.join(
+        FILTER_DIRS, "tv_mixed", "S1", "Show S1E1.mkv"
+    )
+    assert res["files"][1] == os.path.join(
+        FILTER_DIRS, "tv_mixed", "Season 1", "Show S1E2.mkv"
+    )
+
+
+async def test_movie_mode_keeps_all_directories(process):
+    # MOVIE mode keeps every directory, but still filters by extension
+    # (reference test/process/filter_dirs.js:43-61)
+    res = await run(process, "movie_all", "MOVIE")
+    names = [os.path.relpath(f, FILTER_DIRS) for f in res["files"]]
+    assert names == [
+        os.path.join("movie_all", "Extras", "Making Of.mp4"),
+        os.path.join("movie_all", "Main Feature", "The Film.mkv"),
+    ]
+
+
+async def test_sole_top_level_dir_always_traversed(process):
+    # TV mode + a single top-level dir with no season-ish name
+    # (reference test/process/filter_dirs.js:63-81)
+    res = await run(process, "top_level", "TV")
+    assert [os.path.basename(f) for f in res["files"]] == ["The Film.mkv"]
+
+
+async def test_no_media_files_raises(process, tmp_path):
+    # (reference lib/process.js:109-111)
+    (tmp_path / "readme.txt").write_text("nope")
+    with pytest.raises(NoMediaFilesError):
+        await process(
+            Job(media=make_media("TV"), last_stage={"path": str(tmp_path)})
+        )
+
+
+async def test_returns_download_path_passthrough(process):
+    res = await run(process, "top_level", "TV")
+    assert res["downloadPath"] == os.path.join(FILTER_DIRS, "top_level")

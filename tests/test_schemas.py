@@ -1,0 +1,63 @@
+"""Schema/wire-format tests.
+
+Parity targets: enum constants the reference emits as raw integers
+(DOWNLOADING=2 at /root/reference/lib/main.js:68, ERRORED=6 at
+lib/main.js:149) and the proto helper surface
+(enumToString/stringToEnum, lib/download.js:243, lib/process.js:53).
+"""
+
+import pytest
+
+from downloader_tpu import schemas
+
+
+def test_telemetry_status_parity_constants():
+    assert schemas.TelemetryStatus.Value("DOWNLOADING") == 2
+    assert schemas.TelemetryStatus.Value("ERRORED") == 6
+
+
+def test_source_type_names_cover_dispatch_table():
+    # the download stage dispatches on the lowercased enum name
+    # (reference lib/download.js:243,256)
+    names = {schemas.SourceType.Name(v).lower() for v in (0, 1, 2, 3)}
+    assert names == {"torrent", "http", "file", "bucket"}
+
+
+def test_enum_helpers_roundtrip():
+    assert schemas.enum_to_string(schemas.MediaType, 1) == "MOVIE"
+    assert schemas.string_to_enum(schemas.MediaType, "TV") == 0
+
+
+def test_download_roundtrip():
+    msg = schemas.Download(
+        media=schemas.Media(
+            id="job-1",
+            creator_id="card-1",
+            name="A Show",
+            type=schemas.MediaType.Value("TV"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri="http://example/file.mkv",
+        ),
+        created_at="2026-07-29T00:00:00Z",
+    )
+    wire = schemas.encode(msg)
+    assert isinstance(wire, bytes)
+    decoded = schemas.decode(schemas.Download, wire)
+    assert decoded.media.id == "job-1"
+    assert decoded.media.source == schemas.SourceType.Value("HTTP")
+    assert decoded == msg
+
+
+def test_convert_roundtrip():
+    msg = schemas.Convert(
+        created_at="2026-07-29T00:00:00Z",
+        media=schemas.Media(id="job-2", source_uri="magnet:?xt=..."),
+    )
+    decoded = schemas.decode(schemas.Convert, schemas.encode(msg))
+    assert decoded.media.id == "job-2"
+
+
+def test_registry_load():
+    assert schemas.load("downloader.Download") is schemas.Download
+    with pytest.raises(KeyError):
+        schemas.load("api.Nope")

@@ -1,0 +1,74 @@
+"""Object-store contract tests, run against both hermetic backends."""
+
+import pytest
+
+from downloader_tpu.store import (
+    FilesystemObjectStore,
+    InMemoryObjectStore,
+    ObjectNotFound,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(params=["memory", "fs"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryObjectStore()
+    return FilesystemObjectStore(str(tmp_path / "objects"))
+
+
+async def test_bucket_lifecycle(store):
+    assert not await store.bucket_exists("b")
+    await store.make_bucket("b")
+    assert await store.bucket_exists("b")
+
+
+async def test_put_get_roundtrip(store):
+    await store.make_bucket("b")
+    await store.put_object("b", "job/original/done", b"true")
+    assert await store.get_object("b", "job/original/done") == b"true"
+
+
+async def test_get_missing_raises(store):
+    with pytest.raises(ObjectNotFound):
+        await store.get_object("nope", "missing")
+    await store.make_bucket("b")
+    with pytest.raises(ObjectNotFound):
+        await store.get_object("b", "missing")
+
+
+async def test_file_roundtrip(store, tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"x" * 1024)
+    await store.make_bucket("b")
+    await store.fput_object("b", "dir/obj", str(src))
+
+    dst = tmp_path / "deep" / "dst.bin"
+    await store.fget_object("b", "dir/obj", str(dst))
+    assert dst.read_bytes() == b"x" * 1024
+
+
+async def test_fget_missing_raises(store, tmp_path):
+    await store.make_bucket("b")
+    with pytest.raises(ObjectNotFound):
+        await store.fget_object("b", "missing", str(tmp_path / "out"))
+
+
+async def test_list_objects_prefix(store):
+    await store.make_bucket("b")
+    await store.put_object("b", "a/1", b"1")
+    await store.put_object("b", "a/2", b"22")
+    await store.put_object("b", "z/3", b"333")
+
+    names = [info.name async for info in store.list_objects("b", "a/")]
+    assert names == ["a/1", "a/2"]
+    sizes = {info.name: info.size async for info in store.list_objects("b")}
+    assert sizes == {"a/1": 1, "a/2": 2, "z/3": 3}
+
+
+async def test_fs_rejects_traversal(tmp_path):
+    store = FilesystemObjectStore(str(tmp_path / "objects"))
+    await store.make_bucket("b")
+    with pytest.raises(ValueError):
+        await store.put_object("b", "../escape", b"x")

@@ -1,0 +1,122 @@
+"""Upload-stage tests: staging layout, idempotency marker, progress band,
+cleanup (reference /root/reference/lib/upload.js)."""
+
+import base64
+import os
+
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
+from downloader_tpu.stages.base import Job, StageContext
+from downloader_tpu.stages.upload import (
+    STAGING_BUCKET,
+    done_marker_name,
+    object_name,
+    stage_factory,
+)
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.utils import EventEmitter
+
+pytestmark = pytest.mark.anyio
+
+
+def test_object_name_is_base64_of_basename():
+    # (reference lib/upload.js:43-44)
+    name = object_name("job-1", "/tmp/dl/Some Movie.mkv")
+    expected = base64.b64encode(b"Some Movie.mkv").decode()
+    assert name == f"job-1/original/{expected}"
+    assert done_marker_name("job-1") == "job-1/original/done"
+
+
+@pytest.fixture
+def broker():
+    return InMemoryBroker()
+
+
+@pytest.fixture
+def store():
+    return InMemoryObjectStore()
+
+
+async def make_upload(store, broker):
+    mq = MemoryQueue(broker)
+    await mq.connect()
+    ctx = StageContext(
+        config={},
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+        telemetry=Telemetry(mq),
+        store=store,
+    )
+    return await stage_factory(ctx)
+
+
+def make_job(tmp_path, names=("a.mkv", "b.mkv")):
+    download_path = tmp_path / "dl"
+    download_path.mkdir(exist_ok=True)
+    files = []
+    for name in names:
+        f = download_path / name
+        f.write_bytes(b"data-" + name.encode())
+        files.append(str(f))
+    return Job(
+        media=schemas.Media(id="job-1"),
+        last_stage={"files": files, "downloadPath": str(download_path)},
+    )
+
+
+async def test_uploads_files_and_done_marker(store, broker, tmp_path):
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path)
+
+    await upload(job)
+
+    assert await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv")
+    ) == b"data-a.mkv"
+    assert await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "b.mkv")
+    ) == b"data-b.mkv"
+    # idempotency marker (reference lib/upload.js:55)
+    assert await store.get_object(STAGING_BUCKET, "job-1/original/done") == b"true"
+
+
+async def test_progress_mapped_to_upper_band(store, broker, tmp_path):
+    upload = await make_upload(store, broker)
+    await upload(make_job(tmp_path, names=("a.mkv", "b.mkv")))
+
+    events = [
+        schemas.decode(schemas.TelemetryProgressEvent, raw)
+        for raw in broker.published(PROGRESS_QUEUE)
+    ]
+    # (reference lib/upload.js:48: (i/n*50)+50)
+    assert [e.percent for e in events] == [75, 100]
+    assert all(e.status == schemas.TelemetryStatus.Value("DOWNLOADING") for e in events)
+
+
+async def test_cleans_download_dir(store, broker, tmp_path):
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path)
+    await upload(job)
+    assert not os.path.exists(job.last_stage["downloadPath"])
+
+
+async def test_missing_file_raises(store, broker, tmp_path):
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path)
+    os.unlink(job.last_stage["files"][0])
+    with pytest.raises(FileNotFoundError):
+        await upload(job)
+
+
+async def test_non_list_files_raises(store, broker, tmp_path):
+    upload = await make_upload(store, broker)
+    job = Job(
+        media=schemas.Media(id="job-1"),
+        last_stage={"files": "not-a-list", "downloadPath": str(tmp_path)},
+    )
+    with pytest.raises(TypeError):
+        await upload(job)

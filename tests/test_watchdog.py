@@ -1,0 +1,66 @@
+"""Stall-watchdog semantics (reference 240 s stall detection,
+/root/reference/lib/download.js:21,90-101)."""
+
+import asyncio
+
+import pytest
+
+from downloader_tpu.utils.watchdog import (
+    STALL_TIMEOUT_SECONDS,
+    DownloadStalledError,
+    StallWatchdog,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def test_parity_timeout_constant():
+    # (reference lib/download.js:21: 240000 ms)
+    assert STALL_TIMEOUT_SECONDS == 240.0
+
+
+def test_error_carries_errdlstall_code():
+    # the orchestrator's drop-vs-retry policy keys on this
+    # (reference lib/main.js:144-146)
+    assert DownloadStalledError().code == "ERRDLSTALL"
+
+
+async def test_stalled_transfer_raises():
+    watchdog = StallWatchdog(timeout=0.05)
+
+    async def never_progresses():
+        await asyncio.sleep(10)
+
+    with pytest.raises(DownloadStalledError):
+        await watchdog.watch(never_progresses())
+
+
+async def test_progressing_transfer_survives_windows():
+    watchdog = StallWatchdog(timeout=0.05)
+
+    async def progresses():
+        for i in range(5):
+            watchdog.feed(i)
+            await asyncio.sleep(0.03)
+        return "done"
+
+    assert await watchdog.watch(progresses()) == "done"
+
+
+async def test_fast_completion_returns_result():
+    watchdog = StallWatchdog(timeout=1.0)
+
+    async def quick():
+        return 42
+
+    assert await watchdog.watch(quick()) == 42
+
+
+async def test_exception_propagates():
+    watchdog = StallWatchdog(timeout=1.0)
+
+    async def boom():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        await watchdog.watch(boom())
