@@ -192,6 +192,11 @@ class MemoryQueue(MessageQueue):
                 async def _run(d: _MemoryDelivery = delivery) -> None:
                     try:
                         await handler(d)
+                    except asyncio.CancelledError:
+                        # cancelled mid-handler (connection close): requeue so
+                        # the at-least-once contract holds
+                        await d.nack(requeue=True)
+                        raise
                     except Exception:
                         # crashed handler: redeliver, like an AMQP channel
                         # close would

@@ -115,23 +115,26 @@ class Orchestrator:
 
         if self.metrics is not None:
             self.metrics.jobs_consumed.inc()
-            self.metrics.jobs_active.inc()
-
-        # set DOWNLOADING status (reference lib/main.js:68)
-        await self.telemetry.emit_status(
-            job_id, schemas.TelemetryStatus.Value("DOWNLOADING")
-        )
 
         job_entry = {"cardId": file_id, "jobId": job_id}
-        self.active_jobs.append(job_entry)
-
         child = self.logger.child(jobId=job_id, fileId=file_id)
+
+        # all bookkeeping after this point is undone in the finally, so a
+        # failure anywhere (even in the status emit) can't leak the gauge or
+        # the active-jobs entry
+        self.active_jobs.append(job_entry)
+        if self.metrics is not None:
+            self.metrics.jobs_active.inc()
         # keyed by the unique job id — the reference keys its EmitterTable by
         # creator/file id (lib/main.js:81), which collides when two jobs from
         # the same creator run concurrently
         emitter = self.emitter_table[job_id] = EventEmitter()
 
         try:
+            # set DOWNLOADING status (reference lib/main.js:68)
+            await self.telemetry.emit_status(
+                job_id, schemas.TelemetryStatus.Value("DOWNLOADING")
+            )
             with self.tracer.span("job", jobId=job_id, fileId=file_id):
                 await self._run_job(msg, delivery, child, emitter)
         finally:

@@ -47,17 +47,16 @@ def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
                        ssl: bool = True):
     """Default factory for the ``bucket`` method's ad-hoc client
     (reference builds a MinIO client inline, lib/download.js:210-215)."""
-    try:
-        from ..store.s3 import S3ObjectStore
-    except ImportError as err:
-        raise NotImplementedError(
-            "bucket:// downloads need the S3 driver "
-            "(downloader_tpu.store.s3) or an injected "
-            "StageContext.bucket_client_factory"
-        ) from err
+    from ..store.s3 import S3ObjectStore
 
-    scheme = "https" if ssl else "http"
-    return S3ObjectStore(f"{scheme}://{endpoint}", access_key, secret_key)
+    if "://" in endpoint:
+        # explicit scheme in the endpoint wins; otherwise default to https
+        # like the reference's hardcoded `useSSL: true` (lib/download.js:212)
+        url = endpoint
+    else:
+        scheme = "https" if ssl else "http"
+        url = f"{scheme}://{endpoint}"
+    return S3ObjectStore(url, access_key, secret_key)
 
 
 def parse_bucket_uri(resource_url: str) -> dict:
@@ -173,22 +172,27 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         client = bucket_client_factory(
             params["endpoint"], params["access_key"], params["secret_key"]
         )
-        sub_folder = params["sub_folder"]
-        prefix = sub_folder.rstrip("/") + "/"
-        total = 0
-        async for item in client.list_objects(params["bucket"], prefix):
-            if not item.name:
-                continue
-            # strip the subFolder prefix from the local path
-            # (reference lib/download.js:223)
-            local = os.path.join(
-                download_path, item.name.replace(sub_folder, "", 1).lstrip("/")
-            )
-            logger.info("bucket fetch", object=item.name, to=local)
-            await client.fget_object(params["bucket"], item.name, local)
-            total += item.size
-        if ctx.metrics is not None:
-            ctx.metrics.bytes_downloaded.labels(protocol="bucket").inc(total)
+        try:
+            sub_folder = params["sub_folder"]
+            prefix = sub_folder.rstrip("/") + "/"
+            total = 0
+            async for item in client.list_objects(params["bucket"], prefix):
+                if not item.name:
+                    continue
+                # strip the subFolder prefix from the local path
+                # (reference lib/download.js:223)
+                local = os.path.join(
+                    download_path, item.name.replace(sub_folder, "", 1).lstrip("/")
+                )
+                logger.info("bucket fetch", object=item.name, to=local)
+                await client.fget_object(params["bucket"], item.name, local)
+                total += item.size
+            if ctx.metrics is not None:
+                ctx.metrics.bytes_downloaded.labels(protocol="bucket").inc(total)
+        finally:
+            closer = getattr(client, "close", None)
+            if closer is not None:
+                await closer()
 
     methods = {"torrent": torrent, "http": http, "file": file, "bucket": bucket}
 

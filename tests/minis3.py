@@ -1,0 +1,185 @@
+"""A minimal in-process S3-compatible server for hermetic driver tests.
+
+Implements the REST slice the S3 driver uses: HEAD/PUT bucket, GET/PUT
+object, ListObjectsV2 with prefix + continuation pagination.  Verifies each
+request's AWS SigV4 signature against the configured credentials by
+recomputing the canonical request from the raw wire data, so the client's
+signing is exercised for real.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+import xml.sax.saxutils as saxutils
+
+from aiohttp import web
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class MiniS3:
+    def __init__(self, access_key: str = "AKIA", secret_key: str = "SECRET",
+                 region: str = "us-east-1", page_size: int = 2):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.page_size = page_size  # small to force pagination in tests
+        self.buckets: dict = {}
+        self.auth_failures: list = []
+        self._runner = None
+        self.port = None
+
+    # -- signature verification ----------------------------------------
+    def _expected_signature(self, request: web.Request, amz_date: str,
+                            payload_hash: str, signed_headers: str) -> str:
+        date_stamp = amz_date[:8]
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(request.query.items())
+        )
+        headers = {
+            name: request.headers.get(name, "")
+            for name in signed_headers.split(";")
+        }
+        canonical_headers = "".join(
+            f"{k}:{headers[k].strip()}\n" for k in sorted(headers)
+        )
+        canonical_request = "\n".join(
+            [
+                request.method,
+                request.raw_path.split("?")[0],
+                canonical_query,
+                canonical_headers,
+                signed_headers,
+                payload_hash,
+            ]
+        )
+        scope = f"{date_stamp}/{self.region}/s3/aws4_request"
+        string_to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical_request.encode()).hexdigest(),
+            ]
+        )
+        key = _hmac(
+            _hmac(
+                _hmac(_hmac(("AWS4" + self.secret_key).encode(), date_stamp),
+                      self.region),
+                "s3",
+            ),
+            "aws4_request",
+        )
+        return hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+    async def _check_auth(self, request: web.Request, body: bytes):
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return web.Response(status=403, text="missing sigv4")
+        parts = dict(
+            p.strip().split("=", 1) for p in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+        )
+        credential = parts.get("Credential", "")
+        if not credential.startswith(self.access_key + "/"):
+            return web.Response(status=403, text="bad access key")
+        claimed_hash = request.headers.get("x-amz-content-sha256", "")
+        if (
+            claimed_hash != "UNSIGNED-PAYLOAD"
+            and hashlib.sha256(body).hexdigest() != claimed_hash
+        ):
+            return web.Response(status=400, text="payload hash mismatch")
+        expected = self._expected_signature(
+            request,
+            request.headers.get("x-amz-date", ""),
+            claimed_hash,
+            parts.get("SignedHeaders", ""),
+        )
+        if parts.get("Signature") != expected:
+            self.auth_failures.append(request.path)
+            return web.Response(status=403, text="signature mismatch")
+        return None
+
+    # -- handlers -------------------------------------------------------
+    async def handle(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        denied = await self._check_auth(request, body)
+        if denied is not None:
+            return denied
+
+        parts = request.path.lstrip("/").split("/", 1)
+        bucket = urllib.parse.unquote(parts[0])
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else None
+
+        if key is None or key == "":
+            return await self._bucket_op(request, bucket)
+        return await self._object_op(request, bucket, key, body)
+
+    async def _bucket_op(self, request, bucket):
+        if request.method == "HEAD":
+            return web.Response(status=200 if bucket in self.buckets else 404)
+        if request.method == "PUT":
+            self.buckets.setdefault(bucket, {})
+            return web.Response(status=200)
+        if request.method == "GET":  # ListObjectsV2
+            if bucket not in self.buckets:
+                return web.Response(status=404, text="NoSuchBucket")
+            prefix = request.query.get("prefix", "")
+            token = request.query.get("continuation-token", "")
+            keys = sorted(
+                k for k in self.buckets[bucket] if k.startswith(prefix)
+            )
+            if token:
+                keys = [k for k in keys if k > token]
+            page, rest = keys[: self.page_size], keys[self.page_size:]
+            contents = "".join(
+                f"<Contents><Key>{saxutils.escape(k)}</Key>"
+                f"<Size>{len(self.buckets[bucket][k])}</Size></Contents>"
+                for k in page
+            )
+            truncated = "true" if rest else "false"
+            next_token = (
+                f"<NextContinuationToken>{saxutils.escape(page[-1])}"
+                "</NextContinuationToken>"
+                if rest
+                else ""
+            )
+            xml = (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"<IsTruncated>{truncated}</IsTruncated>{contents}{next_token}"
+                "</ListBucketResult>"
+            )
+            return web.Response(body=xml.encode(), content_type="application/xml")
+        return web.Response(status=405)
+
+    async def _object_op(self, request, bucket, key, body):
+        if request.method == "PUT":
+            self.buckets.setdefault(bucket, {})[key] = body
+            return web.Response(status=200)
+        if request.method in ("GET", "HEAD"):
+            data = self.buckets.get(bucket, {}).get(key)
+            if data is None:
+                return web.Response(status=404, text="NoSuchKey")
+            return web.Response(body=data if request.method == "GET" else b"")
+        return web.Response(status=405)
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
