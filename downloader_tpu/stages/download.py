@@ -49,14 +49,9 @@ def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
     (reference builds a MinIO client inline, lib/download.js:210-215)."""
     from ..store.s3 import S3ObjectStore
 
-    if "://" in endpoint:
-        # explicit scheme in the endpoint wins; otherwise default to https
-        # like the reference's hardcoded `useSSL: true` (lib/download.js:212)
-        url = endpoint
-    else:
-        scheme = "https" if ssl else "http"
-        url = f"{scheme}://{endpoint}"
-    return S3ObjectStore(url, access_key, secret_key)
+    # default-https matches the reference's hardcoded `useSSL: true`
+    # (lib/download.js:212); an explicit scheme in the endpoint wins
+    return S3ObjectStore.from_endpoint(endpoint, access_key, secret_key, ssl=ssl)
 
 
 def parse_bucket_uri(resource_url: str) -> dict:
@@ -180,10 +175,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if not item.name:
                     continue
                 # strip the subFolder prefix from the local path
-                # (reference lib/download.js:223)
-                local = os.path.join(
-                    download_path, item.name.replace(sub_folder, "", 1).lstrip("/")
-                )
+                # (reference lib/download.js:223); object keys are untrusted
+                # remote data, so drop dot segments that would escape
+                # download_path (S3 keys may legally contain '..')
+                relative = item.name.replace(sub_folder, "", 1)
+                parts = [
+                    p for p in relative.split("/") if p not in ("", ".", "..")
+                ]
+                if not parts:
+                    continue
+                local = os.path.join(download_path, *parts)
                 logger.info("bucket fetch", object=item.name, to=local)
                 await client.fget_object(params["bucket"], item.name, local)
                 total += item.size

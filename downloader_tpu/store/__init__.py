@@ -40,14 +40,11 @@ def new_client(config) -> ObjectStore:
     if backend == "s3":
         from .s3 import S3ObjectStore
 
-        endpoint = minio_cfg.get("endpoint", "localhost:9000")
-        if "://" not in endpoint:
-            scheme = "https" if minio_cfg.get("ssl", False) else "http"
-            endpoint = f"{scheme}://{endpoint}"
-        return S3ObjectStore(
-            endpoint,
+        return S3ObjectStore.from_endpoint(
+            minio_cfg.get("endpoint", "localhost:9000"),
             minio_cfg.get("access_key", ""),
             minio_cfg.get("secret_key", ""),
-            minio_cfg.get("region", "us-east-1"),
+            ssl=minio_cfg.get("ssl", False),
+            region=minio_cfg.get("region", "us-east-1"),
         )
     raise ValueError(f"unknown object-store backend {backend!r}")

@@ -123,6 +123,22 @@ class SigV4Signer:
 class S3ObjectStore(ObjectStore):
     """Path-style S3 client: ``<endpoint>/<bucket>/<key>``."""
 
+    @classmethod
+    def from_endpoint(
+        cls,
+        endpoint: str,
+        access_key: str = "",
+        secret_key: str = "",
+        ssl: bool = True,
+        region: str = "us-east-1",
+    ) -> "S3ObjectStore":
+        """Build from a host[:port] or full URL; an explicit scheme wins,
+        otherwise ``ssl`` picks https/http."""
+        if "://" not in endpoint:
+            scheme = "https" if ssl else "http"
+            endpoint = f"{scheme}://{endpoint}"
+        return cls(endpoint, access_key, secret_key, region)
+
     def __init__(
         self,
         endpoint: str,
@@ -268,13 +284,3 @@ class S3ObjectStore(ObjectStore):
             token = root.findtext(f"{ns}NextContinuationToken")
             if not truncated or not token:
                 break
-
-
-def _write_file(path: str, data: bytes) -> None:
-    with open(path, "wb") as fh:
-        fh.write(data)
-
-
-def _read_file(path: str) -> bytes:
-    with open(path, "rb") as fh:
-        return fh.read()

@@ -1,0 +1,32 @@
+"""Pure-asyncio BitTorrent client.
+
+Capability-equivalent to the reference's use of webtorrent
+(/root/reference/lib/download.js:9,19,43-123): download a torrent given a
+magnet link, a ``.torrent`` URL, or a local ``.torrent`` file, into a target
+directory, with progress reporting and the 240 s metadata/stall watchdog
+semantics the reference builds around it.
+
+Scope (documented, gated): HTTP(S) trackers and the BitTorrent peer wire
+protocol with the ut_metadata extension (BEP 3/9/10, compact peers BEP 23).
+UDP trackers, DHT, and PEX are not implemented — magnet links therefore need
+at least one ``tr=`` HTTP tracker.  The package also includes a
+:class:`Seeder` (webtorrent seeds as well as leeches), which doubles as the
+hermetic swarm for tests.
+"""
+
+from .bencode import bdecode, bencode
+from .client import TorrentClient
+from .magnet import MagnetLink, parse_magnet
+from .metainfo import Metainfo, make_metainfo
+from .seeder import Seeder
+
+__all__ = [
+    "bdecode",
+    "bencode",
+    "TorrentClient",
+    "MagnetLink",
+    "parse_magnet",
+    "Metainfo",
+    "make_metainfo",
+    "Seeder",
+]

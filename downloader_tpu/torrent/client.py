@@ -1,0 +1,419 @@
+"""The leeching client: magnet/.torrent -> files on disk.
+
+Behavioral parity with the reference's webtorrent wrapper
+(/root/reference/lib/download.js:43-123):
+
+- accepts magnet URIs, ``.torrent`` URLs, and local ``.torrent`` paths
+  (the http method chains ``.torrent`` URLs here, lib/download.js:144-155)
+- 240 s metadata timeout -> ``Metadata fetch stalled``
+  (lib/download.js:47-50)
+- 240 s no-progress watchdog -> error with ``code == 'ERRDLSTALL'``
+  (lib/download.js:90-101)
+- progress callback on a 30 s cadence (lib/download.js:78-88)
+- resumes from pieces already on disk (webtorrent reuses ``downloadPath``
+  contents; SURVEY.md §5 "checkpoint/resume")
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+import struct
+from typing import Awaitable, Callable, List, Optional, Set
+
+import aiohttp
+
+from ..utils.watchdog import (
+    DownloadStalledError,
+    MetadataTimeoutError,
+    StallWatchdog,
+)
+from . import tracker as tracker_mod
+from . import wire
+from .magnet import parse_magnet
+from .metainfo import BLOCK_SIZE, Metainfo, parse_info_dict, parse_torrent_bytes
+from .storage import TorrentStorage
+
+ProgressCb = Callable[[float], Awaitable[None]]
+
+CONNECT_TIMEOUT = 10.0
+PIPELINE_DEPTH = 16
+MAX_PEERS = 8
+
+
+class TorrentError(RuntimeError):
+    pass
+
+
+class _Swarm:
+    """Shared download state across peer workers."""
+
+    def __init__(self, meta: Metainfo):
+        self.meta = meta
+        self.pending: Set[int] = set(range(meta.num_pieces))
+        self.claimed: Set[int] = set()
+        self.done: Set[int] = set()
+        self.bytes_done = 0
+        self.piece_event = asyncio.Event()
+
+    @property
+    def complete(self) -> bool:
+        return len(self.done) == self.meta.num_pieces
+
+    def claim(self, have: Set[int]) -> Optional[int]:
+        candidates = self.pending & have
+        if not candidates:
+            return None
+        piece = min(candidates)  # sequential-ish: good for media files
+        self.pending.discard(piece)
+        self.claimed.add(piece)
+        return piece
+
+    def release(self, piece: int) -> None:
+        self.claimed.discard(piece)
+        self.pending.add(piece)
+
+    def finish(self, piece: int) -> None:
+        self.claimed.discard(piece)
+        self.done.add(piece)
+        self.bytes_done += self.meta.piece_size(piece)
+        self.piece_event.set()
+
+
+class TorrentClient:
+    def __init__(self, logger=None, peer_id: Optional[bytes] = None):
+        self.logger = logger
+        self.peer_id = peer_id or (
+            b"-DT0001-" + bytes(random.randrange(48, 58) for _ in range(12))
+        )
+
+    # ------------------------------------------------------------------
+    async def download(
+        self,
+        uri: str,
+        download_path: str,
+        *,
+        metadata_timeout: float = 240.0,
+        stall_timeout: float = 240.0,
+        progress_interval: float = 30.0,
+        on_progress: Optional[ProgressCb] = None,
+        peers: Optional[List[tracker_mod.Peer]] = None,
+    ) -> Metainfo:
+        """Fetch the torrent behind ``uri`` into ``download_path``."""
+        meta, peers = await self._resolve(uri, peers, metadata_timeout)
+        self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
+
+        storage = TorrentStorage(meta, download_path)
+        await asyncio.to_thread(storage.preallocate)
+        swarm = _Swarm(meta)
+        await self._resume_from_disk(storage, swarm)
+
+        if swarm.complete:
+            self._log("all pieces already on disk")
+            if on_progress is not None:
+                await on_progress(1.0)
+            return meta
+
+        if not peers:
+            raise TorrentError("no peers available")
+
+        watchdog = StallWatchdog(stall_timeout)
+        watchdog.feed(swarm.bytes_done)
+
+        async def _run() -> None:
+            reporter = asyncio.create_task(
+                self._report_progress(swarm, watchdog, progress_interval, on_progress)
+            )
+            workers = [
+                asyncio.create_task(self._peer_worker(addr, storage, swarm))
+                for addr in peers[:MAX_PEERS]
+            ]
+            try:
+                while not swarm.complete:
+                    if all(w.done() for w in workers):
+                        raise TorrentError(
+                            "all peer connections failed with pieces remaining"
+                        )
+                    try:
+                        async with asyncio.timeout(0.5):
+                            await swarm.piece_event.wait()
+                    except TimeoutError:
+                        pass
+                    swarm.piece_event.clear()
+            finally:
+                reporter.cancel()
+                for w in workers:
+                    w.cancel()
+                await asyncio.gather(reporter, *workers, return_exceptions=True)
+
+        await watchdog.watch(_run())
+
+        if on_progress is not None:
+            await on_progress(1.0)
+        return meta
+
+    # ------------------------------------------------------------------
+    async def _resolve(self, uri: str, peers, metadata_timeout: float):
+        """uri -> (Metainfo, peers)."""
+        if uri.startswith("magnet:"):
+            magnet = parse_magnet(uri)
+            if peers is None:
+                peers = await self._announce_all(
+                    magnet.trackers, magnet.info_hash, left=1
+                )
+            if not peers:
+                raise TorrentError(
+                    "magnet link needs reachable peers (HTTP trackers only; "
+                    "no DHT support)"
+                )
+            try:
+                async with asyncio.timeout(metadata_timeout):
+                    meta = await self._fetch_metadata(magnet, peers)
+            except TimeoutError:
+                raise MetadataTimeoutError("Metadata fetch stalled") from None
+            return meta, peers
+
+        if uri.startswith(("http://", "https://")):
+            async with aiohttp.ClientSession() as session:
+                async with session.get(uri) as resp:
+                    resp.raise_for_status()
+                    data = await resp.read()
+            meta = parse_torrent_bytes(data)
+        else:
+            path = uri[len("file://"):] if uri.startswith("file://") else uri
+            with open(path, "rb") as fh:
+                meta = parse_torrent_bytes(fh.read())
+
+        if peers is None:
+            peers = await self._announce_all(
+                meta.trackers, meta.info_hash, left=meta.total_length
+            )
+        return meta, peers
+
+    async def _announce_all(self, trackers: List[str], info_hash: bytes,
+                            left: int) -> List[tracker_mod.Peer]:
+        seen = set()
+        out: List[tracker_mod.Peer] = []
+        for url in trackers:
+            try:
+                found = await tracker_mod.announce(
+                    url, info_hash, self.peer_id, port=6881, left=left
+                )
+            except Exception as err:
+                self._log("tracker announce failed", tracker=url, error=str(err))
+                continue
+            for peer in found:
+                if (peer.host, peer.port) not in seen:
+                    seen.add((peer.host, peer.port))
+                    out.append(peer)
+        return out
+
+    # -- metadata over ut_metadata (BEP 9) ------------------------------
+    async def _fetch_metadata(self, magnet, peers) -> Metainfo:
+        last_error: Optional[Exception] = None
+        for peer_addr in peers:
+            try:
+                return await self._fetch_metadata_from(magnet, peer_addr)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError,
+                    wire.WireError) as err:
+                last_error = err
+                continue
+        raise TorrentError(f"metadata fetch failed from all peers: {last_error}")
+
+    async def _fetch_metadata_from(self, magnet, peer_addr) -> Metainfo:
+        peer = await self._connect(peer_addr, magnet.info_hash)
+        try:
+            # wait for their extended handshake
+            while peer.peer_metadata_size is None:
+                msg_id, payload = await peer.recv_message()
+                if msg_id == wire.MSG_EXTENDED and payload[0] == wire.EXT_HANDSHAKE_ID:
+                    peer.handle_ext_handshake(payload[1:])
+            total = peer.peer_metadata_size
+            num_pieces = (total + wire.METADATA_PIECE_SIZE - 1) // wire.METADATA_PIECE_SIZE
+            chunks: dict = {}
+            for i in range(num_pieces):
+                await peer.send_metadata_request(i)
+            while len(chunks) < num_pieces:
+                msg_id, payload = await peer.recv_message()
+                if msg_id != wire.MSG_EXTENDED or payload[0] == wire.EXT_HANDSHAKE_ID:
+                    continue
+                from .bencode import bdecode_prefix
+
+                header, consumed = bdecode_prefix(payload[1:])
+                if header.get(b"msg_type") == wire.MD_DATA:
+                    chunks[header[b"piece"]] = payload[1 + consumed:]
+                elif header.get(b"msg_type") == wire.MD_REJECT:
+                    raise wire.WireError("peer rejected metadata request")
+            info_bytes = b"".join(chunks[i] for i in range(num_pieces))[:total]
+            if hashlib.sha1(info_bytes).digest() != magnet.info_hash:
+                raise wire.WireError("metadata hash mismatch")
+            return parse_info_dict(info_bytes, magnet.trackers)
+        finally:
+            await peer.close()
+
+    # -- resume ---------------------------------------------------------
+    async def _resume_from_disk(self, storage: TorrentStorage, swarm: _Swarm) -> None:
+        meta = swarm.meta
+
+        def _scan() -> list:
+            # runs in a worker thread: hashing a multi-GB torrent must not
+            # block the event loop
+            intact = []
+            for index in range(meta.num_pieces):
+                data = storage.read_piece(index)
+                if hashlib.sha1(data).digest() == meta.piece_hashes[index]:
+                    intact.append(index)
+            return intact
+
+        for index in await asyncio.to_thread(_scan):
+            swarm.pending.discard(index)
+            swarm.done.add(index)
+            swarm.bytes_done += meta.piece_size(index)
+        if swarm.done:
+            self._log("resumed pieces from disk", count=len(swarm.done))
+
+    # -- progress -------------------------------------------------------
+    async def _report_progress(self, swarm: _Swarm, watchdog: StallWatchdog,
+                               interval: float, on_progress: Optional[ProgressCb]):
+        total = swarm.meta.total_length or 1
+        while True:
+            await asyncio.sleep(interval)
+            watchdog.feed(swarm.bytes_done)
+            if on_progress is not None:
+                await on_progress(swarm.bytes_done / total)
+
+    # -- peer plumbing ---------------------------------------------------
+    async def _connect(self, peer_addr, info_hash: bytes) -> wire.PeerWire:
+        async with asyncio.timeout(CONNECT_TIMEOUT):
+            reader, writer = await asyncio.open_connection(
+                peer_addr.host, peer_addr.port
+            )
+        peer = wire.PeerWire(reader, writer)
+        try:
+            await peer.send_handshake(info_hash, self.peer_id)
+            handshake = await peer.recv_handshake()
+            if handshake.info_hash != info_hash:
+                raise wire.WireError("infohash mismatch in handshake")
+            if handshake.supports_extensions:
+                await peer.send_ext_handshake()
+            return peer
+        except BaseException:
+            # close on ANY failure (including cancellation from the caller's
+            # metadata timeout) — a leaked open connection keeps the remote
+            # peer's transport alive indefinitely
+            await peer.close()
+            raise
+
+    async def _peer_worker(self, peer_addr, storage: TorrentStorage,
+                           swarm: _Swarm) -> None:
+        meta = swarm.meta
+        claimed: Optional[int] = None
+        try:
+            peer = await self._connect(peer_addr, meta.info_hash)
+        except Exception as err:
+            self._log("peer connect failed", peer=str(peer_addr), error=str(err))
+            return
+        have: Set[int] = set()
+        choked = True
+        interested_sent = False
+
+        # per-piece assembly state
+        buffer: Optional[bytearray] = None
+        received: Set[int] = set()
+        requested: Set[int] = set()
+
+        def _blocks(piece: int) -> List[int]:
+            return list(range(0, meta.piece_size(piece), BLOCK_SIZE))
+
+        async def _pump_requests() -> None:
+            nonlocal claimed, buffer, received, requested
+            if choked:
+                return
+            if claimed is None:
+                piece = swarm.claim(have)
+                if piece is None:
+                    return
+                claimed = piece
+                buffer = bytearray(meta.piece_size(piece))
+                received = set()
+                requested = set()
+            outstanding = requested - received
+            for begin in _blocks(claimed):
+                if len(outstanding) >= PIPELINE_DEPTH:
+                    break
+                if begin in requested:
+                    continue
+                length = min(BLOCK_SIZE, meta.piece_size(claimed) - begin)
+                await peer.send_request(claimed, begin, length)
+                requested.add(begin)
+                outstanding.add(begin)
+
+        try:
+            while not swarm.complete:
+                try:
+                    # bounded recv so an idle (unchoked but messageless)
+                    # connection still re-pumps requests — e.g. to pick up a
+                    # piece another worker released
+                    async with asyncio.timeout(5.0):
+                        msg_id, payload = await peer.recv_message()
+                except TimeoutError:
+                    await _pump_requests()
+                    continue
+                if msg_id is None:
+                    continue
+                if msg_id == wire.MSG_BITFIELD:
+                    have |= wire.parse_bitfield(payload, meta.num_pieces)
+                    if not interested_sent:
+                        await peer.send_message(wire.MSG_INTERESTED)
+                        interested_sent = True
+                elif msg_id == wire.MSG_HAVE:
+                    (index,) = struct.unpack(">I", payload)
+                    have.add(index)
+                    if not interested_sent:
+                        await peer.send_message(wire.MSG_INTERESTED)
+                        interested_sent = True
+                elif msg_id == wire.MSG_UNCHOKE:
+                    choked = False
+                    await _pump_requests()
+                elif msg_id == wire.MSG_CHOKE:
+                    choked = True
+                    # BEP 3: a choke discards the peer's request queue, so
+                    # undelivered requests must be re-sent after unchoke
+                    requested &= received
+                elif msg_id == wire.MSG_EXTENDED:
+                    if payload[0] == wire.EXT_HANDSHAKE_ID:
+                        peer.handle_ext_handshake(payload[1:])
+                elif msg_id == wire.MSG_PIECE:
+                    index, begin = struct.unpack(">II", payload[:8])
+                    data = payload[8:]
+                    if index != claimed or buffer is None:
+                        continue
+                    buffer[begin:begin + len(data)] = data
+                    received.add(begin)
+                    if received == set(_blocks(claimed)):
+                        piece_bytes = bytes(buffer)
+                        digest = hashlib.sha1(piece_bytes).digest()
+                        if digest == meta.piece_hashes[claimed]:
+                            storage.write_piece(claimed, piece_bytes)
+                            swarm.finish(claimed)
+                        else:
+                            self._log("piece hash mismatch", piece=claimed)
+                            swarm.release(claimed)
+                        claimed = None
+                        buffer = None
+                    await _pump_requests()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                wire.WireError, struct.error, IndexError, ValueError) as err:
+            # struct/Index/Value errors come from malformed frames — these
+            # are untrusted wire bytes, so treat them like a dead peer
+            self._log("peer connection lost", peer=str(peer_addr), error=str(err))
+        finally:
+            if claimed is not None:
+                swarm.release(claimed)
+            await peer.close()
+
+    def _log(self, msg: str, **extra) -> None:
+        if self.logger is not None:
+            self.logger.info(msg, **extra)

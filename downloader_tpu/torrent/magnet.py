@@ -1,0 +1,57 @@
+"""Magnet URI parsing (BEP 9 §magnet): ``magnet:?xt=urn:btih:<hash>&dn=...&tr=...``."""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import urllib.parse
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MagnetLink:
+    info_hash: bytes            # 20-byte SHA-1
+    display_name: Optional[str]
+    trackers: List[str]
+
+    @property
+    def info_hash_hex(self) -> str:
+        return self.info_hash.hex()
+
+
+def parse_magnet(uri: str) -> MagnetLink:
+    parsed = urllib.parse.urlparse(uri)
+    if parsed.scheme != "magnet":
+        raise ValueError(f"not a magnet URI: {uri[:40]!r}")
+    params = urllib.parse.parse_qs(parsed.query)
+
+    info_hash: Optional[bytes] = None
+    for xt in params.get("xt", []):
+        if xt.startswith("urn:btih:"):
+            raw = xt[len("urn:btih:"):]
+            if len(raw) == 40:  # hex
+                info_hash = bytes.fromhex(raw)
+            elif len(raw) == 32:  # base32
+                info_hash = base64.b32decode(raw.upper())
+            else:
+                raise ValueError(f"bad btih length {len(raw)}")
+            break
+    if info_hash is None:
+        raise ValueError("magnet URI has no urn:btih exact topic")
+
+    names = params.get("dn", [])
+    return MagnetLink(
+        info_hash=info_hash,
+        display_name=names[0] if names else None,
+        trackers=params.get("tr", []),
+    )
+
+
+def make_magnet(info_hash: bytes, name: Optional[str] = None,
+                trackers: Optional[List[str]] = None) -> str:
+    parts = [f"xt=urn:btih:{info_hash.hex()}"]
+    if name:
+        parts.append("dn=" + urllib.parse.quote(name, safe=""))
+    for tracker in trackers or []:
+        parts.append("tr=" + urllib.parse.quote(tracker, safe=""))
+    return "magnet:?" + "&".join(parts)

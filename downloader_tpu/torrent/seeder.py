@@ -1,0 +1,112 @@
+"""A seeding peer: serves pieces and ut_metadata over the wire protocol.
+
+webtorrent both leeches and seeds (/root/reference/lib/download.js:19 keeps
+one long-lived client); this is the seeding half, and doubles as the hermetic
+swarm for tests (no network egress needed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from typing import Optional, Set
+
+from . import wire
+from .metainfo import Metainfo
+from .storage import TorrentStorage
+
+
+class Seeder:
+    """Serves one torrent's pieces from ``root`` on a local TCP port."""
+
+    def __init__(self, meta: Metainfo, root: str, peer_id: Optional[bytes] = None):
+        self.meta = meta
+        self.storage = TorrentStorage(meta, root)
+        self.peer_id = peer_id or (b"-DT0001-" + os.urandom(6).hex().encode())
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.port: Optional[int] = None
+        self.connections: int = 0
+        self._conn_tasks: Set[asyncio.Task] = set()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_connect, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for task in list(self._conn_tasks):
+            task.cancel()
+        for task in list(self._conn_tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _on_connect(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        peer = wire.PeerWire(reader, writer)
+        try:
+            handshake = await peer.recv_handshake()
+            if handshake.info_hash != self.meta.info_hash:
+                await peer.close()
+                return
+            self.connections += 1
+            await peer.send_handshake(self.meta.info_hash, self.peer_id)
+            if handshake.supports_extensions:
+                await peer.send_ext_handshake(
+                    metadata_size=len(self.meta.info_bytes)
+                )
+            await peer.send_bitfield(
+                wire.build_bitfield(range(self.meta.num_pieces), self.meta.num_pieces)
+            )
+            await self._serve(peer)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await peer.close()
+
+    async def _serve(self, peer: wire.PeerWire) -> None:
+        while True:
+            msg_id, payload = await peer.recv_message()
+            if msg_id is None:
+                continue
+            if msg_id == wire.MSG_INTERESTED:
+                await peer.send_message(wire.MSG_UNCHOKE)
+            elif msg_id == wire.MSG_REQUEST:
+                index, begin, length = struct.unpack(">III", payload)
+                if index >= self.meta.num_pieces or length > (1 << 17):
+                    raise wire.WireError("bad request")
+                data = self.storage.read(
+                    index * self.meta.piece_length + begin, length
+                )
+                await peer.send_piece(index, begin, data)
+            elif msg_id == wire.MSG_EXTENDED:
+                await self._serve_extended(peer, payload)
+            # choke/have/bitfield/cancel from a leech need no reply here
+
+    async def _serve_extended(self, peer: wire.PeerWire, payload: bytes) -> None:
+        ext_id, body = payload[0], payload[1:]
+        if ext_id == wire.EXT_HANDSHAKE_ID:
+            peer.handle_ext_handshake(body)
+            return
+        # ut_metadata request addressed to the id we advertised
+        from .bencode import bdecode_prefix
+
+        header, _consumed = bdecode_prefix(body)
+        if header.get(b"msg_type") == wire.MD_REQUEST:
+            piece = header[b"piece"]
+            total = len(self.meta.info_bytes)
+            start = piece * wire.METADATA_PIECE_SIZE
+            if start >= total:
+                await peer.send_metadata_reject(piece)
+                return
+            chunk = self.meta.info_bytes[start:start + wire.METADATA_PIECE_SIZE]
+            await peer.send_metadata_data(piece, total, chunk)

@@ -1,0 +1,71 @@
+"""Piece <-> file mapping: read/write the torrent's linear byte stream across
+its (possibly many) files on disk."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from .metainfo import Metainfo
+
+
+class TorrentStorage:
+    """Maps absolute stream offsets onto files under ``root``.
+
+    The reference hands webtorrent a download directory and lets it lay the
+    torrent's files out inside it (/root/reference/lib/download.js:64-66);
+    this does the same: ``<root>/<file.path>``.
+    """
+
+    def __init__(self, meta: Metainfo, root: str):
+        self.meta = meta
+        self.root = os.path.abspath(root)
+
+    def file_path(self, entry_path: str) -> str:
+        parts = [p for p in entry_path.split("/") if p not in ("", ".", "..")]
+        return os.path.join(self.root, *parts)
+
+    def _ranges(self, offset: int, length: int) -> List[Tuple[str, int, int, int]]:
+        """(path, file_offset, stream_start, chunk_len) per touched file."""
+        out = []
+        end = offset + length
+        for entry in self.meta.files:
+            file_start = entry.offset
+            file_end = entry.offset + entry.length
+            lo = max(offset, file_start)
+            hi = min(end, file_end)
+            if lo < hi:
+                out.append(
+                    (self.file_path(entry.path), lo - file_start, lo - offset, hi - lo)
+                )
+        return out
+
+    def preallocate(self) -> None:
+        for entry in self.meta.files:
+            path = self.file_path(entry.path)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            if not os.path.exists(path) or os.path.getsize(path) != entry.length:
+                with open(path, "wb") as fh:
+                    fh.truncate(entry.length)
+
+    def write(self, offset: int, data: bytes) -> None:
+        for path, file_off, rel, length in self._ranges(offset, len(data)):
+            with open(path, "r+b") as fh:
+                fh.seek(file_off)
+                fh.write(data[rel:rel + length])
+
+    def read(self, offset: int, length: int) -> bytes:
+        out = bytearray(length)
+        for path, file_off, rel, chunk_len in self._ranges(offset, length):
+            with open(path, "rb") as fh:
+                fh.seek(file_off)
+                out[rel:rel + chunk_len] = fh.read(chunk_len)
+        return bytes(out)
+
+    def read_piece(self, index: int) -> bytes:
+        return self.read(
+            index * self.meta.piece_length, self.meta.piece_size(index)
+        )
+
+    def write_piece(self, index: int, data: bytes) -> None:
+        self.write(index * self.meta.piece_length, data)

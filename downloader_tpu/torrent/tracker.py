@@ -1,0 +1,84 @@
+"""HTTP tracker announce (BEP 3) with compact peer lists (BEP 23)."""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import urllib.parse
+from typing import List
+
+import aiohttp
+import yarl
+
+from .bencode import bdecode
+
+
+@dataclasses.dataclass(frozen=True)
+class Peer:
+    host: str
+    port: int
+
+
+class TrackerError(RuntimeError):
+    pass
+
+
+async def announce(
+    tracker_url: str,
+    info_hash: bytes,
+    peer_id: bytes,
+    port: int,
+    uploaded: int = 0,
+    downloaded: int = 0,
+    left: int = 0,
+    event: str = "started",
+    session: aiohttp.ClientSession | None = None,
+) -> List[Peer]:
+    """Announce to an HTTP tracker and return its peer list."""
+    query = urllib.parse.urlencode(
+        {
+            "info_hash": info_hash,
+            "peer_id": peer_id,
+            "port": port,
+            "uploaded": uploaded,
+            "downloaded": downloaded,
+            "left": left,
+            "compact": 1,
+            "event": event,
+        },
+        quote_via=urllib.parse.quote,
+    )
+    sep = "&" if "?" in tracker_url else "?"
+    url = f"{tracker_url}{sep}{query}"
+
+    owned = session is None
+    session = session or aiohttp.ClientSession()
+    try:
+        # pre-encoded: the percent-encoded binary info_hash must reach the
+        # wire untouched (yarl would otherwise re-quote it)
+        async with session.get(yarl.URL(url, encoded=True)) as resp:
+            if resp.status != 200:
+                raise TrackerError(f"tracker answered {resp.status}")
+            body = await resp.read()
+    finally:
+        if owned:
+            await session.close()
+
+    data = bdecode(body)
+    if b"failure reason" in data:
+        raise TrackerError(data[b"failure reason"].decode("utf-8", "replace"))
+
+    peers = data.get(b"peers", b"")
+    out: List[Peer] = []
+    if isinstance(peers, bytes):  # compact: 6 bytes per peer
+        for i in range(0, len(peers) - len(peers) % 6, 6):
+            host = socket.inet_ntoa(peers[i:i + 4])
+            (peer_port,) = struct.unpack(">H", peers[i + 4:i + 6])
+            out.append(Peer(host, peer_port))
+    else:  # non-compact dict form
+        for entry in peers:
+            out.append(
+                Peer(entry[b"ip"].decode(), entry[b"port"])
+            )
+    return out

@@ -1,0 +1,58 @@
+"""A minimal in-process HTTP BitTorrent tracker for hermetic swarm tests.
+
+Serves a fixed peer list as a compact (BEP 23) announce response.  Parses
+the raw query string itself because ``info_hash``/``peer_id`` are
+percent-encoded *binary*, not utf-8.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import urllib.parse
+from typing import List, Tuple
+
+from aiohttp import web
+
+from downloader_tpu.torrent.bencode import bencode
+
+
+class MiniTracker:
+    def __init__(self, peers: List[Tuple[str, int]]):
+        self.peers = list(peers)
+        self.announces: list = []
+        self._runner = None
+        self.port = None
+
+    async def handle(self, request: web.Request) -> web.Response:
+        raw: dict = {}
+        for pair in request.rel_url.raw_query_string.split("&"):
+            if "=" in pair:
+                key, value = pair.split("=", 1)
+                raw[key] = urllib.parse.unquote_to_bytes(value)
+        self.announces.append(raw)
+        if len(raw.get("info_hash", b"")) != 20:
+            return web.Response(
+                body=bencode({b"failure reason": b"bad info_hash length"})
+            )
+        compact = b"".join(
+            socket.inet_aton(host) + struct.pack(">H", port)
+            for host, port in self.peers
+        )
+        return web.Response(
+            body=bencode({b"interval": 60, b"peers": compact})
+        )
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_get("/announce", self.handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}/announce"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

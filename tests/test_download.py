@@ -156,6 +156,33 @@ async def test_bucket_download_strips_subfolder(tmp_path, broker):
     assert not os.path.exists(os.path.join(root, "ep3.mkv"))
 
 
+async def test_bucket_download_rejects_traversal_keys(tmp_path, broker):
+    """Object keys are untrusted remote data; '..' segments must not
+    escape the download directory."""
+    remote = InMemoryObjectStore()
+    await remote.make_bucket("media")
+    await remote.put_object("media", "show/../../evil.mkv", b"evil")
+    await remote.put_object("media", "show/ok.mkv", b"ok")
+
+    stage = await make_stage(
+        tmp_path, broker, bucket_client_factory=lambda *a, **k: remote
+    )
+    uri = "bucket://minio.example:9000,media,AKIA,SECRET,show"
+    result = await stage(make_job("BUCKET", uri, media_id="trav"))
+
+    root = result["path"]
+    with open(os.path.join(root, "ok.mkv"), "rb") as fh:
+        assert fh.read() == b"ok"
+    # nothing escaped above the per-job download dir
+    assert not os.path.exists(str(tmp_path / "evil.mkv"))
+    assert not os.path.exists(str(tmp_path / "downloads" / "evil.mkv"))
+    # the traversal key was either skipped or flattened inside the job dir
+    for dirpath, _dirs, files in os.walk(str(tmp_path)):
+        for f in files:
+            if f == "evil.mkv":
+                assert dirpath.startswith(root)
+
+
 def test_parse_bucket_uri():
     parsed = parse_bucket_uri("bucket://e:9000,b,ak,sk,folder/")
     assert parsed == {

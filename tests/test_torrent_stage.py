@@ -1,0 +1,150 @@
+"""End-to-end: a magnet-link Download job through the full pipeline
+(download stage's torrent method -> filter -> staging upload), hermetic
+swarm (reference flow: lib/main.js + lib/download.js torrent method)."""
+
+import os
+
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.torrent import Seeder, make_metainfo
+from downloader_tpu.torrent.magnet import make_magnet
+
+from minitracker import MiniTracker
+from test_torrent import make_payload_dir
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_magnet_job_end_to_end(tmp_path):
+    # seed a two-episode season behind a live seeder + tracker
+    src, files = make_payload_dir(tmp_path, [120_000, 60_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent))
+    port = await seeder.start()
+    tracker = MiniTracker([("127.0.0.1", port)])
+    tracker_url = await tracker.start()
+    magnet = make_magnet(meta.info_hash, meta.name, [tracker_url])
+
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode(
+        {"instance": {"download_path": str(tmp_path / "downloads")}}
+    )
+    mq = MemoryQueue(broker)
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config,
+        mq=mq,
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+
+    msg = schemas.Download(
+        media=schemas.Media(
+            id="magnet-job",
+            creator_id="card-m",
+            name="Great Show",
+            type=schemas.MediaType.Value("TV"),
+            source=schemas.SourceType.Value("TORRENT"),
+            source_uri=magnet,
+        )
+    )
+    broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+    await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+
+    # every episode staged under its base64 name; torrent dir layout was
+    # <name>/S1/epN.mkv and the filter kept the S1 season dir
+    for name, data in files.items():
+        base = os.path.basename(name)
+        staged = await store.get_object(
+            STAGING_BUCKET, object_name("magnet-job", base)
+        )
+        assert staged == data
+    assert (
+        await store.get_object(STAGING_BUCKET, "magnet-job/original/done")
+        == b"true"
+    )
+    assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+
+    # progress telemetry: 0 at start, 50 after download, 50-100 for upload
+    events = [
+        schemas.decode(schemas.TelemetryProgressEvent, raw).percent
+        for raw in broker.published(PROGRESS_QUEUE)
+    ]
+    assert events[0] == 0
+    assert 50 in events
+    assert events[-1] == 100
+
+    await orchestrator.shutdown(grace_seconds=2)
+    await seeder.stop()
+    await tracker.stop()
+
+
+async def test_dot_torrent_url_chains_to_torrent_method(tmp_path):
+    """HTTP source whose URL ends in .torrent must go through the torrent
+    downloader (reference lib/download.js:144-155)."""
+    from aiohttp import web
+
+    from downloader_tpu.stages.base import Job, StageContext
+    from downloader_tpu.stages.download import stage_factory
+    from downloader_tpu.utils import EventEmitter
+
+    src, files = make_payload_dir(tmp_path, [90_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent))
+    port = await seeder.start()
+    tracker = MiniTracker([("127.0.0.1", port)])
+    tracker_url = await tracker.start()
+    meta = make_metainfo(
+        str(src), piece_length=1 << 14, trackers=[tracker_url]
+    )
+
+    # serve the .torrent file over HTTP
+    app = web.Application()
+
+    async def serve_torrent(_request):
+        return web.Response(body=meta.to_torrent_bytes())
+
+    app.router.add_get("/show.torrent", serve_torrent)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    http_port = site._server.sockets[0].getsockname()[1]
+
+    ctx = StageContext(
+        config=ConfigNode(
+            {"instance": {"download_path": str(tmp_path / "dl")}}
+        ),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    stage = await stage_factory(ctx)
+    result = await stage(
+        Job(
+            media=schemas.Media(
+                id="tfile-job",
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=f"http://127.0.0.1:{http_port}/show.torrent",
+            )
+        )
+    )
+    for name, data in files.items():
+        path = os.path.join(result["path"], meta.name, name)
+        with open(path, "rb") as fh:
+            assert fh.read() == data
+
+    await runner.cleanup()
+    await seeder.stop()
+    await tracker.stop()
