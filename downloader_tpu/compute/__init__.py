@@ -1,0 +1,20 @@
+"""Optional TPU compute subsystem: the downstream "converter" demo.
+
+The reference pipeline's entire job is to stage media for a downstream
+converter service (it publishes ``api.Convert`` jobs — SURVEY.md §1); the
+reference itself contains **no tensor compute** (SURVEY.md §5: long-context /
+parallelism are N/A).  This package is the TPU-native demonstration of that
+downstream stage: a JAX/Flax video-frame super-resolution model ("media
+upscale" transcode), with
+
+- ``models/``   — the flagship upscaler network (bfloat16, NHWC, MXU-sized
+                  convs)
+- ``ops/``      — custom ops (Pallas kernel with an XLA fallback)
+- ``parallel/`` — device-mesh + sharding helpers (data-parallel batch,
+                  tensor-parallel feature dim) for multi-chip execution
+- ``train.py``  — a jittable training step used by the multi-chip dry run
+
+It is deliberately optional: the staging pipeline never imports JAX, and the
+compute stage plugs in through the same stage contract as download/process/
+upload.
+"""

@@ -1,0 +1,3 @@
+from .upscaler import Upscaler, UpscalerConfig
+
+__all__ = ["Upscaler", "UpscalerConfig"]

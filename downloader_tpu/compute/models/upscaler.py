@@ -1,0 +1,75 @@
+"""Flagship model: an efficient sub-pixel video-frame upscaler.
+
+ESPCN-style super-resolution (conv feature extraction + sub-pixel pixel
+shuffle) — the classic "media transcode/upscale" workload the pipeline's
+downstream converter would run.  TPU-first choices:
+
+- NHWC layout with channel counts that are multiples of the 128-lane vector
+  register width, so XLA tiles convs onto the MXU without padding
+- bfloat16 activations/params with fp32 loss accumulation
+- static shapes only; the whole forward is one fused XLA computation
+- feature (channel) dimension is shardable for tensor parallelism
+  (see ``compute.parallel``)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.pixel_shuffle import pixel_shuffle
+
+
+@dataclasses.dataclass(frozen=True)
+class UpscalerConfig:
+    scale: int = 2              # spatial upscale factor
+    features: int = 128         # conv width (multiple of 128 for MXU/VPU)
+    depth: int = 4              # number of hidden conv layers
+    channels: int = 3           # RGB
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+
+class Upscaler(nn.Module):
+    """(B, H, W, C) -> (B, H*scale, W*scale, C)"""
+
+    config: UpscalerConfig = UpscalerConfig()
+
+    @nn.compact
+    def __call__(self, frames: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = frames.astype(cfg.compute_dtype)
+
+        x = nn.Conv(
+            cfg.features, (5, 5), padding="SAME",
+            dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
+            name="stem",
+        )(x)
+        x = nn.relu(x)
+
+        for i in range(cfg.depth - 1):
+            residual = x
+            x = nn.Conv(
+                cfg.features, (3, 3), padding="SAME",
+                dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
+                name=f"body_{i}",
+            )(x)
+            x = nn.relu(x) + residual  # residual keeps deep stacks trainable
+
+        # project to scale^2 * channels sub-pixel maps, then rearrange
+        x = nn.Conv(
+            cfg.channels * cfg.scale * cfg.scale, (3, 3), padding="SAME",
+            dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
+            name="subpixel",
+        )(x)
+        return pixel_shuffle(x, cfg.scale)
+
+
+def init_params(rng: jax.Array, config: UpscalerConfig = UpscalerConfig(),
+                sample_shape=(1, 32, 32, 3)):
+    model = Upscaler(config)
+    params = model.init(rng, jnp.zeros(sample_shape, jnp.float32))
+    return model, params

@@ -1,0 +1,3 @@
+from .pixel_shuffle import pixel_shuffle
+
+__all__ = ["pixel_shuffle"]

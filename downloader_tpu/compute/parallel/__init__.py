@@ -1,0 +1,3 @@
+from .mesh import MeshPlan, make_mesh, shard_batch, shard_params
+
+__all__ = ["MeshPlan", "make_mesh", "shard_batch", "shard_params"]

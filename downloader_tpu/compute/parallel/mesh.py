@@ -1,0 +1,82 @@
+"""Device mesh + sharding plan for the compute stage.
+
+Two mesh axes:
+
+- ``data``  — data parallelism: the frame batch is split across this axis;
+  gradient psums ride ICI (inserted automatically by XLA from the sharding
+  annotations, scaling-book style: annotate, don't hand-schedule).
+- ``model`` — tensor parallelism: conv feature (output-channel) dimensions
+  are split across this axis, so each chip holds 1/T of every kernel and
+  activations stay sharded on the channel dim through the elementwise ops.
+
+The same plan compiles on one chip (both axes size 1), the driver's virtual
+8-device CPU mesh, or a real multi-host slice — only the mesh shape changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    mesh: Mesh
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Batches: split the leading (batch) dim across ``data``."""
+        return NamedSharding(self.mesh, P("data", None, None, None))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def param_spec(self, path: tuple, value) -> P:
+        """Tensor-parallel param layout: split conv kernels' output-channel
+        dim (last axis) across ``model``; biases likewise.  Sub-pixel head
+        stays replicated (its channel count is scale^2*3, not divisible by
+        typical model-axis sizes)."""
+        name = "/".join(str(p) for p in path)
+        if "subpixel" in name:
+            return P()
+        if value.ndim == 4:  # conv kernel (kh, kw, cin, cout)
+            return P(None, None, None, "model")
+        if value.ndim == 1:  # bias (cout,)
+            return P("model")
+        return P()
+
+    def param_sharding(self, path: tuple, value) -> NamedSharding:
+        return NamedSharding(self.mesh, self.param_spec(path, value))
+
+
+def make_mesh(n_devices: Optional[int] = None, model_axis: int = 1) -> MeshPlan:
+    """Build a (data x model) mesh over the first ``n_devices`` devices."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"asked for {n} devices, have {len(devices)}")
+    if n % model_axis != 0:
+        raise ValueError(f"{n} devices not divisible by model axis {model_axis}")
+    grid = np.array(devices[:n]).reshape(n // model_axis, model_axis)
+    return MeshPlan(Mesh(grid, axis_names=("data", "model")))
+
+
+def shard_params(plan: MeshPlan, params):
+    """Place a param pytree according to the plan (device_put with named
+    shardings; XLA partitions the arrays)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    placed = [
+        jax.device_put(value, plan.param_sharding(path, value))
+        for path, value in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def shard_batch(plan: MeshPlan, batch):
+    return jax.device_put(batch, plan.data_sharding)

@@ -2,13 +2,17 @@ import os
 import sys
 
 # Force JAX (imported only by compute tests) onto a virtual 8-device CPU mesh
-# BEFORE any jax import, so multi-chip sharding is exercised hermetically.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# so multi-chip sharding is exercised hermetically.  The image's axon
+# sitecustomize may have pre-registered the TPU platform before conftest
+# runs, so also flip jax.config if jax is importable.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if "jax" in sys.modules:  # pre-imported by a site hook: env vars won't apply
+    sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

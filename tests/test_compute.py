@@ -1,0 +1,132 @@
+"""Compute subsystem tests (virtual 8-device CPU mesh via conftest)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from downloader_tpu.compute.models.upscaler import (  # noqa: E402
+    Upscaler,
+    UpscalerConfig,
+)
+from downloader_tpu.compute.ops.pixel_shuffle import (  # noqa: E402
+    _pallas_shuffle_clip,
+    pixel_shuffle,
+    pixel_shuffle_clip_u8,
+)
+from downloader_tpu.compute.parallel.mesh import (  # noqa: E402
+    make_mesh,
+    shard_batch,
+    shard_params,
+)
+from downloader_tpu.compute.train import make_train_step  # noqa: E402
+
+TINY = UpscalerConfig(features=16, depth=2, scale=2)
+
+
+def test_pixel_shuffle_matches_reference():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 4, 12)).astype(np.float32)  # C=3*2*2
+    out = np.asarray(pixel_shuffle(jnp.asarray(x), 2))
+    assert out.shape == (2, 6, 8, 3)
+    # spot-check the sub-pixel interleave: output[b, h*r+dr, w*r+dc, c]
+    # == input[b, h, w, (dr*r + dc)*C + c]
+    for b, h, w, dr, dc, c in [(0, 1, 2, 0, 1, 1), (1, 2, 3, 1, 0, 2), (0, 0, 0, 1, 1, 0)]:
+        expected = x[b, h, w, (dr * 2 + dc) * 3 + c]
+        assert out[b, h * 2 + dr, w * 2 + dc, c] == expected
+
+
+def test_pallas_kernel_matches_xla_path():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-20, 300, (2, 4, 8, 12)).astype(np.float32)
+    xla = pixel_shuffle_clip_u8(jnp.asarray(x), 2)
+    pallas = _pallas_shuffle_clip(jnp.asarray(x), 2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(xla), np.asarray(pallas))
+
+
+def test_upscaler_shapes_and_dtype():
+    model = Upscaler(TINY)
+    x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 16, 16, 3)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_train_step_reduces_loss():
+    train_step, init_state = make_train_step(TINY, learning_rate=3e-3)
+    rng = jax.random.PRNGKey(0)
+    params, opt_state = init_state(rng, sample_shape=(1, 8, 8, 3))
+
+    low = jax.random.uniform(rng, (4, 8, 8, 3))
+    # target correlated with input (upscaled nearest) so the model can learn
+    high = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)
+
+    step = jax.jit(train_step)
+    first_loss = None
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state, low, high)
+        if first_loss is None:
+            first_loss = float(loss)
+    assert float(loss) < first_loss
+
+
+def test_mesh_sharded_train_step_runs_and_matches_single_device():
+    """The multi-chip path computes the same loss as single-device."""
+    train_step, init_state = make_train_step(TINY)
+    rng = jax.random.PRNGKey(42)
+    params, opt_state = init_state(rng, sample_shape=(1, 8, 8, 3))
+    low = jax.random.uniform(rng, (8, 8, 8, 3))
+    high = jax.random.uniform(rng, (8, 16, 16, 3))
+
+    # single device reference
+    _, _, ref_loss = jax.jit(train_step)(params, opt_state, low, high)
+
+    # 4x2 mesh: dp over 4, tp over 2
+    plan = make_mesh(8, model_axis=2)
+    sharded_params = shard_params(plan, params)
+    sharded_opt = shard_params(plan, opt_state)
+    slow = shard_batch(plan, low)
+    shigh = shard_batch(plan, high)
+    with plan.mesh:
+        _, _, mesh_loss = jax.jit(train_step)(
+            sharded_params, sharded_opt, slow, shigh
+        )
+    np.testing.assert_allclose(
+        float(ref_loss), float(mesh_loss), rtol=2e-2
+    )
+
+
+def test_param_sharding_layout():
+    plan = make_mesh(8, model_axis=2)
+    _, init_state = make_train_step(TINY)[0], make_train_step(TINY)[1]
+    params, _ = init_state(jax.random.PRNGKey(0), sample_shape=(1, 8, 8, 3))
+    sharded = shard_params(plan, params)
+
+    stem = sharded["params"]["stem"]["kernel"]
+    # conv kernels split on the output-channel (last) dim across 'model'
+    assert stem.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, None, "model"
+    )
+    sub = sharded["params"]["subpixel"]["kernel"]
+    assert sub.sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_graft_entry_contract():
+    """The driver contract: entry() compiles; dryrun_multichip(8) runs."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), "__graft_entry__.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 128, 128, 3)
+
+    mod.dryrun_multichip(8)
