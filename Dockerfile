@@ -1,0 +1,17 @@
+# Service image for the downloader pipeline.
+# Capability-equivalent to the reference's Dockerfile (tritonmedia/base +
+# prod-only install + copy to /stack, /root/reference/Dockerfile:1-5),
+# rebuilt on a plain Python base so it is self-contained.
+FROM python:3.12-slim
+
+WORKDIR /stack
+
+COPY pyproject.toml ./
+COPY downloader_tpu ./downloader_tpu
+
+RUN pip install --no-cache-dir .
+
+# health endpoint (reference lib/main.js:194)
+EXPOSE 3401
+
+CMD ["python", "-m", "downloader_tpu"]

@@ -1,0 +1,23 @@
+# Build/test entry points (reference Makefile renders CI config,
+# /root/reference/Makefile:1-7; here make drives the whole dev loop).
+
+.PHONY: test bench proto lint run docker
+
+test:
+	python -m pytest tests/ -x -q
+
+lint:
+	python -m pytest tests/test_lint.py -q
+
+bench:
+	python bench.py
+
+# regenerate protobuf gencode after editing downloader.proto
+proto:
+	protoc --python_out=downloader_tpu/schemas --proto_path=downloader_tpu/schemas downloader.proto
+
+run:
+	python -m downloader_tpu
+
+docker:
+	docker build -t downloader-tpu .

@@ -15,8 +15,6 @@ stage (SURVEY.md §7 step 6 lists that global as a bug to fix).
 
 from __future__ import annotations
 
-from typing import Optional
-
 from .. import schemas
 from ..mq.base import MessageQueue
 

@@ -12,7 +12,7 @@ single source of truth for field names and enum values.
 
 from __future__ import annotations
 
-from typing import Type, Union
+from typing import Type
 
 from google.protobuf.message import Message
 

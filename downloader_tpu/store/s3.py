@@ -14,7 +14,6 @@ Works against MinIO, AWS S3, GCS interop mode, or the in-repo test server
 
 from __future__ import annotations
 
-import asyncio
 import datetime
 import hashlib
 import hmac

@@ -18,18 +18,13 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import os
 import random
 import struct
 from typing import Awaitable, Callable, List, Optional, Set
 
 import aiohttp
 
-from ..utils.watchdog import (
-    DownloadStalledError,
-    MetadataTimeoutError,
-    StallWatchdog,
-)
+from ..utils.watchdog import MetadataTimeoutError, StallWatchdog
 from . import tracker as tracker_mod
 from . import wire
 from .magnet import parse_magnet

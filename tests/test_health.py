@@ -1,0 +1,64 @@
+"""Health/metrics HTTP surface tests (reference lib/main.js:174-194)."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu.health import build_app
+from downloader_tpu.platform import metrics as prom
+
+pytestmark = pytest.mark.anyio
+
+
+class FakeOrchestrator:
+    def __init__(self):
+        self.active_jobs = []
+
+
+@pytest.fixture
+async def client():
+    orchestrator = FakeOrchestrator()
+    metrics = prom.new("healthtest")
+    app = build_app(orchestrator, metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    import aiohttp
+
+    session = aiohttp.ClientSession()
+    yield session, f"http://127.0.0.1:{port}", orchestrator, metrics
+    await session.close()
+    await runner.cleanup()
+
+
+async def test_health_idle_is_500(client):
+    # inverted semantics preserved from the reference (lib/main.js:177-181):
+    # an idle worker reports unhealthy
+    session, base, _orch, _m = client
+    async with session.get(f"{base}/health") as resp:
+        assert resp.status == 500
+        assert json.loads(await resp.text()) == {"message": "Not Running Jobs"}
+
+
+async def test_health_busy_is_200_with_active_count(client):
+    session, base, orch, _m = client
+    orch.active_jobs.extend([{"jobId": "a"}, {"jobId": "b"}])
+    async with session.get(f"{base}/health") as resp:
+        assert resp.status == 200
+        body = json.loads(await resp.text())
+        assert body["metadata"]["success"] is True
+        assert body["data"]["active"] == 2
+        assert body["metadata"]["host"]
+
+
+async def test_metrics_exposition(client):
+    session, base, _orch, metrics = client
+    metrics.jobs_consumed.inc()
+    async with session.get(f"{base}/metrics") as resp:
+        assert resp.status == 200
+        text = await resp.text()
+        assert "healthtest_jobs_consumed_total 1.0" in text

@@ -1,0 +1,102 @@
+"""Lint-as-test: static checks over the package, run as a test suite.
+
+Capability-equivalent to the reference's mocha-eslint suite
+(/root/reference/test/eslint.js, SURVEY.md §2 component 7), implemented with
+the stdlib ``ast`` module (no linter dependencies in the image): every
+module must parse, carry no unused imports, no bare ``except:``, no tabs,
+and no ``print()`` in library code (structured logging only).
+"""
+
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "downloader_tpu")
+
+
+def _module_files():
+    out = []
+    for dirpath, dirnames, filenames in os.walk(PACKAGE):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for filename in filenames:
+            if filename.endswith(".py") and not filename.endswith("_pb2.py"):
+                out.append(os.path.join(dirpath, filename))
+    out.append(os.path.join(REPO, "bench.py"))
+    out.append(os.path.join(REPO, "__graft_entry__.py"))
+    return sorted(out)
+
+
+MODULES = _module_files()
+IDS = [os.path.relpath(p, REPO) for p in MODULES]
+
+
+class _ImportUsage(ast.NodeVisitor):
+    def __init__(self):
+        self.imported = {}  # name -> lineno
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = (alias.asname or alias.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imported[alias.asname or alias.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+@pytest.mark.parametrize("path", MODULES, ids=IDS)
+def test_module_lints_clean(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+
+    assert "\t" not in source, f"{path}: tabs found"
+
+    tree = ast.parse(source, filename=path)  # SyntaxError -> test failure
+
+    usage = _ImportUsage()
+    usage.visit(tree)
+    referenced = usage.used
+    explicit_exports = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant):
+                            explicit_exports.add(elt.value)
+    unused = [
+        f"{name} (line {line})"
+        for name, line in usage.imported.items()
+        if name not in referenced
+        and name not in explicit_exports
+        and not name.startswith("_")
+        and f"# noqa" not in source.splitlines()[line - 1]
+    ]
+    assert not unused, f"{path}: unused imports: {unused}"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            pytest.fail(f"{path}:{node.lineno}: bare 'except:'")
+
+    # library code logs, it doesn't print (bench/graft entry are CLIs)
+    if not path.endswith(("bench.py", "__graft_entry__.py")):
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                pytest.fail(f"{path}:{node.lineno}: print() in library code")

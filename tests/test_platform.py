@@ -1,0 +1,140 @@
+"""Platform layer tests: config/dyn, structured logging, tracing."""
+
+import io
+import json
+import os
+
+import pytest
+
+from downloader_tpu.platform.config import DEFAULTS, ConfigNode, dyn, load_config
+from downloader_tpu.platform.logging import Logger, NullLogger, get_logger
+from downloader_tpu.platform.tracing import NullTracer, Tracer, init_tracer
+
+
+# -- config -------------------------------------------------------------
+def test_load_config_defaults_when_missing(tmp_path):
+    config = load_config("converter", path=str(tmp_path))
+    # the one key the reference consumes in-tree
+    # (/root/reference/lib/download.js:235)
+    assert config.instance.download_path == "downloading"
+
+
+def test_load_config_merges_yaml_over_defaults(tmp_path):
+    (tmp_path / "converter.yaml").write_text(
+        "instance:\n  download_path: /data/dl\nextra:\n  key: 7\n"
+    )
+    config = load_config("converter", path=str(tmp_path))
+    assert config.instance.download_path == "/data/dl"
+    assert config.extra.key == 7
+    # untouched defaults survive the merge
+    assert "rabbitmq" in config.services
+
+
+def test_config_node_mapping_interface():
+    node = ConfigNode({"a": {"b": 1}})
+    assert node["a"]["b"] == 1
+    assert node.get("missing", "dflt") == "dflt"
+    assert dict(node.a) == {"b": 1}
+    with pytest.raises(AttributeError):
+        _ = node.nope
+
+
+def test_dyn_resolution_order(monkeypatch):
+    # env var wins (reference triton-core/dynamics semantics)
+    monkeypatch.setenv("RABBITMQ", "amqp://env-wins")
+    assert dyn("rabbitmq") == "amqp://env-wins"
+    monkeypatch.delenv("RABBITMQ")
+
+    config = ConfigNode({"services": {"rabbitmq": "amqp://from-config"}})
+    assert dyn("rabbitmq", config) == "amqp://from-config"
+    assert dyn("rabbitmq") == DEFAULTS["services"]["rabbitmq"]
+    assert dyn("unknown-service") == "localhost"
+
+
+# -- logging ------------------------------------------------------------
+def test_logger_emits_single_line_json():
+    stream = io.StringIO()
+    logger = Logger("test", stream=stream)
+    logger.info("hello", jobId="j1")
+    record = json.loads(stream.getvalue())
+    assert record["msg"] == "hello"
+    assert record["name"] == "test"
+    assert record["jobId"] == "j1"
+    assert record["level"] == 30  # pino level numbering
+
+
+def test_child_logger_carries_bindings():
+    stream = io.StringIO()
+    logger = Logger("parent", stream=stream)
+    child = logger.child(jobId="j2", fileId="f2")
+    child.warn("careful")
+    record = json.loads(stream.getvalue())
+    assert (record["jobId"], record["fileId"]) == ("j2", "f2")
+    assert record["level"] == 40
+
+
+def test_log_level_filtering(monkeypatch):
+    stream = io.StringIO()
+    monkeypatch.setenv("LOG_LEVEL", "error")
+    logger = Logger("quiet", stream=stream)
+    logger.info("dropped")
+    logger.error("kept")
+    lines = [l for l in stream.getvalue().splitlines() if l]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["msg"] == "kept"
+
+
+def test_null_logger_drops_everything():
+    NullLogger().error("nothing happens")
+
+
+def test_get_logger_factory():
+    assert isinstance(get_logger("x"), Logger)
+
+
+# -- tracing ------------------------------------------------------------
+def test_spans_nest_and_record():
+    tracer = Tracer("svc")
+    with tracer.span("outer", jobId="j"):
+        with tracer.span("inner") as inner:
+            inner.set_tag("k", "v")
+    outer_spans = tracer.spans("outer")
+    inner_spans = tracer.spans("inner")
+    assert len(outer_spans) == len(inner_spans) == 1
+    assert inner_spans[0].parent_id == outer_spans[0].span_id
+    assert inner_spans[0].trace_id == outer_spans[0].trace_id
+    assert inner_spans[0].tags["k"] == "v"
+    assert outer_spans[0].duration >= 0
+
+
+def test_span_records_error_and_reraises():
+    tracer = Tracer("svc")
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("nope")
+    (span,) = tracer.spans("boom")
+    assert "ValueError" in span.error
+
+
+def test_span_export_jsonl(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer("svc", export_path=path)
+    with tracer.span("exported"):
+        pass
+    with open(path) as fh:
+        record = json.loads(fh.readline())
+    assert record["name"] == "exported"
+    assert record["service"] == "svc"
+
+
+def test_null_tracer_records_nothing():
+    tracer = NullTracer()
+    with tracer.span("x"):
+        pass
+    assert tracer.spans() == []
+
+
+def test_init_tracer_respects_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TRACE_EXPORT", str(tmp_path / "t.jsonl"))
+    tracer = init_tracer("downloader")
+    assert tracer.export_path == str(tmp_path / "t.jsonl")
