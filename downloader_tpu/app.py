@@ -15,7 +15,8 @@ import signal
 
 from . import schemas  # noqa: F401  (ensures schemas import before serving)
 from .health import start_server
-from .mq.memory import InMemoryBroker, MemoryQueue
+from .mq import new_queue, resolve_backend
+from .mq.memory import InMemoryBroker
 from .orchestrator import Orchestrator
 from .platform import metrics as prom
 from .platform.config import load_config
@@ -36,12 +37,16 @@ def build_service(config=None, broker=None, store=None):
     tracer = init_tracer("downloader", logger)
     metrics = prom.new("downloader")
 
-    # cap redeliveries so a deterministically-failing (poison) job cannot
-    # hot-loop at the head of the queue and starve the worker; RabbitMQ
-    # would need a dead-letter policy for the same guarantee
-    broker = broker or InMemoryBroker(max_redeliveries=5)
-    mq = MemoryQueue(broker)
-    telem_mq = MemoryQueue(broker)
+    # Queue backend per config: a real AMQP connection pair (one for jobs,
+    # one for telemetry, like the reference's AMQP + Telemetry connections,
+    # lib/main.js:46-50) or the hermetic in-process broker.  For the memory
+    # backend, cap redeliveries so a deterministically-failing (poison) job
+    # cannot hot-loop at the head of the queue and starve the worker;
+    # RabbitMQ would need a dead-letter policy for the same guarantee.
+    if broker is None and resolve_backend(config) == "memory":
+        broker = InMemoryBroker(max_redeliveries=5)
+    mq = new_queue(config, broker=broker, logger=logger)
+    telem_mq = new_queue(config, broker=broker, logger=logger)
     telemetry = Telemetry(telem_mq, metrics)
 
     store = store if store is not None else new_client(config)

@@ -105,6 +105,7 @@ class Orchestrator:
                 active=len(self.active_jobs),
             )
         await self.mq.close()
+        await self.telemetry.close()
 
     # ------------------------------------------------------------------
     async def processor(self, delivery: Delivery) -> None:

@@ -32,6 +32,10 @@ DEFAULTS: dict = {
         "secret_key": os.environ.get("MINIO_SECRET_KEY", ""),
         "ssl": False,
     },
+    "rabbitmq": {
+        # "memory" boots hermetically; "amqp" connects to dyn('rabbitmq')
+        "backend": "memory",
+    },
     "services": {
         # service-discovery name -> address map consumed by dyn()
         "rabbitmq": os.environ.get("RABBITMQ", "amqp://localhost"),

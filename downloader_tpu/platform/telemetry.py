@@ -37,6 +37,10 @@ class Telemetry:
         """(reference lib/main.js:50)"""
         await self._mq.connect()
 
+    async def close(self) -> None:
+        """Tear down the telemetry connection (graceful shutdown)."""
+        await self._mq.close()
+
     async def emit_status(self, media_id: str, status: int) -> None:
         event = schemas.TelemetryStatusEvent(media_id=media_id, status=status)
         await self._mq.publish(STATUS_QUEUE, schemas.encode(event))
@@ -57,6 +61,9 @@ class NullTelemetry(Telemetry):
 
     def __init__(self) -> None:  # noqa: D401
         super().__init__(mq=None)  # type: ignore[arg-type]
+
+    async def close(self) -> None:
+        pass
 
     async def connect(self) -> None:
         pass
