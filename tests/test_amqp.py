@@ -335,7 +335,7 @@ async def test_new_queue_factory_selects_amqp():
 async def test_orchestrator_end_to_end_over_amqp(server, tmp_path):
     """The full pipeline slice across real AMQP sockets: one Download in,
     staged files + done marker in the store, one Convert out."""
-    from aiohttp import web
+    from helpers import start_media_server
 
     from downloader_tpu import schemas
     from downloader_tpu.orchestrator import Orchestrator
@@ -345,17 +345,7 @@ async def test_orchestrator_end_to_end_over_amqp(server, tmp_path):
     from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
     from downloader_tpu.store import InMemoryObjectStore
 
-    app = web.Application()
-
-    async def serve(_request):
-        return web.Response(body=b"V" * 4096)
-
-    app.router.add_get("/show.mkv", serve)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
+    runner, base = await start_media_server(b"V" * 4096)
 
     telem_mq = AmqpQueue(server.url, heartbeat=0)
     store = InMemoryObjectStore()
@@ -377,7 +367,7 @@ async def test_orchestrator_end_to_end_over_amqp(server, tmp_path):
                 name="A Show",
                 type=schemas.MediaType.Value("MOVIE"),
                 source=schemas.SourceType.Value("HTTP"),
-                source_uri=f"http://127.0.0.1:{port}/show.mkv",
+                source_uri=f"{base}/show.mkv",
             )
         )
         server._publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
@@ -395,6 +385,72 @@ async def test_orchestrator_end_to_end_over_amqp(server, tmp_path):
         assert server.published("v1.telemetry.status")
     finally:
         await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_two_replicas_split_work_over_amqp(server, tmp_path):
+    """Horizontal scaling (the reference's concurrency model, SURVEY.md §2):
+    two worker replicas on separate connections share one queue round-robin,
+    and every job lands exactly once in the staging store."""
+    from helpers import start_media_server
+
+    from downloader_tpu import schemas
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.store import InMemoryObjectStore
+
+    # the response delay forces overlap so both replicas get work
+    runner, base = await start_media_server(b"V" * 2048, delay=0.03)
+
+    store = InMemoryObjectStore()  # shared staging backend
+    counts = [0, 0]
+    replicas = []
+    for i in range(2):
+        orch = Orchestrator(
+            config=ConfigNode(
+                {"instance": {"download_path": str(tmp_path / f"dl{i}")}}
+            ),
+            mq=AmqpQueue(server.url, heartbeat=0),
+            store=store,
+            logger=NullLogger(),
+        )
+
+        async def counting(delivery, i=i, orig=orch.processor):
+            counts[i] += 1
+            await orig(delivery)
+
+        orch.processor = counting
+        replicas.append(orch)
+        await orch.start()
+
+    try:
+        jobs = 6
+        for n in range(jobs):
+            msg = schemas.Download(
+                media=schemas.Media(
+                    id=f"multi-{n}",
+                    creator_id=f"card-{n}",
+                    type=schemas.MediaType.Value("MOVIE"),
+                    source=schemas.SourceType.Value("HTTP"),
+                    source_uri=f"{base}/show.mkv",
+                )
+            )
+            server._publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await server.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+
+        assert len(server.published(schemas.CONVERT_QUEUE)) == jobs
+        from downloader_tpu.stages.upload import STAGING_BUCKET
+
+        for n in range(jobs):
+            assert await store.get_object(
+                STAGING_BUCKET, f"multi-{n}/original/done") == b"true"
+        # both replicas actually participated
+        assert counts[0] >= 1 and counts[1] >= 1
+        assert sum(counts) == jobs
+    finally:
+        for orch in replicas:
+            await orch.shutdown(grace_seconds=5)
         await runner.cleanup()
 
 

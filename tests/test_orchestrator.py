@@ -5,10 +5,11 @@ One ``Download`` message in -> files staged with a ``done`` marker -> one
 plus the idempotency and error policies of /root/reference/lib/main.js.
 """
 
+import asyncio
 import os
 
 import pytest
-from aiohttp import web
+from helpers import start_media_server
 
 from downloader_tpu import schemas
 from downloader_tpu.mq import InMemoryBroker, MemoryQueue
@@ -26,19 +27,9 @@ pytestmark = pytest.mark.anyio
 
 @pytest.fixture
 async def http_server():
-    app = web.Application()
     payload = b"V" * 4096
-
-    async def serve(request):
-        return web.Response(body=payload)
-
-    app.router.add_get("/show.mkv", serve)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
-    yield f"http://127.0.0.1:{port}", payload
+    runner, base = await start_media_server(payload)
+    yield base, payload
     await runner.cleanup()
 
 
@@ -188,3 +179,35 @@ async def test_stall_error_acks_and_drops(tmp_path):
         s.status != schemas.TelemetryStatus.Value("ERRORED") for s in statuses
     )
     await orchestrator.shutdown(grace_seconds=1)
+
+
+async def test_graceful_shutdown_drains_inflight_job(tmp_path):
+    """Shutdown stops pulling new work but lets the in-flight job finish
+    (the reference's termination closure refuses to exit while jobs are
+    active, lib/main.js:197-204)."""
+    # job is mid-download when shutdown starts
+    runner, base = await start_media_server(
+        b"V" * 1024, delay=0.3, path="/slow.mkv")
+
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        broker.publish(
+            schemas.DOWNLOAD_QUEUE,
+            make_download_msg(f"{base}/slow.mkv", job_id="job-slow"),
+        )
+        # wait until the job is actually in flight, then shut down
+        async with asyncio.timeout(5):
+            while not orchestrator.active_jobs:
+                await asyncio.sleep(0.01)
+        await orchestrator.shutdown(grace_seconds=10)
+
+        # the in-flight job ran to completion during the grace period
+        assert orchestrator.active_jobs == []
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+        assert await store.get_object(
+            STAGING_BUCKET, "job-slow/original/done") == b"true"
+    finally:
+        await orchestrator.shutdown(grace_seconds=1)
+        await runner.cleanup()
