@@ -30,10 +30,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # Self-baseline (MB/s) from the round-1 measurement; see BASELINE.md.
 SELF_BASELINE_MBPS = 500.0
 
-JOBS = 8
-MIB_PER_JOB = 32
-PREFETCH = 2  # single-core host: 2 in-flight jobs pipeline download vs upload
-REPS = 3      # noisy shared host; take the best of three
+JOBS = int(os.environ.get("BENCH_JOBS", 8))
+MIB_PER_JOB = int(os.environ.get("BENCH_MIB_PER_JOB", 32))
+# single-core host: the loop is CPU-bound, so interleaving jobs only adds
+# scheduling overhead — prefetch=1 measured fastest (sweep: 1 > 4 > 3 > 2)
+PREFETCH = int(os.environ.get("BENCH_PREFETCH", 1))
+REPS = int(os.environ.get("BENCH_REPS", 3))  # noisy shared host; best of N
 
 
 async def _one_rep(port: int) -> float:
@@ -109,41 +111,63 @@ async def bench_pipeline():
     }
 
 
-def bench_compute():
-    """Secondary: upscaler throughput on the available accelerator."""
+_COMPUTE_SNIPPET = """
+import json, time
+import jax
+import jax.numpy as jnp
+from downloader_tpu.compute.models.upscaler import UpscalerConfig, init_params
+
+config = UpscalerConfig()
+rng = jax.random.PRNGKey(0)
+frames = jax.random.uniform(rng, (16, 180, 320, 3), jnp.float32)
+model, params = init_params(rng, config, sample_shape=frames.shape)
+fwd = jax.jit(lambda p, x: model.apply(p, x))
+fwd(params, frames).block_until_ready()  # compile
+
+iters = 20
+start = time.monotonic()
+x = frames
+for _ in range(iters):
+    # feed the (downsampled) output back in so each step depends on the
+    # previous one — defeats async-dispatch overlap that would otherwise
+    # fake the timing
+    out = fwd(params, x)
+    x = out[:, ::2, ::2, :].astype(frames.dtype)
+x.block_until_ready()
+dt = time.monotonic() - start
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "upscaler_fps_180p_to_360p": frames.shape[0] * iters / dt,
+}))
+"""
+
+
+def bench_compute(timeout_s: float = 240.0):
+    """Secondary: upscaler throughput on the available accelerator.
+
+    Runs in a subprocess with a hard timeout — a wedged TPU runtime (e.g.
+    an unreachable device tunnel hangs PJRT client init uninterruptibly)
+    must not take the headline pipeline metric down with it.
+    """
+    import subprocess
+
     try:
-        import jax
-        import jax.numpy as jnp
-
-        from downloader_tpu.compute.models.upscaler import (
-            UpscalerConfig,
-            init_params,
+        proc = subprocess.run(
+            [sys.executable, "-c", _COMPUTE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-
-        config = UpscalerConfig()
-        rng = jax.random.PRNGKey(0)
-        frames = jax.random.uniform(rng, (16, 180, 320, 3), jnp.float32)
-        model, params = init_params(rng, config, sample_shape=frames.shape)
-        fwd = jax.jit(lambda p, x: model.apply(p, x))
-        fwd(params, frames).block_until_ready()  # compile
-
-        iters = 20
-        start = time.monotonic()
-        x = frames
-        for _ in range(iters):
-            # feed the (downsampled) output back in so each step depends on
-            # the previous one — defeats async-dispatch overlap that would
-            # otherwise fake the timing
-            out = fwd(params, x)
-            x = out[:, ::2, ::2, :].astype(frames.dtype)
-        x.block_until_ready()
-        dt = time.monotonic() - start
-        return {
-            "backend": jax.default_backend(),
-            "upscaler_fps_180p_to_360p": frames.shape[0] * iters / dt,
-        }
-    except Exception as err:  # pragma: no cover - accelerator-dependent
-        return {"error": f"{type(err).__name__}: {err}"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"compute bench timed out after {timeout_s:.0f}s"}
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no output"]
+        return {"error": f"compute bench failed: {tail[0][:200]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"compute bench bad output: {proc.stdout[:200]!r}"}
 
 
 def main() -> None:

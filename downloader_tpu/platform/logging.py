@@ -51,6 +51,9 @@ class Logger:
         merged.pop("name", None)
         return Logger(name, merged, self._stream)
 
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self.name} {self.bindings}>"
+
     def _emit(self, level: str, msg: str, extra: dict) -> None:
         if _LEVELS[level] < _min_level():
             return
@@ -97,6 +100,9 @@ class NullLogger(Logger):
 
     def __init__(self) -> None:
         super().__init__("null")
+
+    def child(self, **bindings: Any) -> "Logger":
+        return self
 
     def _emit(self, level: str, msg: str, extra: dict) -> None:
         pass

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import os
 import posixpath
+import re
 import urllib.parse
 import urllib.request
+import zlib
 
 import aiohttp
 
@@ -41,6 +43,19 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 PROGRESS_INTERVAL_SECONDS = 30.0
 
 _CHUNK = 1 << 20  # 1 MiB read chunks for streaming HTTP
+
+
+def choose_validator(headers) -> "str | None":
+    """Pick the entity validator to store beside a partial download.
+
+    If-Range requires a STRONG validator (RFC 7232 §3.2): a weak ETag can
+    name byte-different entities, which is exactly what range stitching
+    must not tolerate.  Falls back to Last-Modified, else None (no resume).
+    """
+    etag = headers.get("ETag", "")
+    if etag.startswith("W/"):
+        etag = ""
+    return etag or headers.get("Last-Modified") or None
 
 
 def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
@@ -120,20 +135,234 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         os.makedirs(download_path, exist_ok=True)
         output = os.path.join(download_path, filename)
+        # bytes stream into ``<name>.partial`` and are renamed on completion,
+        # so ``output`` existing is a completion marker and the partial file
+        # is a byte-level resume point across job redeliveries — the
+        # reference restarts every HTTP download from zero (SURVEY.md §5).
+        # ``<name>.partial.meta`` stores the entity validator (ETag or
+        # Last-Modified) the partial bytes came from; resume only happens
+        # when one exists, sent as ``If-Range`` so a changed entity comes
+        # back as a full 200 instead of being stitched onto stale bytes.
+        partial = output + ".partial"
+        meta = partial + ".meta"
 
         watchdog = StallWatchdog(STALL_TIMEOUT_SECONDS)
+        # identity: a Content-Encoding-compressed body would be written to
+        # disk raw (the session doesn't decompress), and byte-range offsets
+        # are only meaningful against the unencoded entity
+        base_headers = {"Accept-Encoding": "identity"}
+
+        def _entity_complete(resp, offset: int) -> bool:
+            # 416 Content-Range is ``bytes */<total>``
+            match = re.fullmatch(
+                r"bytes \*/(\d+)", resp.headers.get("Content-Range", "")
+            )
+            return bool(match) and int(match.group(1)) == offset
+
+        def _content_range(resp) -> "tuple | None":
+            # satisfied-range form: ``bytes <start>-<end>/<total>``
+            match = re.fullmatch(
+                r"bytes (\d+)-(\d+)/(\d+)",
+                resp.headers.get("Content-Range", ""),
+            )
+            return tuple(map(int, match.groups())) if match else None
+
+        def _read_validator() -> str:
+            try:
+                with open(meta) as fh:
+                    return fh.read().strip()
+            except OSError:
+                return ""
+
+        def _remove_meta() -> None:
+            try:
+                os.remove(meta)
+            except OSError:
+                pass
+
+        def _discard_partial() -> None:
+            # order matters: the stale bytes must be gone BEFORE any new
+            # validator is recorded — a crash between the two must never
+            # leave a fresh validator paired with old-entity bytes
+            try:
+                os.remove(partial)
+            except OSError:
+                pass
+            _remove_meta()
+
+        def _write_validator(resp) -> None:
+            validator = choose_validator(resp.headers)
+            if validator:
+                with open(meta, "w") as fh:
+                    fh.write(validator)
+            else:
+                _remove_meta()
+
+        def _promote() -> None:
+            os.replace(partial, output)
+            _remove_meta()
+
+        def _decoder_for(resp):
+            # the session never decompresses (auto_decompress=False) and we
+            # ask for identity, but a misbehaving origin/CDN can still send
+            # Content-Encoding — decode it rather than staging gzip bytes
+            # as media.  MAX_WBITS|32 auto-detects gzip and zlib framing.
+            enc = resp.headers.get("Content-Encoding", "").strip().lower()
+            if enc in ("", "identity"):
+                return None
+            if enc in ("gzip", "x-gzip", "deflate"):
+                return zlib.decompressobj(zlib.MAX_WBITS | 32)
+            raise RuntimeError(f"unsupported Content-Encoding: {enc}")
+
+        fetched = [0]  # cumulative across resume rounds, for the watchdog
+
+        async def _stream_body(resp, mode: str) -> int:
+            total = 0
+            decoder = _decoder_for(resp)
+            with open(partial, mode, buffering=0) as fh:
+                async for raw in resp.content.iter_any():
+                    # watchdog tracks raw network progress; ``total`` counts
+                    # decoded bytes written to disk
+                    fetched[0] += len(raw)
+                    watchdog.feed(fetched[0])
+                    data = decoder.decompress(raw) if decoder else raw
+                    if data:
+                        fh.write(data)
+                        total += len(data)
+                if decoder is not None:
+                    tail = decoder.flush()
+                    if tail:
+                        fh.write(tail)
+                        total += len(tail)
+            return total
+
+        async def _existing_output_ok(session) -> bool:
+            """Validate a pre-existing completed file against the origin.
+
+            Guards against a truncated ``output`` left by a non-atomic
+            writer (older deployments wrote ``output`` directly): compare
+            its size to the origin's Content-Length when a HEAD can tell
+            us.  Unknowable (HEAD unsupported, no length, encoded body)
+            -> trust the file.
+            """
+            try:
+                async with session.head(
+                    resource_url, headers=base_headers, allow_redirects=True
+                ) as resp:
+                    if resp.status != 200:
+                        return True
+                    if resp.headers.get(
+                        "Content-Encoding", ""
+                    ).strip().lower() not in ("", "identity"):
+                        return True
+                    length = resp.headers.get("Content-Length")
+                    if length is None:
+                        return True
+                    return int(length) == os.path.getsize(output)
+            except (aiohttp.ClientError, ValueError, OSError):
+                return True
 
         async def _fetch() -> int:
-            total = 0
-            async with aiohttp.ClientSession() as session:
-                async with session.get(resource_url) as resp:
+            # large read buffer + iter_any: fewer loop wakeups and no
+            # re-chunking copy on the hot path (this stage is the service's
+            # bandwidth bottleneck)
+            async with aiohttp.ClientSession(
+                read_bufsize=_CHUNK, auto_decompress=False
+            ) as session:
+                if os.path.exists(output):
+                    # a previous attempt finished the download but the job
+                    # died before settling (e.g. crash before upload acked)
+                    if await _existing_output_ok(session):
+                        logger.info(
+                            "http: already downloaded, skipping", file=output
+                        )
+                        return 0
+                    logger.warn(
+                        "http: existing file fails size check, re-downloading",
+                        file=output,
+                    )
+                    os.remove(output)
+                # a server may legally satisfy an open-ended range with a
+                # capped 206 (fewer bytes than the remainder), so resuming
+                # loops until the entity is complete; every round must
+                # advance the offset or the attempt errors out
+                while True:
+                    offset = (
+                        os.path.getsize(partial)
+                        if os.path.exists(partial)
+                        else 0
+                    )
+                    validator = _read_validator() if offset else ""
+                    if not (offset and validator):
+                        break  # nothing resumable: full download below
+                    headers = {
+                        **base_headers,
+                        "Range": f"bytes={offset}-",
+                        "If-Range": validator,
+                    }
+                    async with session.get(
+                        resource_url, headers=headers
+                    ) as resp:
+                        crange = _content_range(resp)
+                        encoded = resp.headers.get(
+                            "Content-Encoding", ""
+                        ).strip().lower() not in ("", "identity")
+                        if (
+                            resp.status == 206
+                            and crange is not None
+                            and crange[0] == offset
+                            and not encoded
+                        ):
+                            start, end, total_len = crange
+                            logger.info(
+                                "http: resuming partial download",
+                                offset=offset,
+                                total=total_len,
+                            )
+                            got = await _stream_body(resp, "ab")
+                            # promote on the bytes actually on disk — a
+                            # close-delimited 206 can deliver fewer bytes
+                            # than its Content-Range advertises without
+                            # raising
+                            if os.path.getsize(partial) >= total_len:
+                                _promote()
+                                return fetched[0]
+                            if got <= 0:
+                                raise RuntimeError(
+                                    "http resume made no progress at "
+                                    f"offset {offset}"
+                                )
+                            continue  # short/capped 206: next range round
+                        if resp.status == 200:
+                            # entity changed (If-Range miss) or no range
+                            # support: body is the full entity, restart on
+                            # this response
+                            _discard_partial()
+                            _write_validator(resp)
+                            await _stream_body(resp, "wb")
+                            _promote()
+                            return fetched[0]
+                        if resp.status == 416:
+                            # If-Range was sent, so a 416 means the
+                            # validator matched; length == offset proves the
+                            # partial is the complete entity
+                            if _entity_complete(resp, offset):
+                                _promote()
+                                return fetched[0]
+                            # oversized/stale partial: clean restart below
+                        elif resp.status != 206:
+                            resp.raise_for_status()
+                        # mis-ranged/unparseable 206 or stale 416: restart
+                        break
+                _discard_partial()
+                async with session.get(
+                    resource_url, headers=base_headers
+                ) as resp:
                     resp.raise_for_status()
-                    with open(output, "wb") as fh:
-                        async for chunk in resp.content.iter_chunked(_CHUNK):
-                            fh.write(chunk)
-                            total += len(chunk)
-                            watchdog.feed(total)
-            return total
+                    _write_validator(resp)
+                    await _stream_body(resp, "wb")
+                    _promote()
+                    return fetched[0]
 
         total = await watchdog.watch(_fetch())
         if ctx.metrics is not None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
 import os
 import posixpath
 import shutil
@@ -37,6 +38,33 @@ def object_name(media_id: str, file_path: str) -> str:
 def done_marker_name(media_id: str) -> str:
     """``<id>/original/done`` (reference lib/upload.js:55)."""
     return posixpath.join(media_id, "original", DONE_MARKER)
+
+
+async def _already_staged(store, name: str, file_path: str) -> bool:
+    """True when the staged object provably holds this file's bytes.
+
+    Requires both a size match and a content-hash match against the
+    backend's etag; a backend that can't report one (empty etag) never
+    short-circuits — size equality alone could seal a stale same-size
+    object under the done marker.
+    """
+    from ..store.base import ObjectNotFound
+
+    try:
+        info = await store.stat_object(STAGING_BUCKET, name)
+    except ObjectNotFound:
+        return False
+    if not info.etag or info.size != os.path.getsize(file_path):
+        return False
+    return info.etag == await asyncio.to_thread(_md5_file, file_path)
+
+
+def _md5_file(path: str) -> str:
+    digest = hashlib.md5()
+    with open(path, "rb") as fh:
+        while chunk := fh.read(1 << 20):
+            digest.update(chunk)
+    return digest.hexdigest()
 
 
 async def stage_factory(ctx: StageContext) -> StageFn:
@@ -72,9 +100,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     raise FileNotFoundError(f"{file_path} not found.")
 
                 name = object_name(media_id, file_path)
-                await store.fput_object(STAGING_BUCKET, name, file_path)
-                if ctx.metrics is not None:
-                    ctx.metrics.bytes_uploaded.inc(os.path.getsize(file_path))
+                # file-level resume: a redelivered job (crash/nack before the
+                # done marker was written) skips files whose bytes are
+                # provably already staged — the reference re-uploads
+                # everything from scratch (lib/upload.js:34-52)
+                if await _already_staged(store, name, file_path):
+                    logger.info("already staged, skipping", file=file_path)
+                else:
+                    await store.fput_object(STAGING_BUCKET, name, file_path)
+                    if ctx.metrics is not None:
+                        ctx.metrics.bytes_uploaded.inc(
+                            os.path.getsize(file_path)
+                        )
 
                 # upload occupies the 50-100% progress band
                 # (reference lib/upload.js:48)

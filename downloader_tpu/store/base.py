@@ -23,10 +23,16 @@ class ObjectNotFound(KeyError):
 @dataclasses.dataclass(frozen=True)
 class ObjectInfo:
     """Listing entry (reference iterates ``item.name``/``item.size`` from
-    ``getObjects``, /root/reference/lib/download.js:217-222)."""
+    ``getObjects``, /root/reference/lib/download.js:217-222).
+
+    ``etag`` is the content hash when the backend knows it (S3-style MD5
+    hex for single-part objects), else ``""``.  Consumers must treat an
+    empty etag as "unknown", never as "matches".
+    """
 
     name: str
     size: int
+    etag: str = ""
 
 
 class ObjectStore(abc.ABC):
@@ -62,3 +68,16 @@ class ObjectStore(abc.ABC):
     def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
         """Iterate objects under ``prefix`` (reference ``getObjects``,
         lib/download.js:217)."""
+
+    async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
+        """Metadata for one object; raises :class:`ObjectNotFound`.
+
+        Used by the upload stage to skip files that are already staged
+        (file-level resume — the reference re-uploads everything on a
+        redelivered job, lib/upload.js:34-52).  Default implementation
+        scans a prefix listing; backends override with a cheaper probe.
+        """
+        async for info in self.list_objects(bucket, prefix=name):
+            if info.name == name:
+                return info
+        raise ObjectNotFound(bucket, name)

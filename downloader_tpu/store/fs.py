@@ -80,6 +80,25 @@ class FilesystemObjectStore(ObjectStore):
         for info in await asyncio.to_thread(_walk):
             yield info
 
+    async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
+        path = self._object_path(bucket, name)
+        try:
+            size, etag = await asyncio.to_thread(_stat_with_md5, path)
+        except OSError:
+            raise ObjectNotFound(bucket, name) from None
+        return ObjectInfo(name=name, size=size, etag=etag)
+
+
+def _stat_with_md5(path: str) -> tuple:
+    import hashlib
+
+    size = os.path.getsize(path)
+    digest = hashlib.md5()
+    with open(path, "rb") as fh:
+        while chunk := fh.read(1 << 20):
+            digest.update(chunk)
+    return size, digest.hexdigest()
+
 
 def _read_file(path: str) -> bytes:
     with open(path, "rb") as fh:

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import os
 from typing import AsyncIterator, Dict
 
@@ -52,6 +53,12 @@ class InMemoryObjectStore(ObjectStore):
         for name in sorted(objects):
             if name.startswith(prefix):
                 yield ObjectInfo(name=name, size=len(objects[name]))
+
+    async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
+        data = await self.get_object(bucket, name)
+        return ObjectInfo(
+            name=name, size=len(data), etag=hashlib.md5(data).hexdigest()
+        )
 
 
 def _write_file(path: str, data: bytes) -> None:

@@ -257,6 +257,24 @@ class S3ObjectStore(ObjectStore):
         if resp.status not in (200, 204):
             raise RuntimeError(f"fput_object failed: {resp.status} {body!r}")
 
+    async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
+        resp = await self._request("HEAD", self._object_path(bucket, name))
+        resp.release()
+        if resp.status == 404:
+            raise ObjectNotFound(bucket, name)
+        if resp.status != 200:
+            raise RuntimeError(f"stat_object failed: {resp.status}")
+        # S3 ETag is the MD5 hex for single-part uploads; multipart etags
+        # (``...-N``) are not content MD5s, so expose those as unknown
+        etag = resp.headers.get("ETag", "").strip('"')
+        if "-" in etag:
+            etag = ""
+        return ObjectInfo(
+            name=name,
+            size=int(resp.headers.get("Content-Length", 0)),
+            etag=etag,
+        )
+
     async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
         token: Optional[str] = None
         while True:

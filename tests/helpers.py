@@ -3,6 +3,24 @@
 import asyncio
 
 
+async def start_http_server(handler, path: str = "/show.mkv"):
+    """Serve ``handler`` (an aiohttp GET coroutine) at ``path`` on an
+    ephemeral localhost port.
+
+    Returns ``(runner, base_url)``; callers own ``await runner.cleanup()``.
+    """
+    from aiohttp import web
+
+    app = web.Application()
+    app.router.add_get(path, handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
 async def start_media_server(payload: bytes = b"V" * 4096,
                              delay: float = 0.0,
                              path: str = "/show.mkv"):
@@ -12,17 +30,9 @@ async def start_media_server(payload: bytes = b"V" * 4096,
     """
     from aiohttp import web
 
-    app = web.Application()
-
     async def serve(_request):
         if delay:
             await asyncio.sleep(delay)
         return web.Response(body=payload)
 
-    app.router.add_get(path, serve)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
-    return runner, f"http://127.0.0.1:{port}"
+    return await start_http_server(serve, path)

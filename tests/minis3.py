@@ -166,7 +166,18 @@ class MiniS3:
             data = self.buckets.get(bucket, {}).get(key)
             if data is None:
                 return web.Response(status=404, text="NoSuchKey")
-            return web.Response(body=data if request.method == "GET" else b"")
+            if request.method == "HEAD":
+                # like real S3: metadata-only, Content-Length + MD5 ETag
+                import hashlib
+
+                return web.Response(
+                    body=b"",
+                    headers={
+                        "Content-Length": str(len(data)),
+                        "ETag": f'"{hashlib.md5(data).hexdigest()}"',
+                    },
+                )
+            return web.Response(body=data)
         return web.Response(status=405)
 
     # -- lifecycle ------------------------------------------------------

@@ -6,6 +6,8 @@ import os
 import pytest
 from aiohttp import web
 
+from helpers import start_http_server
+
 from downloader_tpu import schemas
 from downloader_tpu.mq import InMemoryBroker, MemoryQueue
 from downloader_tpu.platform.config import ConfigNode
@@ -55,24 +57,15 @@ def make_job(source: str, uri: str, media_id: str = "job-1") -> Job:
 
 @pytest.fixture
 async def http_server():
-    app = web.Application()
     payload = b"M" * (1 << 20)  # 1 MiB
 
     async def serve(request):
+        if request.path.endswith("missing.mkv"):
+            return web.Response(status=404)
         return web.Response(body=payload)
 
-    async def missing(request):
-        return web.Response(status=404)
-
-    app.router.add_get("/media/file.mkv", serve)
-    app.router.add_get("/media/missing.mkv", missing)
-
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
-    yield f"http://127.0.0.1:{port}", payload
+    runner, base = await start_http_server(serve, path="/media/{name}")
+    yield base, payload
     await runner.cleanup()
 
 
@@ -181,6 +174,277 @@ async def test_bucket_download_rejects_traversal_keys(tmp_path, broker):
         for f in files:
             if f == "evil.mkv":
                 assert dirpath.startswith(root)
+
+
+ETAG = '"v1-abc"'
+
+
+@pytest.fixture
+async def range_server():
+    """Fixture server with byte-range + If-Range support and request log."""
+    payload = bytes(range(256)) * 4096  # 1 MiB, position-dependent bytes
+    requests = []
+
+    async def serve(request):
+        rng = request.headers.get("Range")
+        if request.method == "GET":  # HEADs (output validation) are noise
+            requests.append((rng, request.headers.get("If-Range")))
+        if rng:
+            # If-Range miss -> entity changed -> full 200 (RFC 7233 §3.2)
+            if request.headers.get("If-Range") not in (None, ETAG):
+                return web.Response(body=payload, headers={"ETag": ETAG})
+            start = int(rng.removeprefix("bytes=").split("-")[0])
+            if start >= len(payload):
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{len(payload)}"},
+                )
+            return web.Response(
+                status=206,
+                body=payload[start:],
+                headers={
+                    "ETag": ETAG,
+                    "Content-Range": f"bytes {start}-{len(payload)-1}/{len(payload)}",
+                },
+            )
+        return web.Response(body=payload, headers={"ETag": ETAG})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    yield base, payload, requests
+    await runner.cleanup()
+
+
+def seed_partial(target_dir, data: bytes, validator: str = ETAG):
+    target_dir.mkdir(parents=True, exist_ok=True)
+    (target_dir / "file.mkv.partial").write_bytes(data)
+    if validator:
+        (target_dir / "file.mkv.partial.meta").write_text(validator)
+
+
+async def test_http_resumes_from_partial(tmp_path, broker, range_server):
+    """A leftover .partial file (with its validator) resumes with a
+    Range+If-Range request instead of restarting from zero (the reference
+    restarts, SURVEY.md §5)."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    offset = 300_000
+    seed_partial(target_dir, payload[:offset])
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    assert requests == [(f"bytes={offset}-", ETAG)]
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload
+    assert not (target_dir / "file.mkv.partial").exists()
+    assert not (target_dir / "file.mkv.partial.meta").exists()
+
+
+async def test_http_resume_with_complete_partial(tmp_path, broker, range_server):
+    """A partial that already holds the full entity (416 + matching
+    validator) is promoted without re-downloading."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    seed_partial(target_dir, payload)
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    assert requests == [(f"bytes={len(payload)}-", ETAG)]
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload
+
+
+async def test_http_skips_completed_download(tmp_path, broker, range_server):
+    """A fully-downloaded file from a prior attempt short-circuits the
+    fetch entirely."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    (target_dir / "file.mkv").write_bytes(payload)
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert requests == []
+
+
+async def test_http_restart_when_entity_changed(tmp_path, broker, range_server):
+    """If the origin's entity changed since the partial was written
+    (If-Range miss -> 200), stale bytes are discarded, not stitched."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    seed_partial(target_dir, b"OLD-VERSION-BYTES", validator='"v0-old"')
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    assert requests == [("bytes=17-", '"v0-old"')]
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload  # no v0 bytes survived
+
+
+async def test_http_no_validator_means_clean_restart(tmp_path, broker, range_server):
+    """A partial with no recorded validator cannot be safely resumed;
+    the download restarts from zero with no Range header."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    seed_partial(target_dir, payload[:1000], validator="")
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    assert requests == [(None, None)]
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload
+
+
+async def test_http_capped_206_resumes_in_rounds(tmp_path, broker):
+    """A server that caps open-ended ranges (returns fewer bytes than the
+    remainder) must not yield a silently-truncated file: the stage keeps
+    requesting the next range until the entity is complete."""
+    payload = bytes(range(256)) * 4096  # 1 MiB
+    cap = 200_000
+    requests = []
+
+    async def serve(request):
+        rng = request.headers.get("Range")
+        requests.append(rng)
+        if rng:
+            start = int(rng.removeprefix("bytes=").split("-")[0])
+            end = min(start + cap, len(payload)) - 1
+            return web.Response(
+                status=206,
+                body=payload[start : end + 1],
+                headers={
+                    "ETag": ETAG,
+                    "Content-Range": f"bytes {start}-{end}/{len(payload)}",
+                },
+            )
+        return web.Response(body=payload, headers={"ETag": ETAG})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        stage = await make_stage(tmp_path, broker)
+        target_dir = tmp_path / "downloads" / "job-1"
+        offset = 100_000
+        seed_partial(target_dir, payload[:offset])
+
+        await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+        # 100k seed + ceil(948576/200000) = 5 range rounds
+        assert requests == [
+            f"bytes={o}-" for o in range(offset, len(payload), cap)
+        ]
+        with open(target_dir / "file.mkv", "rb") as fh:
+            assert fh.read() == payload
+    finally:
+        await runner.cleanup()
+
+
+async def test_http_weak_etag_never_recorded_as_validator(tmp_path, broker):
+    """A weak ETag (W/"...") must not become an If-Range validator
+    (RFC 7232 §3.2: If-Range needs a strong validator) — with no
+    Last-Modified fallback, no .meta is written at all."""
+
+    async def serve(request):
+        return web.Response(body=b"x" * 2048, headers={"ETag": 'W/"weak-1"'})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        stage = await make_stage(tmp_path, broker)
+        result = await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+        target = os.path.join(result["path"], "file.mkv")
+        assert os.path.getsize(target) == 2048
+        assert not os.path.exists(target + ".partial.meta")
+    finally:
+        await runner.cleanup()
+
+
+def test_weak_etag_rejected_unit(tmp_path):
+    """Unit-level check of the validator policy without a live download."""
+    from downloader_tpu.stages.download import choose_validator
+
+    assert choose_validator({"ETag": 'W/"weak"'}) is None
+    assert choose_validator({"ETag": 'W/"weak"', "Last-Modified": "LMDATE"}) == "LMDATE"
+    assert choose_validator({"ETag": '"strong"'}) == '"strong"'
+    assert choose_validator({}) is None
+
+
+async def test_http_truncated_preexisting_output_redownloads(tmp_path, broker, range_server):
+    """A pre-existing but truncated final file (e.g. left by a non-atomic
+    writer) fails the HEAD size check and is re-downloaded instead of
+    being treated as a completion marker."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    (target_dir / "file.mkv").write_bytes(payload[:1000])  # truncated
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload
+
+
+async def test_http_intact_preexisting_output_skips(tmp_path, broker, range_server):
+    """A pre-existing final file that matches the origin's Content-Length
+    is trusted — only a HEAD goes over the wire."""
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    (target_dir / "file.mkv").write_bytes(payload)
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert requests == []  # fixture only logs GETs; no GET happened
+
+
+async def test_http_forced_gzip_body_is_decoded(tmp_path, broker):
+    """A server that sends Content-Encoding: gzip despite
+    'Accept-Encoding: identity' must not get raw gzip bytes staged as
+    media — the stage decodes them."""
+    import gzip as gzip_mod
+
+    payload = b"media-bytes-" * 1000
+
+    async def serve(request):
+        assert request.headers.get("Accept-Encoding") == "identity"
+        body = gzip_mod.compress(payload)
+        resp = web.Response(
+            body=body, headers={"Content-Encoding": "gzip", "ETag": ETAG}
+        )
+        # aiohttp would otherwise re-encode; mark the body pre-compressed
+        resp._compressed_body = body
+        return resp
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        stage = await make_stage(tmp_path, broker)
+        result = await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+        with open(os.path.join(result["path"], "file.mkv"), "rb") as fh:
+            assert fh.read() == payload
+    finally:
+        await runner.cleanup()
+
+
+async def test_http_restarts_when_server_lacks_ranges(tmp_path, broker, http_server):
+    """Against a server without range support (plain 200), a stale partial
+    is discarded and the download restarts cleanly."""
+    base, payload = http_server
+    stage = await make_stage(tmp_path, broker)
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    seed_partial(target_dir, b"stale-junk")
+
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    with open(target_dir / "file.mkv", "rb") as fh:
+        assert fh.read() == payload
 
 
 def test_parse_bucket_uri():

@@ -88,6 +88,17 @@ def test_null_logger_drops_everything():
     NullLogger().error("nothing happens")
 
 
+def test_null_logger_children_stay_silent(capsys):
+    # regression: Logger.child() used to construct a plain Logger, so a
+    # NullLogger's per-job children (orchestrator logger.child(jobId=...))
+    # wrote to stderr
+    child = NullLogger().child(jobId="j1", fileId="f1")
+    child.info("must not print")
+    child.child(name="stage").error("nor this")
+    captured = capsys.readouterr()
+    assert captured.out == "" and captured.err == ""
+
+
 def test_get_logger_factory():
     assert isinstance(get_logger("x"), Logger)
 

@@ -51,6 +51,19 @@ async def test_special_characters_in_keys(server, client):
     assert server.auth_failures == []
 
 
+async def test_stat_object_head(server, client):
+    import hashlib
+
+    await client.make_bucket("b")
+    await client.put_object("b", "k/obj", b"123456")
+    info = await client.stat_object("b", "k/obj")
+    assert (info.name, info.size) == ("k/obj", 6)
+    assert info.etag == hashlib.md5(b"123456").hexdigest()
+    with pytest.raises(ObjectNotFound):
+        await client.stat_object("b", "k/missing")
+    assert server.auth_failures == []
+
+
 async def test_get_missing_raises(server, client):
     await client.make_bucket("b")
     with pytest.raises(ObjectNotFound):

@@ -30,6 +30,18 @@ async def test_put_get_roundtrip(store):
     assert await store.get_object("b", "job/original/done") == b"true"
 
 
+async def test_stat_object(store):
+    import hashlib
+
+    await store.make_bucket("b")
+    await store.put_object("b", "job/original/a", b"12345")
+    info = await store.stat_object("b", "job/original/a")
+    assert (info.name, info.size) == ("job/original/a", 5)
+    assert info.etag == hashlib.md5(b"12345").hexdigest()
+    with pytest.raises(ObjectNotFound):
+        await store.stat_object("b", "job/original/missing")
+
+
 async def test_get_missing_raises(store):
     with pytest.raises(ObjectNotFound):
         await store.get_object("nope", "missing")

@@ -112,6 +112,98 @@ async def test_missing_file_raises(store, broker, tmp_path):
         await upload(job)
 
 
+async def test_resume_skips_already_staged_files(store, broker, tmp_path):
+    """File-level resume: a redelivered job must not re-upload files that
+    are already fully staged (the reference re-uploads everything,
+    lib/upload.js:34-52)."""
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path, names=("a.mkv", "b.mkv"))
+
+    # first attempt staged a.mkv (same bytes), then crashed before b.mkv
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv"), b"data-a.mkv"
+    )
+    puts = []
+    original_fput = store.fput_object
+
+    async def spying_fput(bucket, name, file_path):
+        puts.append(name)
+        await original_fput(bucket, name, file_path)
+
+    store.fput_object = spying_fput
+    await upload(job)
+
+    # only the missing file was uploaded; both are staged + done marker
+    assert puts == [object_name("job-1", "b.mkv")]
+    assert await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "b.mkv")
+    ) == b"data-b.mkv"
+    assert await store.get_object(STAGING_BUCKET, "job-1/original/done") == b"true"
+
+
+async def test_resume_reuploads_on_size_mismatch(store, broker, tmp_path):
+    """A truncated (partially-uploaded) object must be re-uploaded, not
+    skipped."""
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path, names=("a.mkv",))
+
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv"), b"data-"  # truncated
+    )
+    await upload(job)
+    assert await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv")
+    ) == b"data-a.mkv"
+
+
+async def test_resume_reuploads_same_size_different_content(store, broker, tmp_path):
+    """Size equality is not content equality: a stale same-size object
+    (e.g. from a prior attempt against a changed source) must be replaced,
+    not sealed under the done marker."""
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path, names=("a.mkv",))
+
+    await store.make_bucket(STAGING_BUCKET)
+    stale = b"XXXX-a.mkv"  # same length as b"data-a.mkv"
+    assert len(stale) == len(b"data-a.mkv")
+    await store.put_object(STAGING_BUCKET, object_name("job-1", "a.mkv"), stale)
+    await upload(job)
+    assert await store.get_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv")
+    ) == b"data-a.mkv"
+
+
+async def test_resume_never_skips_without_etag(store, broker, tmp_path):
+    """A backend that can't report a content hash must never short-circuit
+    the upload."""
+    from downloader_tpu.store.base import ObjectInfo
+
+    upload = await make_upload(store, broker)
+    job = make_job(tmp_path, names=("a.mkv",))
+
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(
+        STAGING_BUCKET, object_name("job-1", "a.mkv"), b"data-a.mkv"
+    )
+
+    async def stat_no_etag(bucket, name):
+        return ObjectInfo(name=name, size=len(b"data-a.mkv"), etag="")
+
+    store.stat_object = stat_no_etag
+    puts = []
+    original_fput = store.fput_object
+
+    async def spying_fput(bucket, name, file_path):
+        puts.append(name)
+        await original_fput(bucket, name, file_path)
+
+    store.fput_object = spying_fput
+    await upload(job)
+    assert puts == [object_name("job-1", "a.mkv")]  # uploaded, not skipped
+
+
 async def test_non_list_files_raises(store, broker, tmp_path):
     upload = await make_upload(store, broker)
     job = Job(
