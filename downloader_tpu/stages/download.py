@@ -50,12 +50,31 @@ def choose_validator(headers) -> "str | None":
 
     If-Range requires a STRONG validator (RFC 7232 §3.2): a weak ETag can
     name byte-different entities, which is exactly what range stitching
-    must not tolerate.  Falls back to Last-Modified, else None (no resume).
+    must not tolerate.  Last-Modified is itself weak (1 s granularity), so
+    per RFC 7232 §2.2.2 it only counts as strong when the origin offered
+    no ETag at all AND the date is at least one second older than the
+    response's own Date (the entity provably wasn't modified within the
+    second that produced it).  Otherwise: None (restart from byte 0 on
+    redelivery rather than risk stitching two entities).
     """
     etag = headers.get("ETag", "")
     if etag.startswith("W/"):
-        etag = ""
-    return etag or headers.get("Last-Modified") or None
+        return None  # weak ETag: origin admits byte-level ambiguity
+    if etag:
+        return etag
+    last_modified = headers.get("Last-Modified")
+    if not last_modified:
+        return None
+    from email.utils import parsedate_to_datetime
+
+    try:
+        modified = parsedate_to_datetime(last_modified)
+        date = parsedate_to_datetime(headers["Date"])
+    except (KeyError, ValueError, TypeError):
+        return None
+    if (date - modified).total_seconds() >= 1.0:
+        return last_modified
+    return None
 
 
 def make_bucket_client(endpoint: str, access_key: str, secret_key: str,

@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import asyncio
 import base64
-import hashlib
 import os
 import posixpath
 import shutil
 
 from .. import schemas
+from ..utils.hashing import md5_file_hex
 from .base import Job, StageContext, StageFn
 
 STAGING_BUCKET = "triton-staging"
@@ -46,25 +46,18 @@ async def _already_staged(store, name: str, file_path: str) -> bool:
     Requires both a size match and a content-hash match against the
     backend's etag; a backend that can't report one (empty etag) never
     short-circuits — size equality alone could seal a stale same-size
-    object under the done marker.
+    object under the done marker.  The probe is best-effort: ANY stat
+    failure (not just ObjectNotFound — e.g. write-only credentials where
+    HEAD answers 403) means "not staged" so the upload proceeds instead
+    of failing a job the plain put path would have handled fine.
     """
-    from ..store.base import ObjectNotFound
-
     try:
         info = await store.stat_object(STAGING_BUCKET, name)
-    except ObjectNotFound:
+    except Exception:
         return False
     if not info.etag or info.size != os.path.getsize(file_path):
         return False
-    return info.etag == await asyncio.to_thread(_md5_file, file_path)
-
-
-def _md5_file(path: str) -> str:
-    digest = hashlib.md5()
-    with open(path, "rb") as fh:
-        while chunk := fh.read(1 << 20):
-            digest.update(chunk)
-    return digest.hexdigest()
+    return info.etag == await asyncio.to_thread(md5_file_hex, file_path)
 
 
 async def stage_factory(ctx: StageContext) -> StageFn:

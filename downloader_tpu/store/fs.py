@@ -90,14 +90,9 @@ class FilesystemObjectStore(ObjectStore):
 
 
 def _stat_with_md5(path: str) -> tuple:
-    import hashlib
+    from ..utils.hashing import md5_file_hex
 
-    size = os.path.getsize(path)
-    digest = hashlib.md5()
-    with open(path, "rb") as fh:
-        while chunk := fh.read(1 << 20):
-            digest.update(chunk)
-    return size, digest.hexdigest()
+    return os.path.getsize(path), md5_file_hex(path)
 
 
 def _read_file(path: str) -> bytes:

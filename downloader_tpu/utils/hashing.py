@@ -1,0 +1,21 @@
+"""Shared file-hashing helpers.
+
+Both the upload stage's resume probe and the filesystem store's etag
+computation must produce identical digests — the resume check compares
+one against the other — so they share this single implementation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_CHUNK = 1 << 20  # 1 MiB
+
+
+def md5_file_hex(path: str) -> str:
+    """Chunked MD5 of a file, as the lowercase hex S3-style etag."""
+    digest = hashlib.md5()
+    with open(path, "rb") as fh:
+        while chunk := fh.read(_CHUNK):
+            digest.update(chunk)
+    return digest.hexdigest()

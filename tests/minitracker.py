@@ -56,3 +56,84 @@ class MiniTracker:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+class MiniUdpTracker:
+    """Minimal BEP 15 UDP tracker: connect + announce with a fixed peer list.
+
+    ``drop_first`` swallows the first N datagrams to exercise the client's
+    retry path.
+    """
+
+    _MAGIC = 0x41727101980
+
+    def __init__(self, peers: List[Tuple[str, int]], drop_first: int = 0,
+                 error: bytes | None = None):
+        self.peers = list(peers)
+        self.announces: list = []
+        self.drop_first = drop_first
+        self.error = error
+        self._transport = None
+        self.port = None
+        self._connection_ids: set = set()
+
+    def _respond(self, data: bytes, addr) -> None:
+        if self.drop_first > 0:
+            self.drop_first -= 1
+            return
+        if len(data) < 16:
+            return
+        action, tid = struct.unpack_from(">II", data, 8)
+        if len(data) == 16 and struct.unpack_from(">Q", data)[0] == self._MAGIC:
+            # connect request
+            cid = 0x1122334455667788 ^ len(self._connection_ids)
+            self._connection_ids.add(cid)
+            self._transport.sendto(struct.pack(">IIQ", 0, tid, cid), addr)
+            return
+        # announce request
+        (cid,) = struct.unpack_from(">Q", data, 0)
+        action, tid = struct.unpack_from(">II", data, 8)
+        if action != 1 or cid not in self._connection_ids:
+            self._transport.sendto(
+                struct.pack(">II", 3, tid) + b"bad connection id", addr)
+            return
+        if self.error is not None:
+            self._transport.sendto(struct.pack(">II", 3, tid) + self.error, addr)
+            return
+        info_hash, peer_id = struct.unpack_from(">20s20s", data, 16)
+        downloaded, left, uploaded, event = struct.unpack_from(">QQQI", data, 56)
+        self.announces.append({
+            "info_hash": info_hash, "peer_id": peer_id, "left": left,
+            "event": event,
+        })
+        compact = b"".join(
+            socket.inet_aton(host) + struct.pack(">H", port)
+            for host, port in self.peers
+        )
+        self._transport.sendto(
+            struct.pack(">IIIII", 1, tid, 60, 1, len(self.peers)) + compact,
+            addr,
+        )
+
+    async def start(self) -> str:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        tracker = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                tracker._transport = transport
+
+            def datagram_received(self, data, addr):
+                tracker._respond(data, addr)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=("127.0.0.1", 0)
+        )
+        self.port = transport.get_extra_info("sockname")[1]
+        return f"udp://127.0.0.1:{self.port}/announce"
+
+    async def stop(self) -> None:
+        if self._transport is not None:
+            self._transport.close()

@@ -369,10 +369,21 @@ def test_weak_etag_rejected_unit(tmp_path):
     """Unit-level check of the validator policy without a live download."""
     from downloader_tpu.stages.download import choose_validator
 
+    lm = "Mon, 01 Jan 2024 00:00:00 GMT"
+    later = "Mon, 01 Jan 2024 00:00:05 GMT"
+
     assert choose_validator({"ETag": 'W/"weak"'}) is None
-    assert choose_validator({"ETag": 'W/"weak"', "Last-Modified": "LMDATE"}) == "LMDATE"
+    # a weak ETag means the origin admits byte-level ambiguity: no resume
+    # even with a plausible Last-Modified (RFC 7232 §2.2.2)
+    assert choose_validator(
+        {"ETag": 'W/"weak"', "Last-Modified": lm, "Date": later}
+    ) is None
     assert choose_validator({"ETag": '"strong"'}) == '"strong"'
     assert choose_validator({}) is None
+    # Last-Modified counts as strong only when >=1s older than Date
+    assert choose_validator({"Last-Modified": lm, "Date": later}) == lm
+    assert choose_validator({"Last-Modified": lm, "Date": lm}) is None
+    assert choose_validator({"Last-Modified": lm}) is None  # no Date header
 
 
 async def test_http_truncated_preexisting_output_redownloads(tmp_path, broker, range_server):

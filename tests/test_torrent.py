@@ -18,10 +18,10 @@ from downloader_tpu.torrent import (
 )
 from downloader_tpu.torrent.magnet import make_magnet
 from downloader_tpu.torrent.metainfo import parse_torrent_bytes
-from downloader_tpu.torrent.tracker import Peer, announce
+from downloader_tpu.torrent.tracker import Peer, TrackerError, announce
 from downloader_tpu.utils.watchdog import DownloadStalledError, MetadataTimeoutError
 
-from minitracker import MiniTracker
+from minitracker import MiniTracker, MiniUdpTracker
 
 pytestmark = pytest.mark.anyio
 
@@ -245,3 +245,79 @@ async def test_announce_helper(swarm):
         swarm.tracker_url, swarm.meta.info_hash, b"-DT0001-xxxxxxxxxxxx", 6881
     )
     assert peers == [Peer("127.0.0.1", swarm.seeder.port)]
+
+
+# -- UDP tracker (BEP 15) ----------------------------------------------
+async def test_udp_announce(swarm):
+    udp = MiniUdpTracker([("127.0.0.1", swarm.seeder.port), ("10.0.0.9", 7001)])
+    url = await udp.start()
+    try:
+        peers = await announce(
+            url, swarm.meta.info_hash, b"-DT0001-xxxxxxxxxxxx", 6881, left=123
+        )
+        assert peers == [
+            Peer("127.0.0.1", swarm.seeder.port),
+            Peer("10.0.0.9", 7001),
+        ]
+        [seen] = udp.announces
+        assert seen["info_hash"] == swarm.meta.info_hash
+        assert seen["left"] == 123
+        assert seen["event"] == 2  # "started"
+    finally:
+        await udp.stop()
+
+
+async def test_udp_announce_retries_lost_datagrams():
+    udp = MiniUdpTracker([("127.0.0.1", 9999)], drop_first=2)
+    url = await udp.start()
+    try:
+        peers = await announce(
+            url, b"\x07" * 20, b"-DT0001-xxxxxxxxxxxx", 6881,
+            udp_timeout=0.2, udp_retries=3,
+        )
+        assert peers == [Peer("127.0.0.1", 9999)]
+    finally:
+        await udp.stop()
+
+
+async def test_udp_announce_timeout_raises():
+    # nothing listening: bind a socket, learn its port, close it
+    import socket as socket_mod
+
+    probe = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    with pytest.raises(TrackerError):
+        await announce(
+            f"udp://127.0.0.1:{dead_port}", b"\x07" * 20,
+            b"-DT0001-xxxxxxxxxxxx", 6881, udp_timeout=0.1, udp_retries=0,
+        )
+
+
+async def test_udp_announce_tracker_error():
+    udp = MiniUdpTracker([], error=b"torrent not registered")
+    url = await udp.start()
+    try:
+        with pytest.raises(TrackerError, match="not registered"):
+            await announce(
+                url, b"\x07" * 20, b"-DT0001-xxxxxxxxxxxx", 6881
+            )
+    finally:
+        await udp.stop()
+
+
+async def test_download_via_udp_tracker(swarm, tmp_path):
+    """Full swarm drive where the magnet's only tracker is UDP."""
+    udp = MiniUdpTracker([("127.0.0.1", swarm.seeder.port)])
+    url = await udp.start()
+    try:
+        uri = make_magnet(swarm.meta.info_hash, swarm.meta.name, [url])
+        dest = str(tmp_path / "dl-udp")
+        client = TorrentClient()
+        meta = await client.download(uri, dest)
+        assert meta.info_hash == swarm.meta.info_hash
+        assert_downloaded(swarm, dest)
+    finally:
+        await udp.stop()
