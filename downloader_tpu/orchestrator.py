@@ -77,6 +77,12 @@ class Orchestrator:
         self.emitter_table: Dict[str, EventEmitter] = {}
         self.active_jobs: List[dict] = []
 
+        # shared across every job's StageContext: stage-memoized resources
+        # (e.g. the download stage's long-lived DHT node) and their
+        # teardown callables, run once at shutdown
+        self.stage_resources: dict = {}
+        self.stage_cleanups: list = []
+
     # ------------------------------------------------------------------
     async def start(self) -> None:
         """Connect and begin consuming (reference lib/main.js:47,172)."""
@@ -106,6 +112,13 @@ class Orchestrator:
             )
         await self.mq.close()
         await self.telemetry.close()
+        for cleanup in self.stage_cleanups:
+            try:
+                await cleanup()
+            except Exception as err:
+                self.logger.warn("stage cleanup failed", error=str(err))
+        self.stage_cleanups.clear()
+        self.stage_resources.clear()
 
     # ------------------------------------------------------------------
     async def processor(self, delivery: Delivery) -> None:
@@ -167,6 +180,8 @@ class Orchestrator:
             metrics=self.metrics,
             store=self.store,
             tracer=self.tracer,
+            resources=self.stage_resources,
+            cleanups=self.stage_cleanups,
         )
         stage_table = await load_stages(ctx, self.stage_names)
 

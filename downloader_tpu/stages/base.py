@@ -56,6 +56,12 @@ class StageContext:
     # Optional override for the download stage's ad-hoc ``bucket://`` client
     # (tests inject a fake; default builds an S3 client).
     bucket_client_factory: Optional[Callable] = None
+    # Cross-job shared state: the orchestrator passes the SAME dict/list to
+    # every job's context, so stages can memoize long-lived resources (e.g.
+    # the download stage's DHT node) and register async teardown callables
+    # that run once at orchestrator shutdown.
+    resources: dict = dataclasses.field(default_factory=dict)
+    cleanups: list = dataclasses.field(default_factory=list)
 
 StageFn = Callable[[Job], Awaitable[Any]]
 StageFactory = Callable[[StageContext], Awaitable[StageFn]]

@@ -50,12 +50,13 @@ def choose_validator(headers) -> "str | None":
 
     If-Range requires a STRONG validator (RFC 7232 §3.2): a weak ETag can
     name byte-different entities, which is exactly what range stitching
-    must not tolerate.  Last-Modified is itself weak (1 s granularity), so
-    per RFC 7232 §2.2.2 it only counts as strong when the origin offered
-    no ETag at all AND the date is at least one second older than the
-    response's own Date (the entity provably wasn't modified within the
-    second that produced it).  Otherwise: None (restart from byte 0 on
-    redelivery rather than risk stitching two entities).
+    must not tolerate.  Last-Modified is itself weak (1 s granularity);
+    RFC 7232 §2.2.2 lets a client treat it as strong only when the origin
+    offered no ETag at all AND the date is at least 60 seconds older than
+    the response's own Date — outside the window in which clock skew and
+    sub-minute regeneration could produce two different entities with the
+    same timestamp.  Otherwise: None (restart from byte 0 on redelivery
+    rather than risk stitching two entities).
     """
     etag = headers.get("ETag", "")
     if etag.startswith("W/"):
@@ -72,7 +73,7 @@ def choose_validator(headers) -> "str | None":
         date = parsedate_to_datetime(headers["Date"])
     except (KeyError, ValueError, TypeError):
         return None
-    if (date - modified).total_seconds() >= 1.0:
+    if (date - modified).total_seconds() >= 60.0:
         return last_modified
     return None
 
@@ -111,6 +112,38 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
     bucket_client_factory = getattr(ctx, "bucket_client_factory", None) or make_bucket_client
 
+    # One long-lived DHT node shared by every torrent job the orchestrator
+    # runs (webtorrent likewise keeps a single bundled DHT instance for the
+    # client's lifetime, lib/download.js:19).  Created lazily on the first
+    # torrent download, memoized in the cross-job ``ctx.resources`` dict,
+    # closed once via ``ctx.cleanups`` at orchestrator shutdown.
+    async def _shared_dht(logger):
+        import asyncio
+
+        bootstrap_spec = os.environ.get("DHT_BOOTSTRAP") or getattr(
+            ctx.config.instance, "dht_bootstrap", None
+        )
+        if not bootstrap_spec:
+            return None
+        lock = ctx.resources.setdefault("dht_lock", asyncio.Lock())
+        async with lock:
+            if "dht_node" in ctx.resources:
+                return ctx.resources["dht_node"]
+            from ..torrent.dht import DHTNode, parse_bootstrap
+
+            routers = parse_bootstrap(bootstrap_spec)  # validate BEFORE binding
+            node = DHTNode(logger=logger)
+            await node.start()
+            try:
+                found = await node.bootstrap(routers)
+            except BaseException:
+                await node.close()
+                raise
+            logger.info("dht bootstrapped", routing_table=found)
+            ctx.resources["dht_node"] = node
+            ctx.cleanups.append(node.close)
+            return node
+
     async def torrent(resource_url: str, file_id: str, download_path: str, job: Job):
         try:
             from ..torrent import TorrentClient
@@ -120,7 +153,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             ) from err
 
         logger.info("torrent", url=resource_url[:25] + "...")
-        client = TorrentClient(logger=logger)
+
+        # DHT peer discovery (BEP 5) — the reference's webtorrent bundles
+        # bittorrent-dht (lib/download.js:19).  Bootstrap routers come from
+        # DHT_BOOTSTRAP=host:port,... or config.instance.dht_bootstrap;
+        # unset means tracker-only discovery.
+        client = TorrentClient(logger=logger, dht=await _shared_dht(logger))
 
         last_emitted = [None]
 

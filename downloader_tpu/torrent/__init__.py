@@ -6,16 +6,18 @@ magnet link, a ``.torrent`` URL, or a local ``.torrent`` file, into a target
 directory, with progress reporting and the 240 s metadata/stall watchdog
 semantics the reference builds around it.
 
-Scope (documented, gated): HTTP(S) trackers and the BitTorrent peer wire
-protocol with the ut_metadata extension (BEP 3/9/10, compact peers BEP 23).
-UDP trackers, DHT, and PEX are not implemented — magnet links therefore need
-at least one ``tr=`` HTTP tracker.  The package also includes a
+Scope: the BitTorrent peer wire protocol with the ut_metadata extension
+(BEP 3/9/10, compact peers BEP 23), HTTP(S) and UDP trackers (BEP 15),
+mainline DHT peer discovery (BEP 5), and ``x.pe`` direct peers — so magnet
+links resolve through trackers, the DHT, or explicit peers, matching
+webtorrent's discovery surface.  The package also includes a
 :class:`Seeder` (webtorrent seeds as well as leeches), which doubles as the
 hermetic swarm for tests.
 """
 
 from .bencode import bdecode, bencode
 from .client import TorrentClient
+from .dht import DHTNode
 from .magnet import MagnetLink, parse_magnet
 from .metainfo import Metainfo, make_metainfo
 from .seeder import Seeder
@@ -24,6 +26,7 @@ __all__ = [
     "bdecode",
     "bencode",
     "TorrentClient",
+    "DHTNode",
     "MagnetLink",
     "parse_magnet",
     "Metainfo",

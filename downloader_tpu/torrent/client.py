@@ -78,11 +78,17 @@ class _Swarm:
 
 
 class TorrentClient:
-    def __init__(self, logger=None, peer_id: Optional[bytes] = None):
+    def __init__(self, logger=None, peer_id: Optional[bytes] = None,
+                 dht=None):
+        """``dht`` is an optional started :class:`~.dht.DHTNode`; when set,
+        it is queried as an additional peer source next to trackers (the
+        reference's webtorrent does the same via bittorrent-dht,
+        /root/reference/lib/download.js:19,64)."""
         self.logger = logger
         self.peer_id = peer_id or (
             b"-DT0001-" + bytes(random.randrange(48, 58) for _ in range(12))
         )
+        self.dht = dht
 
     # ------------------------------------------------------------------
     async def download(
@@ -158,10 +164,15 @@ class TorrentClient:
                 peers = await self._announce_all(
                     magnet.trackers, magnet.info_hash, left=1
                 )
+                peers = self._merge_peers(
+                    peers,
+                    [tracker_mod.Peer(h, p) for h, p in magnet.peer_addrs],
+                    await self._dht_peers(magnet.info_hash),
+                )
             if not peers:
                 raise TorrentError(
-                    "magnet link needs reachable peers (HTTP trackers only; "
-                    "no DHT support)"
+                    "magnet link needs reachable peers (trackers, DHT, or "
+                    "x.pe all came up empty)"
                 )
             try:
                 async with asyncio.timeout(metadata_timeout):
@@ -182,10 +193,36 @@ class TorrentClient:
                 meta = parse_torrent_bytes(fh.read())
 
         if peers is None:
-            peers = await self._announce_all(
-                meta.trackers, meta.info_hash, left=meta.total_length
+            peers = self._merge_peers(
+                await self._announce_all(
+                    meta.trackers, meta.info_hash, left=meta.total_length
+                ),
+                await self._dht_peers(meta.info_hash),
             )
         return meta, peers
+
+    async def _dht_peers(self, info_hash: bytes) -> List[tracker_mod.Peer]:
+        if self.dht is None:
+            return []
+        try:
+            found = await self.dht.get_peers(info_hash)
+        except Exception as err:
+            self._log("dht lookup failed", error=str(err))
+            return []
+        if found:
+            self._log("dht peers found", count=len(found))
+        return found
+
+    @staticmethod
+    def _merge_peers(*groups) -> List[tracker_mod.Peer]:
+        seen = set()
+        out: List[tracker_mod.Peer] = []
+        for group in groups:
+            for peer in group:
+                if (peer.host, peer.port) not in seen:
+                    seen.add((peer.host, peer.port))
+                    out.append(peer)
+        return out
 
     async def _announce_all(self, trackers: List[str], info_hash: bytes,
                             left: int) -> List[tracker_mod.Peer]:

@@ -13,6 +13,10 @@ class MagnetLink:
     info_hash: bytes            # 20-byte SHA-1
     display_name: Optional[str]
     trackers: List[str]
+    # x.pe direct peer addresses (BEP 9) as (host, port)
+    peer_addrs: tuple = ()
+    # ws= webseed URLs (BEP 19 via magnet)
+    webseeds: tuple = ()
 
     @property
     def info_hash_hex(self) -> str:
@@ -40,10 +44,19 @@ def parse_magnet(uri: str) -> MagnetLink:
         raise ValueError("magnet URI has no urn:btih exact topic")
 
     names = params.get("dn", [])
+    peer_addrs = []
+    for pe in params.get("x.pe", []):
+        host, _, port = pe.rpartition(":")
+        try:
+            peer_addrs.append((host, int(port)))
+        except ValueError:
+            continue
     return MagnetLink(
         info_hash=info_hash,
         display_name=names[0] if names else None,
         trackers=params.get("tr", []),
+        peer_addrs=tuple(peer_addrs),
+        webseeds=tuple(params.get("ws", [])),
     )
 
 

@@ -113,10 +113,7 @@ async def announce_http(
     peers = data.get(b"peers", b"")
     out: List[Peer] = []
     if isinstance(peers, bytes):  # compact: 6 bytes per peer
-        for i in range(0, len(peers) - len(peers) % 6, 6):
-            host = socket.inet_ntoa(peers[i:i + 4])
-            (peer_port,) = struct.unpack(">H", peers[i + 4:i + 6])
-            out.append(Peer(host, peer_port))
+        out.extend(parse_compact_peers(peers))
     else:  # non-compact dict form
         for entry in peers:
             out.append(
@@ -160,13 +157,22 @@ class _UdpTrackerProtocol(asyncio.DatagramProtocol):
         self.waiters.clear()
 
 
-def _parse_compact_peers(blob: bytes) -> List[Peer]:
+def parse_compact_peers(blob: bytes) -> List[Peer]:
+    """BEP 23 compact peers: 4-byte IP + 2-byte port each, concatenated.
+
+    The single parser for every compact-peer surface (HTTP tracker, UDP
+    tracker, DHT ``values``).  Port-0 entries are dropped — unconnectable.
+    """
     out = []
     for i in range(0, len(blob) - len(blob) % 6, 6):
         host = socket.inet_ntoa(blob[i:i + 4])
         (peer_port,) = struct.unpack(">H", blob[i + 4:i + 6])
-        out.append(Peer(host, peer_port))
+        if peer_port:
+            out.append(Peer(host, peer_port))
     return out
+
+
+_parse_compact_peers = parse_compact_peers  # backwards-compatible alias
 
 
 async def announce_udp(

@@ -1,0 +1,233 @@
+"""Mainline DHT (BEP 5) tests: KRPC wire formats, routing table, the
+get_peers/announce_peer flow between live UDP nodes, and a fully hermetic
+trackerless magnet download (reference capability: webtorrent's bundled
+bittorrent-dht, /root/reference/lib/download.js:19,64)."""
+
+import hashlib
+import os
+
+import pytest
+
+from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
+from downloader_tpu.torrent.dht import (
+    DHTError,
+    DHTNode,
+    NodeInfo,
+    RoutingTable,
+    pack_nodes,
+    pack_peers,
+    parse_bootstrap,
+    unpack_nodes,
+    unpack_peers,
+    xor_distance,
+)
+from downloader_tpu.torrent.magnet import make_magnet, parse_magnet
+from downloader_tpu.torrent.tracker import Peer
+
+from test_torrent import make_payload_dir  # noqa: F401  (helper reuse)
+
+pytestmark = pytest.mark.anyio
+
+
+# -- compact encodings --------------------------------------------------
+def test_compact_node_roundtrip():
+    nodes = [
+        NodeInfo(os.urandom(20), "10.1.2.3", 6881),
+        NodeInfo(os.urandom(20), "192.168.0.9", 51413),
+    ]
+    assert unpack_nodes(pack_nodes(nodes)) == nodes
+
+
+def test_compact_node_skips_hostnames_and_zero_ports():
+    nodes = [NodeInfo(os.urandom(20), "not-an-ip.example", 6881)]
+    assert pack_nodes(nodes) == b""
+    # zero port entries are dropped on decode
+    blob = pack_nodes([NodeInfo(b"\x01" * 20, "1.2.3.4", 1)])
+    assert unpack_nodes(blob[:-2] + b"\x00\x00") == []
+
+
+def test_compact_peer_roundtrip():
+    peers = [("10.0.0.1", 6881), ("127.0.0.1", 9000)]
+    assert unpack_peers(pack_peers(peers)) == [Peer(h, p) for h, p in peers]
+
+
+def test_unpack_peers_ignores_malformed_values():
+    assert unpack_peers([b"short", 42, b"\x01\x02\x03\x04\x00\x00"]) == []
+
+
+def test_parse_bootstrap():
+    assert parse_bootstrap("router.example:6881, 10.0.0.1:999") == [
+        ("router.example", 6881),
+        ("10.0.0.1", 999),
+    ]
+    with pytest.raises(DHTError):
+        parse_bootstrap("no-port-here")
+
+
+# -- routing table ------------------------------------------------------
+def test_routing_table_orders_by_xor_distance():
+    own = b"\x00" * 20
+    table = RoutingTable(own)
+    near = NodeInfo(b"\x00" * 19 + b"\x01", "1.1.1.1", 1)
+    far = NodeInfo(b"\xff" * 20, "2.2.2.2", 2)
+    table.add(far)
+    table.add(near)
+    assert table.closest(own, 2) == [near, far]
+    assert xor_distance(own, near.node_id) == 1
+
+
+def test_routing_table_ignores_self_and_caps_buckets():
+    own = os.urandom(20)
+    table = RoutingTable(own, k=2)
+    table.add(NodeInfo(own, "9.9.9.9", 9))
+    assert len(table) == 0
+    # same top bit => same bucket; third node is dropped while residents
+    # are fresh
+    base = bytearray(b"\x80" + b"\x00" * 19)
+    for i in range(3):
+        node_id = bytes(base[:19]) + bytes([i + 1])
+        table.add(NodeInfo(node_id, "1.0.0.1", 1000 + i))
+    assert len(table) == 2
+
+
+def test_routing_table_refreshes_known_node_address():
+    own = b"\x00" * 20
+    table = RoutingTable(own)
+    node_id = b"\x01" * 20
+    table.add(NodeInfo(node_id, "1.1.1.1", 1))
+    table.add(NodeInfo(node_id, "2.2.2.2", 2))
+    assert len(table) == 1
+    assert table.closest(own)[0].host == "2.2.2.2"
+
+
+# -- live KRPC ----------------------------------------------------------
+@pytest.fixture
+async def dht_pair():
+    a, b = DHTNode(), DHTNode()
+    await a.start("127.0.0.1")
+    await b.start("127.0.0.1")
+    yield a, b
+    await a.close()
+    await b.close()
+
+
+async def test_ping_populates_both_tables(dht_pair):
+    a, b = dht_pair
+    assert await a.bootstrap([("127.0.0.1", b.port)]) >= 1
+    assert len(a.table) >= 1
+    assert len(b.table) >= 1  # b learned a from the inbound query
+
+
+async def test_bootstrap_survives_dead_routers():
+    node = DHTNode()
+    await node.start("127.0.0.1")
+    try:
+        # 127.0.0.1:1 — nothing listening; must not raise
+        assert await node.bootstrap([("127.0.0.1", 1)]) == 0
+    finally:
+        await node.close()
+
+
+async def test_get_peers_and_announce_flow(dht_pair):
+    a, b = dht_pair
+    info_hash = hashlib.sha1(b"some torrent").digest()
+    await a.bootstrap([("127.0.0.1", b.port)])
+
+    # nothing announced yet
+    assert await a.get_peers(info_hash) == []
+
+    # a announces itself for the hash; b stores (a's ip, announced port)
+    assert await a.announce(info_hash, port=7001) >= 1
+
+    c = DHTNode()
+    await c.start("127.0.0.1")
+    try:
+        await c.bootstrap([("127.0.0.1", b.port)])
+        peers = await c.get_peers(info_hash)
+        assert Peer("127.0.0.1", 7001) in peers
+    finally:
+        await c.close()
+
+
+async def test_announce_with_bad_token_rejected(dht_pair):
+    a, b = dht_pair
+    info_hash = hashlib.sha1(b"t").digest()
+    with pytest.raises((DHTError, TimeoutError)):
+        await a._query(("127.0.0.1", b.port), b"announce_peer", {
+            b"info_hash": info_hash,
+            b"port": 7001,
+            b"token": b"forged!!",
+        })
+    assert await a.get_peers(info_hash) == []
+
+
+async def test_unknown_method_gets_krpc_error(dht_pair):
+    a, b = dht_pair
+    with pytest.raises(DHTError):
+        await a._query(("127.0.0.1", b.port), b"flood", {})
+
+
+async def test_malformed_datagrams_ignored(dht_pair):
+    a, b = dht_pair
+    # garbage, non-dict bencode, and a query with junk args: none may kill
+    # the node, and it must still answer pings afterwards
+    for junk in (b"\xff\xfe", b"le", b"d1:y1:qe"):
+        a.transport.sendto(junk, ("127.0.0.1", b.port))
+    resp = await a._query(("127.0.0.1", b.port), b"ping", {})
+    assert resp[b"id"] == b.node_id
+
+
+# -- magnet extensions fed by DHT/webseed surfaces ----------------------
+def test_magnet_parses_xpe_and_ws():
+    info_hash = hashlib.sha1(b"m").digest()
+    uri = (
+        f"magnet:?xt=urn:btih:{info_hash.hex()}"
+        "&x.pe=127.0.0.1:7005&x.pe=10.0.0.2:6881&x.pe=bogus"
+        "&ws=http%3A%2F%2Fcdn.example%2Fpayload%2F"
+    )
+    magnet = parse_magnet(uri)
+    assert magnet.peer_addrs == (("127.0.0.1", 7005), ("10.0.0.2", 6881))
+    assert magnet.webseeds == ("http://cdn.example/payload/",)
+
+
+# -- end-to-end: trackerless magnet via DHT -----------------------------
+async def test_trackerless_magnet_download_via_dht(tmp_path):
+    src, files = make_payload_dir(tmp_path, [120_000, 40_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent))
+    seed_port = await seeder.start()
+
+    router = DHTNode()
+    await router.start("127.0.0.1")
+    announcer = DHTNode()
+    await announcer.start("127.0.0.1")
+    client_node = DHTNode()
+    await client_node.start("127.0.0.1")
+    try:
+        await announcer.bootstrap([("127.0.0.1", router.port)])
+        assert await announcer.announce(meta.info_hash, port=seed_port) >= 1
+
+        await client_node.bootstrap([("127.0.0.1", router.port)])
+        client = TorrentClient(dht=client_node)
+        magnet_uri = make_magnet(meta.info_hash, meta.name)  # NO trackers
+        dest = tmp_path / "out"
+        got = await client.download(
+            magnet_uri, str(dest), metadata_timeout=30, stall_timeout=30,
+            progress_interval=0.2,
+        )
+        assert got.info_hash == meta.info_hash
+        for rel, data in files.items():
+            assert (dest / meta.name / rel).read_bytes() == data
+    finally:
+        await seeder.stop()
+        for node in (router, announcer, client_node):
+            await node.close()
+
+
+async def test_client_merges_tracker_and_dht_peers(dht_pair):
+    a, b = dht_pair
+    merged = TorrentClient._merge_peers(
+        [Peer("1.1.1.1", 1), Peer("2.2.2.2", 2)],
+        [Peer("2.2.2.2", 2), Peer("3.3.3.3", 3)],
+    )
+    assert merged == [Peer("1.1.1.1", 1), Peer("2.2.2.2", 2), Peer("3.3.3.3", 3)]

@@ -370,7 +370,8 @@ def test_weak_etag_rejected_unit(tmp_path):
     from downloader_tpu.stages.download import choose_validator
 
     lm = "Mon, 01 Jan 2024 00:00:00 GMT"
-    later = "Mon, 01 Jan 2024 00:00:05 GMT"
+    later = "Mon, 01 Jan 2024 00:02:05 GMT"
+    barely = "Mon, 01 Jan 2024 00:00:05 GMT"
 
     assert choose_validator({"ETag": 'W/"weak"'}) is None
     # a weak ETag means the origin admits byte-level ambiguity: no resume
@@ -380,8 +381,10 @@ def test_weak_etag_rejected_unit(tmp_path):
     ) is None
     assert choose_validator({"ETag": '"strong"'}) == '"strong"'
     assert choose_validator({}) is None
-    # Last-Modified counts as strong only when >=1s older than Date
+    # Last-Modified counts as strong only when >=60s older than Date
+    # (RFC 7232 §2.2.2: outside the clock-skew/regeneration window)
     assert choose_validator({"Last-Modified": lm, "Date": later}) == lm
+    assert choose_validator({"Last-Modified": lm, "Date": barely}) is None
     assert choose_validator({"Last-Modified": lm, "Date": lm}) is None
     assert choose_validator({"Last-Modified": lm}) is None  # no Date header
 
