@@ -139,6 +139,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             except BaseException:
                 await node.close()
                 raise
+            if found == 0:
+                # transient DNS/network failure must not memoize a dead
+                # node for the process lifetime — retry on the next job
+                logger.warn("dht bootstrap found no routers; will retry")
+                await node.close()
+                return None
             logger.info("dht bootstrapped", routing_table=found)
             ctx.resources["dht_node"] = node
             ctx.cleanups.append(node.close)

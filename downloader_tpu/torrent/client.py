@@ -20,6 +20,7 @@ import asyncio
 import hashlib
 import random
 import struct
+from collections import Counter
 from typing import Awaitable, Callable, List, Optional, Set
 
 import aiohttp
@@ -36,6 +37,12 @@ ProgressCb = Callable[[float], Awaitable[None]]
 CONNECT_TIMEOUT = 10.0
 PIPELINE_DEPTH = 16
 MAX_PEERS = 8
+# biggest file we'll accept from a webseed that ignores Range requests —
+# without ranges every piece re-streams the file prefix (quadratic)
+WEBSEED_NO_RANGE_MAX = 32 << 20
+# worker/session cap, like MAX_PEERS — a hostile url-list must not be able
+# to spawn one task + HTTP session per entry
+MAX_WEBSEEDS = 4
 
 
 class TorrentError(RuntimeError):
@@ -43,7 +50,15 @@ class TorrentError(RuntimeError):
 
 
 class _Swarm:
-    """Shared download state across peer workers."""
+    """Shared download state across peer workers.
+
+    Piece selection is rarest-first (classic BitTorrent: pick the piece the
+    fewest connected peers advertise, so rare pieces replicate before their
+    holders leave), with piece index as the deterministic tie-break.  When
+    every piece is either done or in flight, the swarm enters endgame mode
+    (BEP 3): idle workers duplicate-request in-flight pieces so one slow
+    peer cannot stall the tail of the download.
+    """
 
     def __init__(self, meta: Metainfo):
         self.meta = meta
@@ -52,29 +67,53 @@ class _Swarm:
         self.done: Set[int] = set()
         self.bytes_done = 0
         self.piece_event = asyncio.Event()
+        # piece index -> number of connected peers advertising it
+        self.availability: Counter = Counter()
+        self.endgame = False
 
     @property
     def complete(self) -> bool:
         return len(self.done) == self.meta.num_pieces
 
+    def _rarest(self, candidates: Set[int]) -> int:
+        return min(candidates, key=lambda p: (self.availability[p], p))
+
     def claim(self, have: Set[int]) -> Optional[int]:
         candidates = self.pending & have
-        if not candidates:
-            return None
-        piece = min(candidates)  # sequential-ish: good for media files
-        self.pending.discard(piece)
-        self.claimed.add(piece)
-        return piece
+        if candidates:
+            piece = self._rarest(candidates)
+            self.pending.discard(piece)
+            self.claimed.add(piece)
+            return piece
+        if not self.pending and self.claimed:
+            # endgame: everything is in flight — duplicate-request an
+            # unfinished claimed piece this peer has (requests for it stay
+            # live on both workers; the loser cancels on the finish event)
+            in_flight = (self.claimed - self.done) & have
+            if in_flight:
+                self.endgame = True
+                return self._rarest(in_flight)
+        return None
 
     def release(self, piece: int) -> None:
+        if piece in self.done:
+            return  # endgame duplicate: another worker already finished it
         self.claimed.discard(piece)
         self.pending.add(piece)
 
-    def finish(self, piece: int) -> None:
+    def finish(self, piece: int) -> bool:
+        """Mark ``piece`` verified+written. False if it was already done
+        (an endgame duplicate landed second — caller must not re-write)."""
+        if piece in self.done:
+            return False
         self.claimed.discard(piece)
+        # a dying endgame duplicate may have release()d it back to pending
+        # before the winner finished — don't let it be claimed again
+        self.pending.discard(piece)
         self.done.add(piece)
         self.bytes_done += self.meta.piece_size(piece)
         self.piece_event.set()
+        return True
 
 
 class TorrentClient:
@@ -117,7 +156,8 @@ class TorrentClient:
                 await on_progress(1.0)
             return meta
 
-        if not peers:
+        webseeds = self._webseed_urls(uri, meta)
+        if not peers and not webseeds:
             raise TorrentError("no peers available")
 
         watchdog = StallWatchdog(stall_timeout)
@@ -129,13 +169,17 @@ class TorrentClient:
             )
             workers = [
                 asyncio.create_task(self._peer_worker(addr, storage, swarm))
-                for addr in peers[:MAX_PEERS]
+                for addr in (peers or [])[:MAX_PEERS]
+            ] + [
+                asyncio.create_task(self._webseed_worker(url, storage, swarm))
+                for url in webseeds[:MAX_WEBSEEDS]
             ]
             try:
                 while not swarm.complete:
                     if all(w.done() for w in workers):
                         raise TorrentError(
-                            "all peer connections failed with pieces remaining"
+                            "all peer/webseed sources failed with pieces "
+                            "remaining"
                         )
                     try:
                         async with asyncio.timeout(0.5):
@@ -161,13 +205,18 @@ class TorrentClient:
         if uri.startswith("magnet:"):
             magnet = parse_magnet(uri)
             if peers is None:
-                peers = await self._announce_all(
-                    magnet.trackers, magnet.info_hash, left=1
+                # trackers and DHT are independent sources — overlap them
+                # so slow/dead trackers don't serialize in front of the DHT
+                tracker_peers, dht_peers = await asyncio.gather(
+                    self._announce_all(
+                        magnet.trackers, magnet.info_hash, left=1
+                    ),
+                    self._dht_peers(magnet.info_hash),
                 )
                 peers = self._merge_peers(
-                    peers,
+                    tracker_peers,
                     [tracker_mod.Peer(h, p) for h, p in magnet.peer_addrs],
-                    await self._dht_peers(magnet.info_hash),
+                    dht_peers,
                 )
             if not peers:
                 raise TorrentError(
@@ -193,12 +242,13 @@ class TorrentClient:
                 meta = parse_torrent_bytes(fh.read())
 
         if peers is None:
-            peers = self._merge_peers(
-                await self._announce_all(
+            tracker_peers, dht_peers = await asyncio.gather(
+                self._announce_all(
                     meta.trackers, meta.info_hash, left=meta.total_length
                 ),
-                await self._dht_peers(meta.info_hash),
+                self._dht_peers(meta.info_hash),
             )
+            peers = self._merge_peers(tracker_peers, dht_peers)
         return meta, peers
 
     async def _dht_peers(self, info_hash: bytes) -> List[tracker_mod.Peer]:
@@ -226,20 +276,15 @@ class TorrentClient:
 
     async def _announce_all(self, trackers: List[str], info_hash: bytes,
                             left: int) -> List[tracker_mod.Peer]:
-        seen = set()
+        # dedup is owned by _merge_peers at the call sites
         out: List[tracker_mod.Peer] = []
         for url in trackers:
             try:
-                found = await tracker_mod.announce(
+                out.extend(await tracker_mod.announce(
                     url, info_hash, self.peer_id, port=6881, left=left
-                )
+                ))
             except Exception as err:
                 self._log("tracker announce failed", tracker=url, error=str(err))
-                continue
-            for peer in found:
-                if (peer.host, peer.port) not in seen:
-                    seen.add((peer.host, peer.port))
-                    out.append(peer)
         return out
 
     # -- metadata over ut_metadata (BEP 9) ------------------------------
@@ -284,6 +329,131 @@ class TorrentClient:
             return parse_info_dict(info_bytes, magnet.trackers)
         finally:
             await peer.close()
+
+    # -- webseeds (BEP 19) ----------------------------------------------
+    @staticmethod
+    def _webseed_urls(uri: str, meta: Metainfo) -> List[str]:
+        """HTTP seed URLs: ``url-list`` from the .torrent plus ``ws=`` from
+        the magnet (both deduped, http(s) only)."""
+        urls = list(meta.webseeds)
+        if uri.startswith("magnet:"):
+            try:
+                for url in parse_magnet(uri).webseeds:
+                    if url not in urls:
+                        urls.append(url)
+            except ValueError:
+                pass
+        return [u for u in urls if u.startswith(("http://", "https://"))]
+
+    @staticmethod
+    def _webseed_file_url(base: str, meta: Metainfo, entry) -> str:
+        """BEP 19 URL construction: a base ending in ``/`` is a directory —
+        append the torrent-relative path (which already starts with the
+        torrent name); otherwise, for single-file torrents, the URL IS the
+        file."""
+        from urllib.parse import quote
+
+        if len(meta.files) == 1 and not base.endswith("/"):
+            return base
+        prefix = base if base.endswith("/") else base + "/"
+        return prefix + "/".join(quote(part) for part in entry.path.split("/"))
+
+    async def _fetch_webseed_piece(self, session, base: str, meta: Metainfo,
+                                   piece: int) -> bytes:
+        """Fetch one piece over HTTP Range requests, spanning file
+        boundaries in multi-file torrents."""
+        start = piece * meta.piece_length
+        end = start + meta.piece_size(piece)
+        out = bytearray()
+        for entry in meta.files:
+            lo = max(start, entry.offset)
+            hi = min(end, entry.offset + entry.length)
+            if lo >= hi:
+                continue
+            url = self._webseed_file_url(base, meta, entry)
+            file_lo, file_hi = lo - entry.offset, hi - entry.offset
+            headers = {"Range": f"bytes={file_lo}-{file_hi - 1}"}
+            async with asyncio.timeout(60):
+                async with session.get(url, headers=headers) as resp:
+                    if resp.status not in (200, 206):
+                        raise OSError(f"webseed HTTP {resp.status} for {url}")
+                    if resp.status == 206:
+                        body = await resp.read()
+                    else:
+                        # server ignored Range: stream-slice the span out of
+                        # the full body (bounded memory) and abort the rest.
+                        # Viable only for small files — per-piece prefix
+                        # re-transfer is quadratic, so retire big seeds.
+                        if entry.length > WEBSEED_NO_RANGE_MAX:
+                            raise OSError(
+                                f"webseed ignores Range and file is "
+                                f"{entry.length} bytes; retiring {url}"
+                            )
+                        body = await self._stream_slice(resp, file_lo, file_hi)
+            if len(body) != hi - lo:
+                raise OSError(
+                    f"webseed short read: wanted {hi - lo}, got {len(body)}"
+                )
+            out += body
+        return bytes(out)
+
+    @staticmethod
+    async def _stream_slice(resp, lo: int, hi: int) -> bytes:
+        """Collect bytes [lo, hi) from a streaming response body without
+        buffering the whole payload; closes the connection early once hi is
+        reached."""
+        got = bytearray()
+        offset = 0
+        async for chunk in resp.content.iter_chunked(1 << 16):
+            start = max(lo - offset, 0)
+            end = min(hi - offset, len(chunk))
+            if start < end:
+                got += chunk[start:end]
+            offset += len(chunk)
+            if offset >= hi:
+                break
+        return bytes(got)
+
+    async def _webseed_worker(self, base_url: str, storage: TorrentStorage,
+                              swarm: _Swarm) -> None:
+        """Drains the swarm from an HTTP seed; participates in claim/release
+        and endgame exactly like a peer worker (have = everything)."""
+        meta = swarm.meta
+        have = set(range(meta.num_pieces))
+        failures = 0
+        async with aiohttp.ClientSession() as session:
+            while not swarm.complete:
+                piece = swarm.claim(have)
+                if piece is None:
+                    await asyncio.sleep(0.2)  # wait for a release or endgame
+                    continue
+                try:
+                    data = await self._fetch_webseed_piece(
+                        session, base_url, meta, piece
+                    )
+                except (aiohttp.ClientError, TimeoutError, OSError) as err:
+                    swarm.release(piece)
+                    failures += 1
+                    self._log("webseed fetch failed", url=base_url,
+                              piece=piece, error=str(err))
+                    if failures >= 3:
+                        return  # dead seed: leave the swarm to the peers
+                    await asyncio.sleep(min(2 ** failures, 10.0))
+                    continue
+                if hashlib.sha1(data).digest() == meta.piece_hashes[piece]:
+                    failures = 0  # consecutive, not cumulative: a healthy
+                    # seed must survive rare transient errors over a long
+                    # webseed-only download
+                    if piece not in swarm.done:  # endgame duplicate guard
+                        storage.write_piece(piece, data)
+                        swarm.finish(piece)
+                else:
+                    self._log("webseed piece hash mismatch", piece=piece,
+                              url=base_url)
+                    swarm.release(piece)
+                    failures += 1
+                    if failures >= 3:
+                        return
 
     # -- resume ---------------------------------------------------------
     async def _resume_from_disk(self, storage: TorrentStorage, swarm: _Swarm) -> None:
@@ -359,8 +529,23 @@ class TorrentClient:
         def _blocks(piece: int) -> List[int]:
             return list(range(0, meta.piece_size(piece), BLOCK_SIZE))
 
+        async def _abandon_if_done_elsewhere() -> None:
+            # endgame: another worker finished our piece first — cancel the
+            # in-flight requests (BEP 3) and free this peer for other work
+            nonlocal claimed, buffer, received, requested
+            if claimed is None or claimed not in swarm.done:
+                return
+            for begin in requested - received:
+                length = min(BLOCK_SIZE, meta.piece_size(claimed) - begin)
+                await peer.send_cancel(claimed, begin, length)
+            claimed = None
+            buffer = None
+            received = set()
+            requested = set()
+
         async def _pump_requests() -> None:
             nonlocal claimed, buffer, received, requested
+            await _abandon_if_done_elsewhere()
             if choked:
                 return
             if claimed is None:
@@ -396,13 +581,17 @@ class TorrentClient:
                 if msg_id is None:
                     continue
                 if msg_id == wire.MSG_BITFIELD:
-                    have |= wire.parse_bitfield(payload, meta.num_pieces)
+                    fresh = wire.parse_bitfield(payload, meta.num_pieces) - have
+                    have |= fresh
+                    swarm.availability.update(fresh)
                     if not interested_sent:
                         await peer.send_message(wire.MSG_INTERESTED)
                         interested_sent = True
                 elif msg_id == wire.MSG_HAVE:
                     (index,) = struct.unpack(">I", payload)
-                    have.add(index)
+                    if index not in have:
+                        have.add(index)
+                        swarm.availability[index] += 1
                     if not interested_sent:
                         await peer.send_message(wire.MSG_INTERESTED)
                         interested_sent = True
@@ -428,8 +617,12 @@ class TorrentClient:
                         piece_bytes = bytes(buffer)
                         digest = hashlib.sha1(piece_bytes).digest()
                         if digest == meta.piece_hashes[claimed]:
-                            storage.write_piece(claimed, piece_bytes)
-                            swarm.finish(claimed)
+                            # skip when an endgame duplicate landed second —
+                            # the winner already wrote it (no await between
+                            # the check and finish, so this is atomic)
+                            if claimed not in swarm.done:
+                                storage.write_piece(claimed, piece_bytes)
+                                swarm.finish(claimed)
                         else:
                             self._log("piece hash mismatch", piece=claimed)
                             swarm.release(claimed)
@@ -444,6 +637,8 @@ class TorrentClient:
         finally:
             if claimed is not None:
                 swarm.release(claimed)
+            # this peer's copies no longer count toward piece availability
+            swarm.availability.subtract(have)
             await peer.close()
 
     def _log(self, msg: str, **extra) -> None:

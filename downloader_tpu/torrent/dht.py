@@ -14,9 +14,10 @@ implementation of the same protocol:
 - a bounded per-infohash peer store for the server side
 
 The torrent client uses :meth:`DHTNode.get_peers` as an additional peer
-source next to tracker announces, and :meth:`DHTNode.announce` to register
-itself, mirroring webtorrent's behavior for magnets with no (or dead)
-trackers.
+source next to tracker announces, covering magnets with no (or dead)
+trackers.  :meth:`DHTNode.announce` is the write side; the leeching client
+does not call it (it serves no incoming peer connections — serving is the
+:class:`~.seeder.Seeder`'s job, which advertises via trackers).
 """
 
 from __future__ import annotations
@@ -194,8 +195,8 @@ class DHTNode:
         self.logger = logger
         self.table = RoutingTable(self.node_id)
         self.transport: Optional[asyncio.DatagramTransport] = None
-        self._pending: Dict[bytes, asyncio.Future] = {}
-        self._txn = 0
+        # txn -> (future, addr the query was sent to)
+        self._pending: Dict[bytes, Tuple[asyncio.Future, Tuple[str, int]]] = {}
         self._secret = os.urandom(16)
         self._prev_secret = self._secret
         self._secret_rotated = time.monotonic()
@@ -219,7 +220,7 @@ class DHTNode:
         if self.transport is not None:
             self.transport.close()
             self.transport = None
-        for fut in self._pending.values():
+        for fut, _addr in self._pending.values():
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
@@ -227,11 +228,16 @@ class DHTNode:
     async def bootstrap(self, nodes: Iterable[Tuple[str, int]]) -> int:
         """Ping the given routers and walk toward our own id to fill the
         table.  Returns the resulting routing-table size."""
-        for addr in nodes:
+        async def _ping(addr) -> None:
             try:
                 await self._query(addr, b"ping", {})
             except (DHTError, asyncio.TimeoutError, OSError):
-                continue
+                pass
+
+        # independent UDP round-trips: ping in parallel so dead routers
+        # don't serialize their timeouts (this also runs under the
+        # cross-job dht lock in the download stage)
+        await asyncio.gather(*(_ping(addr) for addr in nodes))
         if len(self.table):
             await self._lookup(self.node_id, want_peers=False)
         return len(self.table)
@@ -319,6 +325,10 @@ class DHTNode:
                 except (DHTError, asyncio.TimeoutError, OSError):
                     return
                 node_id = resp.get(b"id", node.node_id)
+                if not (isinstance(node_id, bytes) and len(node_id) == 20):
+                    # untrusted wire data: a non-bytes/odd-length id would
+                    # blow up xor_distance below — fall back to what we knew
+                    node_id = node.node_id
                 info = NodeInfo(node_id, node.host, node.port)
                 responded[node_id] = info
                 tokens[node_id] = resp.get(b"token")
@@ -338,19 +348,25 @@ class DHTNode:
 
     # -- KRPC client -----------------------------------------------------
     def _next_txn(self) -> bytes:
-        self._txn = (self._txn + 1) % 0xFFFF
-        return struct.pack(">H", self._txn)
+        # random (not sequential) so off-path attackers can't predict the
+        # next transaction id and forge responses
+        while True:
+            txn = os.urandom(2)
+            if txn not in self._pending:
+                return txn
 
     async def _query(self, addr: Tuple[str, int], method: bytes,
                      args: dict) -> dict:
         if self.transport is None:
             raise DHTError("node not started")
+        addr = await self._resolve_addr(addr)
         txn = self._next_txn()
         payload = dict(args)
         payload[b"id"] = self.node_id
         msg = bencode({b"t": txn, b"y": b"q", b"q": method, b"a": payload})
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[txn] = fut
+        # remember who we asked: responses are only accepted from that addr
+        self._pending[txn] = (fut, addr)
         try:
             self.transport.sendto(msg, addr)
             async with asyncio.timeout(QUERY_TIMEOUT):
@@ -364,6 +380,20 @@ class DHTNode:
             self.table.add(NodeInfo(node_id, addr[0], addr[1]))
         return resp
 
+    @staticmethod
+    async def _resolve_addr(addr: Tuple[str, int]) -> Tuple[str, int]:
+        """Hostname -> literal IP, so reply-source matching works (datagram
+        sources always arrive as literal addresses)."""
+        try:
+            socket.inet_aton(addr[0])
+            return addr
+        except OSError:
+            pass
+        infos = await asyncio.get_running_loop().getaddrinfo(
+            addr[0], addr[1], type=socket.SOCK_DGRAM, family=socket.AF_INET
+        )
+        return infos[0][4][0], addr[1]
+
     # -- KRPC server -----------------------------------------------------
     def _on_datagram(self, data: bytes, addr) -> None:
         try:
@@ -374,23 +404,35 @@ class DHTNode:
             return
         kind = msg.get(b"y")
         if kind == b"r":
-            self._on_response(msg)
+            self._on_response(msg, addr)
         elif kind == b"q":
             try:
                 self._on_query(msg, addr)
             except Exception as err:  # malformed queries must not kill the loop
                 self._log("dht query handling failed", error=str(err))
         elif kind == b"e":
-            txn = msg.get(b"t")
-            fut = self._pending.get(txn) if isinstance(txn, bytes) else None
-            if fut is not None and not fut.done():
+            fut = self._match_pending(msg, addr)
+            if fut is not None:
                 err = msg.get(b"e", [201, b"error"])
                 fut.set_exception(DHTError(f"remote error {err!r}"))
 
-    def _on_response(self, msg: dict) -> None:
+    def _match_pending(self, msg: dict, addr) -> Optional[asyncio.Future]:
+        """Resolve a reply to its pending query — only if the source address
+        matches where the query went (BEP 5 forgery defence)."""
         txn = msg.get(b"t")
-        fut = self._pending.get(txn) if isinstance(txn, bytes) else None
-        if fut is None or fut.done():
+        entry = self._pending.get(txn) if isinstance(txn, bytes) else None
+        if entry is None:
+            return None
+        fut, queried_addr = entry
+        if (addr[0], addr[1]) != queried_addr:
+            self._log("dht reply from unexpected address dropped",
+                      expected=str(queried_addr), got=str(addr))
+            return None
+        return fut if not fut.done() else None
+
+    def _on_response(self, msg: dict, addr) -> None:
+        fut = self._match_pending(msg, addr)
+        if fut is None:
             return
         resp = msg.get(b"r")
         if isinstance(resp, dict):
@@ -459,7 +501,15 @@ class DHTNode:
     # -- tokens (BEP 5: opaque write token bound to requester IP) --------
     def _rotate_secrets(self) -> None:
         now = time.monotonic()
-        if now - self._secret_rotated > TOKEN_ROTATE_S:
+        elapsed = now - self._secret_rotated
+        if elapsed > 2 * TOKEN_ROTATE_S:
+            # idle gap longer than a full rotation cycle: a single-step
+            # rotation would keep arbitrarily old tokens valid via
+            # _prev_secret — retire both secrets outright
+            self._secret = os.urandom(16)
+            self._prev_secret = self._secret
+            self._secret_rotated = now
+        elif elapsed > TOKEN_ROTATE_S:
             self._prev_secret = self._secret
             self._secret = os.urandom(16)
             self._secret_rotated = now

@@ -48,9 +48,11 @@ def parse_magnet(uri: str) -> MagnetLink:
     for pe in params.get("x.pe", []):
         host, _, port = pe.rpartition(":")
         try:
-            peer_addrs.append((host, int(port)))
+            port_num = int(port)
         except ValueError:
             continue
+        if host and 0 < port_num < 65536:  # unconnectable ports waste a
+            peer_addrs.append((host, port_num))  # MAX_PEERS worker slot
     return MagnetLink(
         info_hash=info_hash,
         display_name=names[0] if names else None,

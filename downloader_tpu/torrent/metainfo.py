@@ -33,6 +33,8 @@ class Metainfo:
     files: List[FileEntry]
     info_bytes: bytes          # canonical bencoded info dict (for ut_metadata)
     trackers: List[str] = dataclasses.field(default_factory=list)
+    # BEP 19 HTTP seeds (``url-list`` in .torrent / ``ws=`` in magnets)
+    webseeds: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def total_length(self) -> int:
@@ -55,10 +57,13 @@ class Metainfo:
             data[b"announce"] = self.trackers[0].encode()
             if len(self.trackers) > 1:
                 data[b"announce-list"] = [[t.encode()] for t in self.trackers]
+        if self.webseeds:
+            data[b"url-list"] = [u.encode() for u in self.webseeds]
         return bencode(data)
 
 
-def parse_info_dict(info_bytes: bytes, trackers: Optional[List[str]] = None) -> Metainfo:
+def parse_info_dict(info_bytes: bytes, trackers: Optional[List[str]] = None,
+                    webseeds: Optional[List[str]] = None) -> Metainfo:
     """Build a :class:`Metainfo` from a bencoded info dict."""
     info = bdecode(info_bytes)
     canonical = bencode(info)
@@ -98,6 +103,7 @@ def parse_info_dict(info_bytes: bytes, trackers: Optional[List[str]] = None) -> 
         files=files,
         info_bytes=canonical,
         trackers=list(trackers or []),
+        webseeds=list(webseeds or []),
     )
 
 
@@ -115,7 +121,16 @@ def parse_torrent_bytes(data: bytes) -> Metainfo:
         url = outer[b"announce"].decode()
         if url not in trackers:
             trackers.insert(0, url)
-    return parse_info_dict(bencode(outer[b"info"]), trackers)
+    webseeds: List[str] = []
+    url_list = outer.get(b"url-list", [])
+    if isinstance(url_list, bytes):  # BEP 19 allows a bare string
+        url_list = [url_list]
+    for entry in url_list:
+        if isinstance(entry, bytes):
+            url = entry.decode("utf-8", "surrogateescape")
+            if url not in webseeds:
+                webseeds.append(url)
+    return parse_info_dict(bencode(outer[b"info"]), trackers, webseeds)
 
 
 def make_metainfo(
@@ -123,6 +138,7 @@ def make_metainfo(
     name: Optional[str] = None,
     piece_length: int = 1 << 18,
     trackers: Optional[List[str]] = None,
+    webseeds: Optional[List[str]] = None,
 ) -> Metainfo:
     """Create metainfo for a file or directory on disk (the seeding side).
 
@@ -195,4 +211,4 @@ def make_metainfo(
             b"pieces": pieces_blob,
             b"length": entries[0][1],
         }
-    return parse_info_dict(bencode(info), trackers)
+    return parse_info_dict(bencode(info), trackers, webseeds)

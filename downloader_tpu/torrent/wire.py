@@ -111,6 +111,9 @@ class PeerWire:
     async def send_request(self, index: int, begin: int, length: int) -> None:
         await self.send_message(MSG_REQUEST, struct.pack(">III", index, begin, length))
 
+    async def send_cancel(self, index: int, begin: int, length: int) -> None:
+        await self.send_message(MSG_CANCEL, struct.pack(">III", index, begin, length))
+
     async def send_piece(self, index: int, begin: int, data: bytes) -> None:
         await self.send_message(MSG_PIECE, struct.pack(">II", index, begin) + data)
 

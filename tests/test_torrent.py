@@ -321,3 +321,188 @@ async def test_download_via_udp_tracker(swarm, tmp_path):
         assert_downloaded(swarm, dest)
     finally:
         await udp.stop()
+
+
+# -- piece selection: rarest-first + endgame (BEP 3) --------------------
+def test_rarest_first_claim_order(tmp_path):
+    from downloader_tpu.torrent.client import _Swarm
+
+    src, _ = make_payload_dir(tmp_path, [4 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    assert meta.num_pieces >= 3
+    sw = _Swarm(meta)
+    sw.availability.update({0: 3, 1: 1, 2: 2})
+    have = {0, 1, 2}
+    assert sw.claim(have) == 1  # rarest
+    assert sw.claim(have) == 2
+    assert sw.claim(have) == 0  # most common last
+
+
+def test_rarest_first_tie_breaks_by_index(tmp_path):
+    from downloader_tpu.torrent.client import _Swarm
+
+    src, _ = make_payload_dir(tmp_path, [3 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    sw = _Swarm(meta)
+    assert sw.claim(set(range(meta.num_pieces))) == 0
+
+
+def test_endgame_duplicates_in_flight_pieces(tmp_path):
+    from downloader_tpu.torrent.client import _Swarm
+
+    src, _ = make_payload_dir(tmp_path, [2 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    sw = _Swarm(meta)
+    all_have = set(range(meta.num_pieces))
+    first = [sw.claim(all_have) for _ in range(meta.num_pieces)]
+    assert set(first) == all_have and not sw.pending
+    # everything is in flight: the next claim duplicates instead of None
+    dup = sw.claim(all_have)
+    assert dup in all_have
+    assert sw.endgame is True
+    # first completion wins; the duplicate is refused
+    assert sw.finish(dup) is True
+    assert sw.finish(dup) is False
+    # releasing a finished piece must NOT resurrect it as pending
+    sw.release(dup)
+    assert dup in sw.done and dup not in sw.pending
+    # a peer with nothing new offers no claim even in endgame
+    assert sw.claim(set()) is None
+
+
+def test_release_returns_piece_to_pending(tmp_path):
+    from downloader_tpu.torrent.client import _Swarm
+
+    src, _ = make_payload_dir(tmp_path, [2 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    sw = _Swarm(meta)
+    piece = sw.claim({0, 1})
+    sw.release(piece)
+    assert piece in sw.pending and piece not in sw.claimed
+
+
+# -- webseeds (BEP 19) --------------------------------------------------
+async def _start_webseed_server(root, support_range=True):
+    """Serve files under ``root`` at /{tail} with (optional) Range support."""
+    import re as _re
+
+    from aiohttp import web
+
+    from helpers import start_http_server
+
+    async def handler(request):
+        rel = request.match_info["tail"]
+        path = os.path.join(str(root), rel)
+        if not os.path.isfile(path):
+            return web.Response(status=404)
+        with open(path, "rb") as fh:
+            payload = fh.read()
+        rng = request.headers.get("Range")
+        if rng and support_range:
+            m = _re.fullmatch(r"bytes=(\d+)-(\d+)", rng)
+            lo, hi = int(m.group(1)), int(m.group(2))
+            return web.Response(status=206, body=payload[lo:hi + 1])
+        return web.Response(body=payload)
+
+    return await start_http_server(handler, path="/{tail:.+}")
+
+
+def test_webseed_url_construction(tmp_path):
+    src, _ = make_payload_dir(tmp_path, [1 << 14])
+    multi = make_metainfo(str(src), piece_length=1 << 14)
+    # directory-style base: torrent-relative path (incl. name) is appended
+    url = TorrentClient._webseed_file_url(
+        "http://ws.example/media/", multi, multi.files[0]
+    )
+    assert url == "http://ws.example/media/Great%20Show/S1/ep0.mkv"
+    # single-file torrent with a non-directory base: the URL IS the file
+    one = tmp_path / "Solo.mkv"
+    one.write_bytes(b"x" * (1 << 14))
+    single = make_metainfo(str(one), piece_length=1 << 14)
+    assert TorrentClient._webseed_file_url(
+        "http://ws.example/Solo.mkv", single, single.files[0]
+    ) == "http://ws.example/Solo.mkv"
+    assert TorrentClient._webseed_file_url(
+        "http://ws.example/dir/", single, single.files[0]
+    ) == "http://ws.example/dir/Solo.mkv"
+
+
+def test_url_list_roundtrip(tmp_path):
+    src, _ = make_payload_dir(tmp_path, [1 << 14])
+    meta = make_metainfo(str(src), piece_length=1 << 14,
+                         webseeds=["http://ws.example/media/"])
+    again = parse_torrent_bytes(meta.to_torrent_bytes())
+    assert again.webseeds == ["http://ws.example/media/"]
+    assert again.info_hash == meta.info_hash
+
+
+async def test_webseed_only_download(tmp_path):
+    """A torrent with no reachable peers downloads fully from its HTTP seed
+    (multi-file, pieces spanning file boundaries)."""
+    src, files = make_payload_dir(tmp_path, [3 * (1 << 14) + 5, 2 * (1 << 14) + 7])
+    runner, base = await _start_webseed_server(src.parent)
+    try:
+        meta = make_metainfo(str(src), piece_length=1 << 14,
+                             webseeds=[base + "/"])
+        torrent_file = tmp_path / "ws.torrent"
+        torrent_file.write_bytes(meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-ws")
+        client = TorrentClient()
+        got = await client.download(str(torrent_file), dest, peers=[])
+        assert got.info_hash == meta.info_hash
+        for name, data in files.items():
+            with open(os.path.join(dest, meta.name, name), "rb") as fh:
+                assert fh.read() == data
+    finally:
+        await runner.cleanup()
+
+
+async def test_webseed_without_range_support(tmp_path):
+    """A webseed that ignores Range (bare 200 + full body) still works."""
+    src, files = make_payload_dir(tmp_path, [2 * (1 << 14) + 3])
+    runner, base = await _start_webseed_server(src.parent, support_range=False)
+    try:
+        meta = make_metainfo(str(src), piece_length=1 << 14,
+                             webseeds=[base + "/"])
+        torrent_file = tmp_path / "ws.torrent"
+        torrent_file.write_bytes(meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-ws200")
+        got = await TorrentClient().download(str(torrent_file), dest, peers=[])
+        assert got.info_hash == meta.info_hash
+    finally:
+        await runner.cleanup()
+
+
+async def test_webseed_plus_peer_swarm(swarm, tmp_path):
+    """Webseed and live peer drain the same swarm together."""
+    runner, base = await _start_webseed_server(
+        tmp_path / "seed", support_range=True
+    )
+    try:
+        meta = make_metainfo(
+            str(tmp_path / "seed" / swarm.meta.name), piece_length=1 << 14,
+            trackers=[swarm.tracker_url], webseeds=[base + "/"],
+        )
+        torrent_file = tmp_path / "both.torrent"
+        torrent_file.write_bytes(meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-both")
+        got = await TorrentClient().download(str(torrent_file), dest)
+        assert got.info_hash == swarm.meta.info_hash
+        assert_downloaded(swarm, dest)
+    finally:
+        await runner.cleanup()
+
+
+async def test_dead_webseed_falls_back_to_peers(swarm, tmp_path):
+    """Three webseed failures retire the webseed worker; peers finish."""
+    meta = make_metainfo(
+        str(tmp_path / "seed" / swarm.meta.name), piece_length=1 << 14,
+        trackers=[swarm.tracker_url],
+        webseeds=["http://127.0.0.1:1/nothing/"],  # connection refused
+    )
+    torrent_file = tmp_path / "deadws.torrent"
+    torrent_file.write_bytes(meta.to_torrent_bytes())
+    dest = str(tmp_path / "dl-deadws")
+    got = await TorrentClient().download(str(torrent_file), dest)
+    assert got.info_hash == swarm.meta.info_hash
+    assert_downloaded(swarm, dest)
