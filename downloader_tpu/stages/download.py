@@ -166,6 +166,35 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         # unset means tracker-only discovery.
         client = TorrentClient(logger=logger, dht=await _shared_dht(logger))
 
+        # seed-while-leech: verified pieces are served back to the swarm
+        # during the download; SEED_LINGER/config.instance.seed_linger keeps
+        # serving that many seconds after completion so concurrent replicas
+        # staging the same torrent don't lose their source.  The reference
+        # removes the torrent on done (lib/download.js:110-120), so the
+        # parity default is 0.
+        raw_linger = os.environ.get("SEED_LINGER") or getattr(
+            ctx.config.instance, "seed_linger", 0
+        )
+        try:
+            seed_linger = float(raw_linger)
+        except (TypeError, ValueError):
+            seed_linger = 0.0
+        if seed_linger > 0:
+            # reap lingering servers at service shutdown
+            if "torrent_clients" not in ctx.resources:
+                ctx.resources["torrent_clients"] = []
+
+                async def _close_all() -> None:
+                    for c in ctx.resources["torrent_clients"]:
+                        await c.close()
+
+                ctx.cleanups.append(_close_all)
+            clients = ctx.resources["torrent_clients"]
+            # prune clients whose linger expired so the list stays bounded
+            # by concurrently-seeding jobs, not total jobs ever run
+            clients[:] = [c for c in clients if c.is_seeding]
+            clients.append(client)
+
         last_emitted = [None]
 
         async def on_progress(fraction: float) -> None:
@@ -183,6 +212,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             stall_timeout=STALL_TIMEOUT_SECONDS,
             progress_interval=PROGRESS_INTERVAL_SECONDS,
             on_progress=on_progress,
+            seed_linger=seed_linger,
         )
 
     async def http(resource_url: str, file_id: str, download_path: str, job: Job):

@@ -70,6 +70,10 @@ class _Swarm:
         # piece index -> number of connected peers advertising it
         self.availability: Counter = Counter()
         self.endgame = False
+        # ut_pex gossip: (host, port) addresses workers hear about
+        self.discovered: asyncio.Queue = asyncio.Queue()
+        # our serving socket, advertised to peers (BEP 10 ``p``)
+        self.listen_port: Optional[int] = None
 
     @property
     def complete(self) -> bool:
@@ -128,6 +132,27 @@ class TorrentClient:
             b"-DT0001-" + bytes(random.randrange(48, 58) for _ in range(12))
         )
         self.dht = dht
+        # lingering seed servers: info_hash -> (Seeder, expiry task)
+        self._lingering: dict = {}
+
+    def serving_port(self, info_hash: bytes) -> Optional[int]:
+        """Port of the lingering seed server for ``info_hash``, if any."""
+        entry = self._lingering.get(info_hash)
+        return entry[0].port if entry else None
+
+    @property
+    def is_seeding(self) -> bool:
+        """True while any post-download server is still lingering."""
+        return bool(self._lingering)
+
+    async def close(self) -> None:
+        """Stop any servers still seeding past their download (webtorrent's
+        ``client.destroy()`` analogue — the reference keeps one long-lived
+        client whose torrents seed until removed, lib/download.js:19,103)."""
+        for server, expiry in list(self._lingering.values()):
+            expiry.cancel()
+            await server.stop()
+        self._lingering.clear()
 
     # ------------------------------------------------------------------
     async def download(
@@ -140,8 +165,22 @@ class TorrentClient:
         progress_interval: float = 30.0,
         on_progress: Optional[ProgressCb] = None,
         peers: Optional[List[tracker_mod.Peer]] = None,
+        listen: bool = True,
+        listen_host: str = "0.0.0.0",
+        seed_linger: float = 0.0,
     ) -> Metainfo:
-        """Fetch the torrent behind ``uri`` into ``download_path``."""
+        """Fetch the torrent behind ``uri`` into ``download_path``.
+
+        While downloading, verified pieces are served back to the swarm on
+        a listen socket (seed-while-leech, like the reference's webtorrent:
+        concurrent replicas staging the same torrent trade pieces instead
+        of all hammering the origin).  ``listen=False`` disables serving.
+
+        ``seed_linger`` keeps the serve socket up for that many seconds
+        AFTER the download completes (in the background — this call still
+        returns immediately), so sibling replicas mid-download don't lose
+        their source; :meth:`close` reaps lingering servers early.
+        """
         meta, peers = await self._resolve(uri, peers, metadata_timeout)
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
 
@@ -160,44 +199,136 @@ class TorrentClient:
         if not peers and not webseeds:
             raise TorrentError("no peers available")
 
+        server = None
+        if listen:
+            from .seeder import Seeder
+
+            # share swarm.done by reference: the serve side's availability
+            # tracks verified pieces with no extra bookkeeping
+            server = Seeder(meta, storage=storage, have=swarm.done,
+                            peer_id=self.peer_id)
+            try:
+                swarm.listen_port = await server.start(host=listen_host)
+                self._log("serving swarm", port=swarm.listen_port)
+            except OSError as err:
+                self._log("listen socket failed; leech-only", error=str(err))
+                server = None
+
         watchdog = StallWatchdog(stall_timeout)
         watchdog.feed(swarm.bytes_done)
 
-        async def _run() -> None:
-            reporter = asyncio.create_task(
-                self._report_progress(swarm, watchdog, progress_interval, on_progress)
+        completed = False
+        try:
+            await watchdog.watch(
+                self._drive(swarm, storage, peers or [], webseeds, server,
+                            progress_interval, on_progress, watchdog)
             )
-            workers = [
-                asyncio.create_task(self._peer_worker(addr, storage, swarm))
-                for addr in (peers or [])[:MAX_PEERS]
-            ] + [
-                asyncio.create_task(self._webseed_worker(url, storage, swarm))
-                for url in webseeds[:MAX_WEBSEEDS]
-            ]
-            try:
-                while not swarm.complete:
-                    if all(w.done() for w in workers):
-                        raise TorrentError(
-                            "all peer/webseed sources failed with pieces "
-                            "remaining"
-                        )
-                    try:
-                        async with asyncio.timeout(0.5):
-                            await swarm.piece_event.wait()
-                    except TimeoutError:
-                        pass
-                    swarm.piece_event.clear()
-            finally:
-                reporter.cancel()
-                for w in workers:
-                    w.cancel()
-                await asyncio.gather(reporter, *workers, return_exceptions=True)
-
-        await watchdog.watch(_run())
+            completed = True
+        finally:
+            if server is not None:
+                if completed and seed_linger > 0:
+                    self._linger(meta.info_hash, server, seed_linger)
+                else:
+                    await server.stop()
 
         if on_progress is not None:
             await on_progress(1.0)
         return meta
+
+    def _linger(self, info_hash: bytes, server, seconds: float) -> None:
+        """Keep ``server`` seeding for ``seconds`` in the background."""
+        async def _expire() -> None:
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                await server.stop()
+                entry = self._lingering.get(info_hash)
+                if entry is not None and entry[0] is server:
+                    self._lingering.pop(info_hash, None)
+
+        old = self._lingering.pop(info_hash, None)
+        if old is not None:
+            old[1].cancel()
+        self._lingering[info_hash] = (server, asyncio.create_task(_expire()))
+
+    async def _drive(self, swarm: _Swarm, storage: TorrentStorage,
+                     peers: List[tracker_mod.Peer], webseeds: List[str],
+                     server, progress_interval: float,
+                     on_progress: Optional[ProgressCb],
+                     watchdog: StallWatchdog) -> None:
+        """Run the download: a dynamic worker pool (seeded from trackers/
+        DHT/x.pe, grown from ut_pex gossip), HAVE re-broadcast of finished
+        pieces, and a best-effort DHT announce of our serving socket."""
+        meta = swarm.meta
+        reporter = asyncio.create_task(
+            self._report_progress(swarm, watchdog, progress_interval,
+                                  on_progress)
+        )
+        seen = {(p.host, p.port) for p in peers}
+        backlog = list(peers)
+        # separate pools: webseed workers must not consume MAX_PEERS slots
+        ws_workers = [
+            asyncio.create_task(self._webseed_worker(url, storage, swarm))
+            for url in webseeds[:MAX_WEBSEEDS]
+        ]
+        workers: List[asyncio.Task] = []
+        announce_task = None
+        if server is not None and self.dht is not None:
+            announce_task = asyncio.create_task(
+                self._dht_announce(meta.info_hash, swarm.listen_port)
+            )
+        announced = set(swarm.done)  # resume pieces are in the bitfield
+        try:
+            while not swarm.complete:
+                # grow the pool from ut_pex gossip
+                while not swarm.discovered.empty():
+                    host, port = swarm.discovered.get_nowait()
+                    if (host, port) not in seen:
+                        seen.add((host, port))
+                        backlog.append(tracker_mod.Peer(host, port))
+                        self._log("pex peer discovered", host=host, port=port)
+                peer_slots = MAX_PEERS - sum(
+                    1 for w in workers if not w.done()
+                )
+                while backlog and peer_slots > 0:
+                    addr = backlog.pop(0)
+                    workers.append(asyncio.create_task(
+                        self._peer_worker(addr, storage, swarm)
+                    ))
+                    peer_slots -= 1
+                if (all(w.done() for w in workers)
+                        and all(w.done() for w in ws_workers)
+                        and not backlog):
+                    raise TorrentError(
+                        "all peer/webseed sources failed with pieces "
+                        "remaining"
+                    )
+                try:
+                    async with asyncio.timeout(0.5):
+                        await swarm.piece_event.wait()
+                except TimeoutError:
+                    pass
+                swarm.piece_event.clear()
+                if server is not None:
+                    for index in swarm.done - announced:
+                        announced.add(index)
+                        await server.add_piece(index)
+        finally:
+            reporter.cancel()
+            if announce_task is not None:
+                announce_task.cancel()
+            for w in workers + ws_workers:
+                w.cancel()
+            await asyncio.gather(reporter, *workers, *ws_workers,
+                                 return_exceptions=True)
+
+    async def _dht_announce(self, info_hash: bytes, port: int) -> None:
+        """Register our serving socket in the DHT (best-effort)."""
+        try:
+            ok = await self.dht.announce(info_hash, port)
+            self._log("dht announce", confirmed_by=ok)
+        except Exception as err:
+            self._log("dht announce failed", error=str(err))
 
     # ------------------------------------------------------------------
     async def _resolve(self, uri: str, peers, metadata_timeout: float):
@@ -378,7 +509,9 @@ class TorrentClient:
                     if resp.status not in (200, 206):
                         raise OSError(f"webseed HTTP {resp.status} for {url}")
                     if resp.status == 206:
-                        body = await resp.read()
+                        # bounded read: a hostile seed answering a ranged
+                        # request with a huge body must not buffer into RAM
+                        body = await self._read_bounded(resp, hi - lo)
                     else:
                         # server ignored Range: stream-slice the span out of
                         # the full body (bounded memory) and abort the rest.
@@ -396,6 +529,19 @@ class TorrentClient:
                 )
             out += body
         return bytes(out)
+
+    @staticmethod
+    async def _read_bounded(resp, want: int) -> bytes:
+        """Read exactly up to ``want`` bytes; error out (instead of
+        buffering) if the server sends more."""
+        got = bytearray()
+        async for chunk in resp.content.iter_chunked(1 << 16):
+            got += chunk
+            if len(got) > want:
+                raise OSError(
+                    f"webseed overlong body: wanted {want}, got >{len(got)}"
+                )
+        return bytes(got)
 
     @staticmethod
     async def _stream_slice(resp, lo: int, hi: int) -> bytes:
@@ -487,7 +633,8 @@ class TorrentClient:
                 await on_progress(swarm.bytes_done / total)
 
     # -- peer plumbing ---------------------------------------------------
-    async def _connect(self, peer_addr, info_hash: bytes) -> wire.PeerWire:
+    async def _connect(self, peer_addr, info_hash: bytes,
+                       listen_port: Optional[int] = None) -> wire.PeerWire:
         async with asyncio.timeout(CONNECT_TIMEOUT):
             reader, writer = await asyncio.open_connection(
                 peer_addr.host, peer_addr.port
@@ -499,7 +646,7 @@ class TorrentClient:
             if handshake.info_hash != info_hash:
                 raise wire.WireError("infohash mismatch in handshake")
             if handshake.supports_extensions:
-                await peer.send_ext_handshake()
+                await peer.send_ext_handshake(listen_port=listen_port)
             return peer
         except BaseException:
             # close on ANY failure (including cancellation from the caller's
@@ -513,7 +660,8 @@ class TorrentClient:
         meta = swarm.meta
         claimed: Optional[int] = None
         try:
-            peer = await self._connect(peer_addr, meta.info_hash)
+            peer = await self._connect(peer_addr, meta.info_hash,
+                                       listen_port=swarm.listen_port)
         except Exception as err:
             self._log("peer connect failed", peer=str(peer_addr), error=str(err))
             return
@@ -606,6 +754,11 @@ class TorrentClient:
                 elif msg_id == wire.MSG_EXTENDED:
                     if payload[0] == wire.EXT_HANDSHAKE_ID:
                         peer.handle_ext_handshake(payload[1:])
+                    elif payload[0] == peer.our_ut_pex:
+                        # ut_pex gossip (BEP 11): hand new addresses to the
+                        # pool manager in download()
+                        for addr in wire.parse_pex(payload[1:]):
+                            swarm.discovered.put_nowait(addr)
                 elif msg_id == wire.MSG_PIECE:
                     index, begin = struct.unpack(">II", payload[:8])
                     data = payload[8:]
@@ -630,9 +783,11 @@ class TorrentClient:
                         buffer = None
                     await _pump_requests()
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                wire.WireError, struct.error, IndexError, ValueError) as err:
-            # struct/Index/Value errors come from malformed frames — these
-            # are untrusted wire bytes, so treat them like a dead peer
+                wire.WireError, struct.error, IndexError, ValueError,
+                AttributeError, TypeError) as err:
+            # struct/Index/Value/Attribute/Type errors come from malformed
+            # frames (e.g. a bencoded non-dict where a dict belongs) —
+            # untrusted wire bytes, so treat them like a dead peer
             self._log("peer connection lost", peer=str(peer_addr), error=str(err))
         finally:
             if claimed is not None:

@@ -15,9 +15,9 @@ implementation of the same protocol:
 
 The torrent client uses :meth:`DHTNode.get_peers` as an additional peer
 source next to tracker announces, covering magnets with no (or dead)
-trackers.  :meth:`DHTNode.announce` is the write side; the leeching client
-does not call it (it serves no incoming peer connections — serving is the
-:class:`~.seeder.Seeder`'s job, which advertises via trackers).
+trackers.  :meth:`DHTNode.announce` is the write side: the client calls it
+(best-effort) once its seed-while-leech listen socket is up, registering
+that socket so other DHT nodes can find and leech from it.
 """
 
 from __future__ import annotations

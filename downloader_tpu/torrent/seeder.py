@@ -1,8 +1,16 @@
-"""A seeding peer: serves pieces and ut_metadata over the wire protocol.
+"""A serving peer: pieces, ut_metadata, and peer exchange over the wire.
 
 webtorrent both leeches and seeds (/root/reference/lib/download.js:19 keeps
-one long-lived client); this is the seeding half, and doubles as the hermetic
-swarm for tests (no network egress needed).
+one long-lived client); this is the serving half.  It doubles as the hermetic
+swarm for tests (no network egress needed) and as the listen socket a
+leeching :class:`~.client.TorrentClient` runs so replicas downloading the
+same torrent can trade pieces (seed-while-leech).
+
+Supports partially-available content: construct with ``have`` (a live,
+possibly shared set of piece indices) and call :meth:`add_piece` as pieces
+verify — connected peers get ``HAVE`` broadcasts (BEP 3).  Peers that
+advertise a listen port in their BEP 10 handshake are gossiped to the rest
+of the swarm via ut_pex (BEP 11).
 """
 
 from __future__ import annotations
@@ -10,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
-from typing import Optional, Set
+from typing import Dict, Optional, Set, Tuple
 
 from . import wire
 from .metainfo import Metainfo
@@ -18,16 +26,39 @@ from .storage import TorrentStorage
 
 
 class Seeder:
-    """Serves one torrent's pieces from ``root`` on a local TCP port."""
+    """Serves one torrent's pieces from ``root`` on a local TCP port.
 
-    def __init__(self, meta: Metainfo, root: str, peer_id: Optional[bytes] = None):
+    ``have`` is the set of piece indices available to serve; ``None`` means
+    the content is complete.  The set may be shared with (and mutated by) a
+    downloading client — :meth:`add_piece` announces new pieces to every
+    connected peer.
+    """
+
+    def __init__(self, meta: Metainfo, root: Optional[str] = None,
+                 peer_id: Optional[bytes] = None,
+                 storage: Optional[TorrentStorage] = None,
+                 have: Optional[Set[int]] = None):
+        if storage is None:
+            if root is None:
+                raise ValueError("need root or storage")
+            storage = TorrentStorage(meta, root)
         self.meta = meta
-        self.storage = TorrentStorage(meta, root)
+        self.storage = storage
+        self.have = have  # live reference; None = everything
         self.peer_id = peer_id or (b"-DT0001-" + os.urandom(6).hex().encode())
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None
         self.connections: int = 0
         self._conn_tasks: Set[asyncio.Task] = set()
+        self._peers: Set[wire.PeerWire] = set()
+        # peers that advertised a listen port: PeerWire -> (host, port)
+        self._listen_addrs: Dict[wire.PeerWire, Tuple[str, int]] = {}
+
+    def _available(self, index: int) -> bool:
+        return self.have is None or index in self.have
+
+    def _have_indices(self):
+        return range(self.meta.num_pieces) if self.have is None else self.have
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._on_connect, host, port)
@@ -45,6 +76,28 @@ class Seeder:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
+
+    async def add_piece(self, index: int) -> None:
+        """Record a newly available piece and HAVE-broadcast it (BEP 3).
+
+        Broadcasts run as background tasks: one stalled connection (a peer
+        that stops reading, filling our write buffer) must not block the
+        caller — for the seed-while-leech path the caller is the download's
+        control loop.
+        """
+        if self.have is not None:
+            self.have.add(index)
+        for peer in list(self._peers):
+            task = asyncio.create_task(self._send_have(peer, index))
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+
+    @staticmethod
+    async def _send_have(peer: wire.PeerWire, index: int) -> None:
+        try:
+            await peer.send_have(index)
+        except (ConnectionError, OSError, wire.WireError):
+            pass  # dying connection: its serve loop will clean up
 
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -64,13 +117,20 @@ class Seeder:
                 await peer.send_ext_handshake(
                     metadata_size=len(self.meta.info_bytes)
                 )
-            await peer.send_bitfield(
-                wire.build_bitfield(range(self.meta.num_pieces), self.meta.num_pieces)
-            )
+            # register BEFORE snapshotting the bitfield, with no await in
+            # between: a piece verified mid-handshake is then either in the
+            # bitfield or HAVE-broadcast (never silently missed), and the
+            # broadcast task cannot run before the bitfield is buffered
+            self._peers.add(peer)
+            await peer.send_bitfield(wire.build_bitfield(
+                self._have_indices(), self.meta.num_pieces
+            ))
             await self._serve(peer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
+            self._peers.discard(peer)
+            self._listen_addrs.pop(peer, None)
             await peer.close()
 
     async def _serve(self, peer: wire.PeerWire) -> None:
@@ -82,7 +142,13 @@ class Seeder:
                 await peer.send_message(wire.MSG_UNCHOKE)
             elif msg_id == wire.MSG_REQUEST:
                 index, begin, length = struct.unpack(">III", payload)
-                if index >= self.meta.num_pieces or length > (1 << 17):
+                if (index >= self.meta.num_pieces or length > (1 << 17)
+                        or begin + length > self.meta.piece_size(index)
+                        or not self._available(index)):
+                    # requesting a piece we never advertised — or bytes
+                    # past its boundary — is a protocol violation, and
+                    # serving it would leak preallocated zeros/unverified
+                    # bytes as content
                     raise wire.WireError("bad request")
                 data = self.storage.read(
                     index * self.meta.piece_length + begin, length
@@ -96,6 +162,7 @@ class Seeder:
         ext_id, body = payload[0], payload[1:]
         if ext_id == wire.EXT_HANDSHAKE_ID:
             peer.handle_ext_handshake(body)
+            await self._register_pex(peer)
             return
         # ut_metadata request addressed to the id we advertised
         from .bencode import bdecode_prefix
@@ -110,3 +177,28 @@ class Seeder:
                 return
             chunk = self.meta.info_bytes[start:start + wire.METADATA_PIECE_SIZE]
             await peer.send_metadata_data(piece, total, chunk)
+
+    # -- peer exchange (BEP 11) -----------------------------------------
+    async def _register_pex(self, peer: wire.PeerWire) -> None:
+        """After a peer's extended handshake: tell it about the swarm, and
+        gossip its listen address (if advertised) to everyone else."""
+        known = [a for p, a in self._listen_addrs.items() if p is not peer]
+        if known and peer.peer_ut_pex is not None:
+            try:
+                await peer.send_pex(known)
+            except (ConnectionError, OSError, wire.WireError):
+                return
+        if peer.peer_listen_port is None:
+            return
+        host = peer.writer.get_extra_info("peername")
+        if host is None:
+            return
+        addr = (host[0], peer.peer_listen_port)
+        self._listen_addrs[peer] = addr
+        for other in list(self._peers):
+            if other is peer or other.peer_ut_pex is None:
+                continue
+            try:
+                await other.send_pex([addr])
+            except (ConnectionError, OSError, wire.WireError):
+                pass

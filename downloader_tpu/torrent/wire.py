@@ -1,5 +1,5 @@
 """BitTorrent peer wire protocol (BEP 3) + extension protocol (BEP 10) +
-metadata exchange (BEP 9).
+metadata exchange (BEP 9) + peer exchange (BEP 11).
 
 One :class:`PeerWire` wraps an asyncio stream pair and is used by both sides:
 the leeching client and the in-package seeder.
@@ -9,8 +9,9 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import socket
 import struct
-from typing import Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 from .bencode import bdecode_prefix, bencode
 
@@ -31,6 +32,7 @@ MSG_EXTENDED = 20
 
 EXT_HANDSHAKE_ID = 0
 UT_METADATA = b"ut_metadata"
+UT_PEX = b"ut_pex"
 METADATA_PIECE_SIZE = 1 << 14
 
 # ut_metadata msg_type values (BEP 9)
@@ -56,10 +58,14 @@ class PeerWire:
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self.reader = reader
         self.writer = writer
-        # negotiated ut_metadata ids: ours (what we told the peer) and theirs
+        # negotiated extension ids: ours (what we told the peer) and theirs
         self.our_ut_metadata = 1
+        self.our_ut_pex = 2
         self.peer_ut_metadata: Optional[int] = None
+        self.peer_ut_pex: Optional[int] = None
         self.peer_metadata_size: Optional[int] = None
+        # the peer's advertised listen port (``p`` in the BEP 10 handshake)
+        self.peer_listen_port: Optional[int] = None
 
     # -- handshake ------------------------------------------------------
     async def send_handshake(self, info_hash: bytes, peer_id: bytes) -> None:
@@ -121,10 +127,16 @@ class PeerWire:
         await self.send_message(MSG_HAVE, struct.pack(">I", index))
 
     # -- extension protocol ---------------------------------------------
-    async def send_ext_handshake(self, metadata_size: Optional[int] = None) -> None:
-        payload: dict = {b"m": {UT_METADATA: self.our_ut_metadata}}
+    async def send_ext_handshake(self, metadata_size: Optional[int] = None,
+                                 listen_port: Optional[int] = None) -> None:
+        payload: dict = {b"m": {
+            UT_METADATA: self.our_ut_metadata,
+            UT_PEX: self.our_ut_pex,
+        }}
         if metadata_size is not None:
             payload[b"metadata_size"] = metadata_size
+        if listen_port is not None:
+            payload[b"p"] = listen_port
         await self.send_message(
             MSG_EXTENDED, bytes([EXT_HANDSHAKE_ID]) + bencode(payload)
         )
@@ -134,8 +146,13 @@ class PeerWire:
         m = data.get(b"m", {})
         if UT_METADATA in m:
             self.peer_ut_metadata = m[UT_METADATA]
+        if UT_PEX in m:
+            self.peer_ut_pex = m[UT_PEX]
         if b"metadata_size" in data:
             self.peer_metadata_size = data[b"metadata_size"]
+        port = data.get(b"p")
+        if isinstance(port, int) and 0 < port < 65536:
+            self.peer_listen_port = port
 
     async def send_metadata_request(self, piece: int) -> None:
         if self.peer_ut_metadata is None:
@@ -165,6 +182,20 @@ class PeerWire:
             MSG_EXTENDED, bytes([self._their_ut_metadata()]) + msg
         )
 
+    # -- peer exchange (BEP 11) -----------------------------------------
+    async def send_pex(self, added: Iterable[Tuple[str, int]],
+                       dropped: Iterable[Tuple[str, int]] = ()) -> None:
+        if self.peer_ut_pex is None:
+            raise WireError("peer does not support ut_pex")
+        msg = bencode({
+            b"added": pack_compact_peers(added),
+            b"added.f": b"",
+            b"dropped": pack_compact_peers(dropped),
+        })
+        await self.send_message(
+            MSG_EXTENDED, bytes([self.peer_ut_pex]) + msg
+        )
+
     async def close(self) -> None:
         try:
             self.writer.close()
@@ -186,3 +217,32 @@ def build_bitfield(have, num_pieces: int) -> bytes:
     for i in have:
         out[i // 8] |= 0x80 >> (i % 8)
     return bytes(out)
+
+
+def pack_compact_peers(addrs: Iterable[Tuple[str, int]]) -> bytes:
+    """IPv4 (host, port) pairs -> BEP 11/23 compact 6-byte entries.
+    Non-IPv4 hosts are skipped (ut_pex's ``added6`` is not implemented)."""
+    out = bytearray()
+    for host, port in addrs:
+        try:
+            out += socket.inet_aton(host) + struct.pack(">H", port)
+        except OSError:
+            continue
+    return bytes(out)
+
+
+def parse_pex(body: bytes) -> List[Tuple[str, int]]:
+    """Extract usable (host, port) peers from a ut_pex message body."""
+    data, _ = bdecode_prefix(body)
+    if not isinstance(data, dict):  # untrusted wire bytes
+        return []
+    added = data.get(b"added", b"")
+    if not isinstance(added, bytes):
+        return []
+    out: List[Tuple[str, int]] = []
+    for i in range(0, len(added) - len(added) % 6, 6):
+        host = socket.inet_ntoa(added[i:i + 4])
+        (port,) = struct.unpack(">H", added[i + 4:i + 6])
+        if 0 < port < 65536:
+            out.append((host, port))
+    return out

@@ -5,6 +5,7 @@ webtorrent at /root/reference/lib/download.js:43-123)."""
 import asyncio
 import hashlib
 import os
+import struct
 
 import pytest
 
@@ -506,3 +507,129 @@ async def test_dead_webseed_falls_back_to_peers(swarm, tmp_path):
     got = await TorrentClient().download(str(torrent_file), dest)
     assert got.info_hash == swarm.meta.info_hash
     assert_downloaded(swarm, dest)
+
+
+# -- seed-while-leech + peer exchange (BEP 11) --------------------------
+async def test_replica_relay_via_seed_while_leech(swarm, tmp_path):
+    """Replica A stages the torrent and keeps seeding (linger); replica B
+    completes with A as its ONLY source — no origin contact."""
+    client_a = TorrentClient()
+    dest_a = str(tmp_path / "replica-a")
+    uri = make_magnet(swarm.meta.info_hash, swarm.meta.name,
+                      [swarm.tracker_url])
+    await client_a.download(uri, dest_a, seed_linger=30,
+                            listen_host="127.0.0.1")
+    port_a = client_a.serving_port(swarm.meta.info_hash)
+    assert port_a is not None  # still seeding after download returned
+
+    dest_b = str(tmp_path / "replica-b")
+    torrent_file = tmp_path / "relay.torrent"
+    # no trackers in this .torrent: B can ONLY reach A
+    bare = make_metainfo(str(tmp_path / "seed" / swarm.meta.name),
+                         piece_length=1 << 14)
+    torrent_file.write_bytes(bare.to_torrent_bytes())
+    client_b = TorrentClient()
+    meta = await client_b.download(
+        str(torrent_file), dest_b, peers=[Peer("127.0.0.1", port_a)]
+    )
+    assert meta.info_hash == swarm.meta.info_hash
+    for name, data in swarm.files.items():
+        with open(os.path.join(dest_b, meta.name, name), "rb") as fh:
+            assert fh.read() == data
+
+    await client_a.close()
+    assert client_a.serving_port(swarm.meta.info_hash) is None
+    with pytest.raises(OSError):
+        await asyncio.open_connection("127.0.0.1", port_a)
+
+
+async def test_partial_seeder_broadcasts_have(tmp_path):
+    """A partial seeder sends its true bitfield, HAVE-broadcasts new
+    pieces, and drops peers requesting unadvertised pieces."""
+    from downloader_tpu.torrent import Seeder
+    from downloader_tpu.torrent import wire
+    from downloader_tpu.torrent.storage import TorrentStorage
+
+    src, files = make_payload_dir(tmp_path, [2 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    store_root = str(tmp_path / "partial")
+    storage = TorrentStorage(meta, store_root)
+    storage.preallocate()
+    have = set()
+    seeder = Seeder(meta, storage=storage, have=have)
+    port = await seeder.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        peer = wire.PeerWire(reader, writer)
+        await peer.send_handshake(meta.info_hash, b"-TS0001-xxxxxxxxxxxx")
+        await peer.recv_handshake()
+        await peer.send_ext_handshake()
+        # seeder sends ext handshake + bitfield; bitfield must be empty
+        saw_bitfield = None
+        while saw_bitfield is None:
+            msg_id, payload = await peer.recv_message()
+            if msg_id == wire.MSG_BITFIELD:
+                saw_bitfield = wire.parse_bitfield(payload, meta.num_pieces)
+        assert saw_bitfield == set()
+
+        # piece 0 appears: write + add_piece -> HAVE broadcast
+        real0 = b"".join(files.values())[: meta.piece_size(0)]
+        storage.write_piece(0, real0)
+        await seeder.add_piece(0)
+        msg_id, payload = await peer.recv_message()
+        assert msg_id == wire.MSG_HAVE
+        assert struct.unpack(">I", payload)[0] == 0
+
+        # advertised piece is served
+        await peer.send_message(wire.MSG_INTERESTED)
+        msg_id, _ = await peer.recv_message()
+        assert msg_id == wire.MSG_UNCHOKE
+        await peer.send_request(0, 0, 1 << 14)
+        msg_id, payload = await peer.recv_message()
+        assert msg_id == wire.MSG_PIECE
+        assert payload[8:] == real0[: 1 << 14]
+
+        # unadvertised piece -> protocol violation -> disconnect
+        await peer.send_request(1, 0, 1 << 14)
+        with pytest.raises((asyncio.IncompleteReadError, ConnectionError)):
+            while True:
+                await peer.recv_message()
+    finally:
+        await seeder.stop()
+
+
+
+async def test_pex_gossip_between_peers(swarm, tmp_path):
+    """A peer that advertises a listen port is gossiped to later peers via
+    ut_pex, and the client dials the discovered address."""
+    from downloader_tpu.torrent import Seeder, wire
+
+    # second seeder, NOT on the tracker: only reachable if pex works
+    hidden = Seeder(swarm.meta, str(tmp_path / "seed"))
+    hidden_port = await hidden.start()
+
+    # a raw connection to the origin seeder advertising the hidden seeder's
+    # port as its own listen port (stand-in for a replica serving pieces)
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", swarm.seeder.port
+    )
+    gossiper = wire.PeerWire(reader, writer)
+    try:
+        await gossiper.send_handshake(swarm.meta.info_hash,
+                                      b"-GS0001-xxxxxxxxxxxx")
+        await gossiper.recv_handshake()
+        await gossiper.send_ext_handshake(listen_port=hidden_port)
+        await asyncio.sleep(0.1)  # let the seeder register the addr
+
+        dest = str(tmp_path / "dl-pex")
+        uri = make_magnet(swarm.meta.info_hash, swarm.meta.name,
+                          [swarm.tracker_url])
+        meta = await TorrentClient().download(uri, dest)
+        assert meta.info_hash == swarm.meta.info_hash
+        # the client learned the hidden seeder's address via ut_pex and
+        # connected to it
+        assert hidden.connections >= 1
+    finally:
+        await gossiper.close()
+        await hidden.stop()
+        await asyncio.sleep(0)

@@ -148,3 +148,64 @@ async def test_dot_torrent_url_chains_to_torrent_method(tmp_path):
     await runner.cleanup()
     await seeder.stop()
     await tracker.stop()
+
+
+async def test_seed_linger_config_keeps_serving_until_shutdown(
+    tmp_path, monkeypatch
+):
+    """With seed_linger configured, a completed torrent job keeps serving
+    the swarm; orchestrator shutdown reaps the server."""
+    import asyncio
+
+    src, files = make_payload_dir(tmp_path, [60_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent))
+    port = await seeder.start()
+    tracker = MiniTracker([("127.0.0.1", port)])
+    tracker_url = await tracker.start()
+    magnet = make_magnet(meta.info_hash, meta.name, [tracker_url])
+
+    monkeypatch.setenv("SEED_LINGER", "60")
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode(
+        {"instance": {"download_path": str(tmp_path / "downloads")}}
+    )
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config,
+        mq=MemoryQueue(broker),
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    msg = schemas.Download(
+        media=schemas.Media(
+            id="linger-job", creator_id="card-l", name="Great Show",
+            type=schemas.MediaType.Value("TV"),
+            source=schemas.SourceType.Value("TORRENT"),
+            source_uri=magnet,
+        )
+    )
+    broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+    await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+
+    # the job completed but the stage's client is still seeding the torrent
+    clients = orchestrator.stage_resources.get("torrent_clients")
+    assert clients, "client should be retained for lingering"
+    serve_port = clients[0].serving_port(meta.info_hash)
+    assert serve_port is not None
+    reader, writer = await asyncio.open_connection("127.0.0.1", serve_port)
+    writer.close()
+    await writer.wait_closed()
+
+    # shutdown reaps the lingering server
+    await orchestrator.shutdown(grace_seconds=5)
+    assert clients[0].serving_port(meta.info_hash) is None
+    with pytest.raises(OSError):
+        await asyncio.open_connection("127.0.0.1", serve_port)
+
+    await tracker.stop()
+    await seeder.stop()
