@@ -35,7 +35,7 @@ MIB_PER_JOB = int(os.environ.get("BENCH_MIB_PER_JOB", 32))
 # single-core host: the loop is CPU-bound, so interleaving jobs only adds
 # scheduling overhead — prefetch=1 measured fastest (sweep: 1 > 4 > 3 > 2)
 PREFETCH = int(os.environ.get("BENCH_PREFETCH", 1))
-REPS = int(os.environ.get("BENCH_REPS", 3))  # noisy shared host; best of N
+REPS = int(os.environ.get("BENCH_REPS", 5))  # noisy shared host; best of N
 
 
 async def _one_rep(port: int) -> float:
@@ -85,13 +85,22 @@ async def _one_rep(port: int) -> float:
 
 
 async def bench_pipeline():
+    import tempfile
+
     from aiohttp import web
 
-    payload = os.urandom(MIB_PER_JOB << 20)
+    # FileResponse serves via kernel sendfile: the in-process fixture
+    # server spends no user-space cycles copying the body, so the number
+    # measures the pipeline, not the fixture (~+5% and less noise vs an
+    # in-memory body)
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "media.mkv")
+    with open(path, "wb") as fh:
+        fh.write(os.urandom(MIB_PER_JOB << 20))
     app = web.Application()
 
     async def serve(_request):
-        return web.Response(body=payload)
+        return web.FileResponse(path)
 
     app.router.add_get("/media.mkv", serve)
     runner = web.AppRunner(app)
@@ -102,6 +111,8 @@ async def bench_pipeline():
 
     elapsed = min([await _one_rep(port) for _ in range(REPS)])
     await runner.cleanup()
+    os.unlink(path)
+    os.rmdir(tmp)
 
     total_mb = JOBS * MIB_PER_JOB * (1 << 20) / 1e6
     return {
@@ -121,23 +132,36 @@ config = UpscalerConfig()
 rng = jax.random.PRNGKey(0)
 frames = jax.random.uniform(rng, (16, 180, 320, 3), jnp.float32)
 model, params = init_params(rng, config, sample_shape=frames.shape)
-fwd = jax.jit(lambda p, x: model.apply(p, x))
-fwd(params, frames).block_until_ready()  # compile
 
-iters = 20
-start = time.monotonic()
-x = frames
-for _ in range(iters):
-    # feed the (downsampled) output back in so each step depends on the
-    # previous one — defeats async-dispatch overlap that would otherwise
-    # fake the timing
-    out = fwd(params, x)
-    x = out[:, ::2, ::2, :].astype(frames.dtype)
-x.block_until_ready()
-dt = time.monotonic() - start
+ITERS = 20
+
+def rollout(p, x0):
+    # the whole dependent iteration chain runs ON DEVICE via lax.scan: one
+    # dispatch instead of ITERS round-trips (over a tunneled TPU each
+    # dispatch costs ~1s of RPC latency, which is NOT chip throughput).
+    # Each step feeds the downsampled output back in, so steps stay
+    # sequentially dependent and cannot be overlapped.
+    def step(x, _):
+        out = model.apply(p, x)
+        return (out[:, ::2, ::2, :].astype(x0.dtype),
+                jnp.sum(out.astype(jnp.float32)))
+    final, sums = jax.lax.scan(step, x0, None, length=ITERS)
+    # reduce to a scalar on device: fetching 4 bytes forces the full
+    # computation without timing a multi-MB transfer over the tunnel
+    # (block_until_ready is unreliable on the tunneled backend)
+    return jnp.sum(sums) + jnp.sum(final)
+
+fn = jax.jit(rollout)
+jax.device_get(fn(params, frames))  # compile + first run
+best = None
+for _ in range(3):
+    start = time.monotonic()
+    jax.device_get(fn(params, frames))
+    dt = time.monotonic() - start
+    best = dt if best is None else min(best, dt)
 print(json.dumps({
     "backend": jax.default_backend(),
-    "upscaler_fps_180p_to_360p": frames.shape[0] * iters / dt,
+    "upscaler_fps_180p_to_360p": frames.shape[0] * ITERS / best,
 }))
 """
 
