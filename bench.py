@@ -109,10 +109,12 @@ async def bench_pipeline():
     await site.start()
     port = site._server.sockets[0].getsockname()[1]
 
-    elapsed = min([await _one_rep(port) for _ in range(REPS)])
-    await runner.cleanup()
-    os.unlink(path)
-    os.rmdir(tmp)
+    try:
+        elapsed = min([await _one_rep(port) for _ in range(REPS)])
+    finally:
+        await runner.cleanup()
+        os.unlink(path)
+        os.rmdir(tmp)
 
     total_mb = JOBS * MIB_PER_JOB * (1 << 20) / 1e6
     return {

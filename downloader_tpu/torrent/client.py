@@ -149,9 +149,10 @@ class TorrentClient:
         """Stop any servers still seeding past their download (webtorrent's
         ``client.destroy()`` analogue — the reference keeps one long-lived
         client whose torrents seed until removed, lib/download.js:19,103)."""
-        for server, expiry in list(self._lingering.values()):
+        for server, expiry, unregister in list(self._lingering.values()):
             expiry.cancel()
             await server.stop()
+            await unregister()
         self._lingering.clear()
 
     # ------------------------------------------------------------------
@@ -227,7 +228,8 @@ class TorrentClient:
         finally:
             if server is not None:
                 if completed and seed_linger > 0:
-                    self._linger(meta.info_hash, server, seed_linger)
+                    self._linger(meta, server, seed_linger,
+                                 swarm.listen_port)
                 else:
                     await server.stop()
 
@@ -235,21 +237,41 @@ class TorrentClient:
             await on_progress(1.0)
         return meta
 
-    def _linger(self, info_hash: bytes, server, seconds: float) -> None:
-        """Keep ``server`` seeding for ``seconds`` in the background."""
+    def _linger(self, meta: Metainfo, server, seconds: float,
+                port: int) -> None:
+        """Keep ``server`` seeding for ``seconds`` in the background; when
+        it stops, tell the trackers (event=stopped) so they stop handing
+        out our now-dead address."""
+        info_hash = meta.info_hash
+
+        async def _unregister() -> None:
+            try:
+                async with asyncio.timeout(5.0):  # dead trackers: bounded
+                    await self._announce_all(meta.trackers, info_hash,
+                                             left=0, port=port,
+                                             event="stopped")
+            except Exception as err:  # best-effort
+                self._log("tracker unregister failed", error=str(err))
+
         async def _expire() -> None:
+            # the finally owns teardown so every exit — natural expiry,
+            # close(), or replacement by a re-download's new server —
+            # stops the socket and withdraws the tracker registration
             try:
                 await asyncio.sleep(seconds)
             finally:
                 await server.stop()
+                await _unregister()
                 entry = self._lingering.get(info_hash)
                 if entry is not None and entry[0] is server:
                     self._lingering.pop(info_hash, None)
 
         old = self._lingering.pop(info_hash, None)
         if old is not None:
-            old[1].cancel()
-        self._lingering[info_hash] = (server, asyncio.create_task(_expire()))
+            old[1].cancel()  # its finally retires the old server
+        self._lingering[info_hash] = (
+            server, asyncio.create_task(_expire()), _unregister
+        )
 
     async def _drive(self, swarm: _Swarm, storage: TorrentStorage,
                      peers: List[tracker_mod.Peer], webseeds: List[str],
@@ -273,10 +295,8 @@ class TorrentClient:
         ]
         workers: List[asyncio.Task] = []
         announce_task = None
-        if server is not None and self.dht is not None:
-            announce_task = asyncio.create_task(
-                self._dht_announce(meta.info_hash, swarm.listen_port)
-            )
+        if server is not None:
+            announce_task = asyncio.create_task(self._advertise(swarm))
         announced = set(swarm.done)  # resume pieces are in the bitfield
         try:
             while not swarm.complete:
@@ -313,6 +333,15 @@ class TorrentClient:
                     for index in swarm.done - announced:
                         announced.add(index)
                         await server.add_piece(index)
+            # download complete: give the discovery registration a bounded
+            # grace — a fast download must not cancel the re-announce that
+            # makes the lingering seed findable by sibling replicas
+            if announce_task is not None and not announce_task.done():
+                try:
+                    async with asyncio.timeout(5.0):
+                        await announce_task
+                except TimeoutError:
+                    pass
         finally:
             reporter.cancel()
             if announce_task is not None:
@@ -322,13 +351,33 @@ class TorrentClient:
             await asyncio.gather(reporter, *workers, *ws_workers,
                                  return_exceptions=True)
 
-    async def _dht_announce(self, info_hash: bytes, port: int) -> None:
-        """Register our serving socket in the DHT (best-effort)."""
-        try:
-            ok = await self.dht.announce(info_hash, port)
-            self._log("dht announce", confirmed_by=ok)
-        except Exception as err:
-            self._log("dht announce failed", error=str(err))
+    async def _advertise(self, swarm: _Swarm) -> None:
+        """Register our serving socket with every discovery channel
+        (best-effort): the DHT, and a tracker re-announce carrying the real
+        listen port — real trackers hand that address to other announcers,
+        so replicas staging the same torrent find each other.  Peers the
+        re-announce returns feed the worker pool like ut_pex gossip.
+
+        Channels run concurrently: a slow DHT walk or one dead tracker
+        must not starve the others inside the completion grace window."""
+        meta = swarm.meta
+        port = swarm.listen_port
+        left = max(meta.total_length - swarm.bytes_done, 0)
+
+        async def _dht() -> None:
+            try:
+                ok = await self.dht.announce(meta.info_hash, port)
+                self._log("dht announce", confirmed_by=ok)
+            except Exception as err:
+                self._log("dht announce failed", error=str(err))
+
+        jobs = [self._announce_all(meta.trackers, meta.info_hash, left,
+                                   port=port)]
+        if self.dht is not None:
+            jobs.append(_dht())
+        results = await asyncio.gather(*jobs)
+        for peer in results[0]:
+            swarm.discovered.put_nowait((peer.host, peer.port))
 
     # ------------------------------------------------------------------
     async def _resolve(self, uri: str, peers, metadata_timeout: float):
@@ -406,17 +455,30 @@ class TorrentClient:
         return out
 
     async def _announce_all(self, trackers: List[str], info_hash: bytes,
-                            left: int) -> List[tracker_mod.Peer]:
-        # dedup is owned by _merge_peers at the call sites
-        out: List[tracker_mod.Peer] = []
-        for url in trackers:
+                            left: int, port: int = 0,
+                            event: str = "started") -> List[tracker_mod.Peer]:
+        """Announce to every tracker concurrently (dead trackers must not
+        serialize their timeouts) and pool the peers they return — dedup
+        is owned by _merge_peers at the call sites.
+
+        ``port=0`` marks a discover-only announce: we are not (yet)
+        listening, and registering trackers must not hand our address out
+        (0 is the BEP 23 "not connectable" convention).  The re-announce
+        from :meth:`_advertise` passes the real serve port.
+        """
+        async def _one(url: str) -> List[tracker_mod.Peer]:
             try:
-                out.extend(await tracker_mod.announce(
-                    url, info_hash, self.peer_id, port=6881, left=left
-                ))
+                return await tracker_mod.announce(
+                    url, info_hash, self.peer_id, port=port, left=left,
+                    event=event,
+                )
             except Exception as err:
-                self._log("tracker announce failed", tracker=url, error=str(err))
-        return out
+                self._log("tracker announce failed", tracker=url,
+                          event=event, error=str(err))
+                return []
+
+        groups = await asyncio.gather(*(_one(u) for u in trackers))
+        return [peer for group in groups for peer in group]
 
     # -- metadata over ut_metadata (BEP 9) ------------------------------
     async def _fetch_metadata(self, magnet, peers) -> Metainfo:
@@ -645,6 +707,9 @@ class TorrentClient:
             handshake = await peer.recv_handshake()
             if handshake.info_hash != info_hash:
                 raise wire.WireError("infohash mismatch in handshake")
+            if handshake.peer_id == self.peer_id:
+                # tracker/pex can echo our own advertised address back
+                raise wire.WireError("connected to self")
             if handshake.supports_extensions:
                 await peer.send_ext_handshake(listen_port=listen_port)
             return peer

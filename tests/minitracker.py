@@ -18,9 +18,13 @@ from downloader_tpu.torrent.bencode import bencode
 
 
 class MiniTracker:
+    """Like a real tracker, announcers are registered and served back to
+    later announcers (minus the requester), on top of a fixed seed list."""
+
     def __init__(self, peers: List[Tuple[str, int]]):
         self.peers = list(peers)
         self.announces: list = []
+        self.registered: dict = {}  # (ip, port) -> peer_id
         self._runner = None
         self.port = None
 
@@ -35,9 +39,23 @@ class MiniTracker:
             return web.Response(
                 body=bencode({b"failure reason": b"bad info_hash length"})
             )
+        requester = None
+        try:
+            port = int(raw.get("port", b"0"))
+        except ValueError:
+            port = 0
+        if request.remote and 0 < port < 65536:
+            requester = (request.remote, port)
+            if raw.get("event") == b"stopped":
+                self.registered.pop(requester, None)
+            else:
+                self.registered[requester] = raw.get("peer_id", b"")
+        swarm = list(self.peers) + [
+            addr for addr in self.registered if addr != requester
+        ]
         compact = b"".join(
             socket.inet_aton(host) + struct.pack(">H", port)
-            for host, port in self.peers
+            for host, port in swarm
         )
         return web.Response(
             body=bencode({b"interval": 60, b"peers": compact})

@@ -633,3 +633,49 @@ async def test_pex_gossip_between_peers(swarm, tmp_path):
         await gossiper.close()
         await hidden.stop()
         await asyncio.sleep(0)
+
+
+async def test_tracker_reannounce_registers_replica(swarm, tmp_path):
+    """A downloading replica re-announces its serve socket to the tracker;
+    a later replica discovers it via the tracker alone (empty fixed list)
+    and completes against it."""
+    # tracker with NO fixed peers: discovery must come from registration
+    tracker = MiniTracker([])
+    tracker_url = await tracker.start()
+    meta = make_metainfo(str(tmp_path / "seed" / swarm.meta.name),
+                         piece_length=1 << 14, trackers=[tracker_url])
+    torrent_file = tmp_path / "replica.torrent"
+    torrent_file.write_bytes(meta.to_torrent_bytes())
+    client_a = TorrentClient()
+    client_b = TorrentClient()
+    try:
+        # replica A: origin passed explicitly (tracker knows nobody yet);
+        # its _advertise re-announce registers its serve port
+        await client_a.download(
+            str(torrent_file), str(tmp_path / "rep-a"),
+            peers=[Peer("127.0.0.1", swarm.seeder.port)],
+            seed_linger=30, listen_host="127.0.0.1",
+        )
+        assert client_a.is_seeding
+        registered_ports = {port for _ip, port in tracker.registered}
+        assert client_a.serving_port(meta.info_hash) in registered_ports
+
+        # replica B: no explicit peers — tracker hands it replica A
+        got = await client_b.download(
+            str(torrent_file), str(tmp_path / "rep-b")
+        )
+        assert got.info_hash == swarm.meta.info_hash
+        for name, data in swarm.files.items():
+            with open(os.path.join(str(tmp_path / "rep-b"), got.name, name),
+                      "rb") as fh:
+                assert fh.read() == data
+
+        # closing replica A sends event=stopped: the tracker must stop
+        # handing out its now-dead address
+        port_a = client_a.serving_port(meta.info_hash)
+        await client_a.close()
+        assert port_a not in {p for _ip, p in tracker.registered}
+    finally:
+        await client_a.close()
+        await client_b.close()
+        await tracker.stop()
