@@ -780,6 +780,7 @@ class TorrentClient:
                 requested.add(begin)
                 outstanding.add(begin)
 
+        idle_rounds = 0
         try:
             while not swarm.complete:
                 try:
@@ -788,7 +789,11 @@ class TorrentClient:
                     # piece another worker released
                     async with asyncio.timeout(5.0):
                         msg_id, payload = await peer.recv_message()
+                    idle_rounds = 0
                 except TimeoutError:
+                    idle_rounds += 1
+                    if idle_rounds % 12 == 0:  # ~60 s idle: BEP 3 keep-alive
+                        await peer.send_keepalive()
                     await _pump_requests()
                     continue
                 if msg_id is None:

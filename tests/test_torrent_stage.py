@@ -209,3 +209,77 @@ async def test_seed_linger_config_keeps_serving_until_shutdown(
 
     await tracker.stop()
     await seeder.stop()
+
+
+async def test_two_service_replicas_share_swarm_via_tracker(
+    tmp_path, monkeypatch
+):
+    """Service-level replica cooperation: two orchestrators stage the SAME
+    magnet; each registers its serve socket with the tracker (via the
+    download stage's seed-while-leech + re-announce), so the second
+    replica can pull pieces from the first, not just the origin."""
+    import asyncio
+
+    src, files = make_payload_dir(tmp_path, [90_000, 45_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    origin = Seeder(meta, str(src.parent))
+    origin_port = await origin.start()
+    tracker = MiniTracker([("127.0.0.1", origin_port)])
+    tracker_url = await tracker.start()
+    magnet = make_magnet(meta.info_hash, meta.name, [tracker_url])
+
+    monkeypatch.setenv("SEED_LINGER", "60")
+    replicas = []
+    brokers = []
+    stores = []
+    try:
+        for i in range(2):
+            broker = InMemoryBroker()
+            store = InMemoryObjectStore()
+            config = ConfigNode({"instance": {
+                "download_path": str(tmp_path / f"dl-{i}")
+            }})
+            telem_mq = MemoryQueue(broker)
+            await telem_mq.connect()
+            orch = Orchestrator(
+                config=config, mq=MemoryQueue(broker), store=store,
+                telemetry=Telemetry(telem_mq), logger=NullLogger(),
+            )
+            await orch.start()
+            replicas.append(orch)
+            brokers.append(broker)
+            stores.append(store)
+
+        for i, broker in enumerate(brokers):
+            msg = schemas.Download(
+                media=schemas.Media(
+                    id=f"rep-{i}", creator_id=f"card-{i}", name="Great Show",
+                    type=schemas.MediaType.Value("TV"),
+                    source=schemas.SourceType.Value("TORRENT"),
+                    source_uri=magnet,
+                )
+            )
+            broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await asyncio.gather(*(
+            b.join(schemas.DOWNLOAD_QUEUE, timeout=60) for b in brokers
+        ))
+
+        # both replicas staged everything
+        for i, store in enumerate(stores):
+            for name in files:
+                base = os.path.basename(name)
+                assert await store.get_object(
+                    STAGING_BUCKET, object_name(f"rep-{i}", base)
+                ) == files[name]
+
+        # both replicas' serve sockets got registered with the tracker
+        # (ports distinct from the origin seeder's)
+        registered = {port for _ip, port in tracker.registered}
+        assert len(registered - {origin_port}) >= 2, (
+            f"expected both replicas registered, got {tracker.registered}"
+        )
+    finally:
+        for orch in replicas:
+            await orch.shutdown(grace_seconds=5)
+        await tracker.stop()
+        await origin.stop()
