@@ -1,0 +1,140 @@
+"""Operator CLI: submit jobs and build torrents without writing a client.
+
+The reference service is driven purely by other services publishing
+protobuf ``api.Download`` messages onto ``v1.download``
+(/root/reference/lib/main.js:172); operators had no tool to enqueue a job
+by hand.  This closes that gap:
+
+    python -m downloader_tpu.cli submit --id my-movie --name "My Movie" \
+        --type MOVIE --source http --uri http://host/movie.mkv
+    python -m downloader_tpu.cli mktorrent /path/to/media \
+        --tracker http://tracker:8000/announce --out media.torrent
+    python -m downloader_tpu.cli magnet media.torrent
+
+``submit`` publishes to the queue backend named in config (AMQP in
+production; refuses the in-memory backend, which cannot reach a running
+service in another process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from . import schemas
+from .platform.config import load_config
+from .platform.logging import get_logger
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="downloader-tpu",
+        description="Operator tools for the downloader staging service",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    submit = sub.add_parser("submit", help="enqueue one Download job")
+    submit.add_argument("--id", required=True, help="media/job id")
+    submit.add_argument("--name", required=True, help="media display name")
+    submit.add_argument("--creator-id", default="cli",
+                        help="creator/card id used in telemetry")
+    submit.add_argument(
+        "--type", default="MOVIE",
+        choices=[n for n in schemas.MediaType.keys()],
+    )
+    submit.add_argument(
+        "--source", default="http",
+        choices=[n.lower() for n in schemas.SourceType.keys()],
+    )
+    submit.add_argument("--uri", required=True,
+                        help="magnet:, http(s)://, file://, or bucket:// URI")
+    submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
+
+    mk = sub.add_parser("mktorrent", help="build a .torrent from a path")
+    mk.add_argument("path", help="file or directory to seed")
+    mk.add_argument("--tracker", action="append", default=[],
+                    help="announce URL (repeatable)")
+    mk.add_argument("--webseed", action="append", default=[],
+                    help="BEP 19 HTTP seed URL (repeatable)")
+    mk.add_argument("--piece-length", type=int, default=1 << 18)
+    mk.add_argument("--out", required=True, help="output .torrent path")
+
+    mag = sub.add_parser("magnet", help="print the magnet link of a .torrent")
+    mag.add_argument("torrent", help=".torrent file path")
+
+    return parser
+
+
+async def _submit(args) -> int:
+    from .mq import new_queue, resolve_backend
+
+    config = load_config("converter")
+    logger = get_logger("downloader-cli")
+    if resolve_backend(config) == "memory":
+        print(
+            "config selects the in-memory queue backend, which lives and "
+            "dies inside one process — a running service cannot see this "
+            "submission. Configure `rabbitmq: {backend: amqp}` first.",
+            file=sys.stderr,
+        )
+        return 2
+    msg = schemas.Download(
+        media=schemas.Media(
+            id=args.id,
+            creator_id=args.creator_id,
+            name=args.name,
+            type=schemas.MediaType.Value(args.type),
+            source=schemas.SourceType.Value(args.source.upper()),
+            source_uri=args.uri,
+        )
+    )
+    mq = new_queue(config, logger=logger)
+    await mq.connect()
+    try:
+        await mq.publish(args.queue, schemas.encode(msg))
+    finally:
+        await mq.close()
+    print(f"submitted {args.id} -> {args.queue}")
+    return 0
+
+
+def _mktorrent(args) -> int:
+    from .torrent import make_metainfo
+
+    meta = make_metainfo(
+        args.path,
+        piece_length=args.piece_length,
+        trackers=args.tracker,
+        webseeds=args.webseed,
+    )
+    with open(args.out, "wb") as fh:
+        fh.write(meta.to_torrent_bytes())
+    print(f"{args.out}: {meta.num_pieces} pieces x {meta.piece_length} "
+          f"({meta.total_length} bytes), infohash {meta.info_hash.hex()}")
+    return 0
+
+
+def _magnet(args) -> int:
+    from .torrent.magnet import make_magnet
+    from .torrent.metainfo import parse_torrent_bytes
+
+    with open(args.torrent, "rb") as fh:
+        meta = parse_torrent_bytes(fh.read())
+    print(make_magnet(meta.info_hash, meta.name, meta.trackers))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.command == "submit":
+        return asyncio.run(_submit(args))
+    if args.command == "mktorrent":
+        return _mktorrent(args)
+    if args.command == "magnet":
+        return _magnet(args)
+    raise AssertionError("unreachable")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Operator CLI: mktorrent/magnet round-trips and job submission over a
+live (hermetic) AMQP broker."""
+
+import asyncio
+import os
+
+import pytest
+
+from downloader_tpu import cli, schemas
+from downloader_tpu.torrent.magnet import parse_magnet
+from downloader_tpu.torrent.metainfo import parse_torrent_bytes
+
+from miniamqp import MiniAmqpServer
+
+pytestmark = pytest.mark.anyio
+
+
+def test_mktorrent_and_magnet_roundtrip(tmp_path, capsys):
+    src = tmp_path / "media"
+    src.mkdir()
+    (src / "a.mkv").write_bytes(os.urandom(40_000))
+    out = str(tmp_path / "media.torrent")
+    rc = cli.main([
+        "mktorrent", str(src),
+        "--tracker", "http://t.example/announce",
+        "--webseed", "http://ws.example/media/",
+        "--piece-length", str(1 << 14),
+        "--out", out,
+    ])
+    assert rc == 0
+    with open(out, "rb") as fh:
+        meta = parse_torrent_bytes(fh.read())
+    assert meta.trackers == ["http://t.example/announce"]
+    assert meta.webseeds == ["http://ws.example/media/"]
+    assert meta.total_length == 40_000
+
+    rc = cli.main(["magnet", out])
+    assert rc == 0
+    printed = capsys.readouterr().out.strip().splitlines()[-1]
+    magnet = parse_magnet(printed)
+    assert magnet.info_hash == meta.info_hash
+    assert magnet.trackers == ["http://t.example/announce"]
+
+
+def test_submit_refuses_memory_backend(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("CONFIG_PATH", str(tmp_path))  # no yaml -> defaults
+    rc = cli.main([
+        "submit", "--id", "j1", "--name", "X",
+        "--source", "http", "--uri", "http://h/x.mkv",
+    ])
+    assert rc == 2
+    assert "in-memory queue backend" in capsys.readouterr().err
+
+
+async def test_submit_publishes_over_amqp(tmp_path, monkeypatch):
+    server = await MiniAmqpServer().start()
+    try:
+        (tmp_path / "converter.yaml").write_text(
+            "rabbitmq: {backend: amqp}\n"
+            f"services: {{rabbitmq: \"{server.url}\"}}\n"
+        )
+        monkeypatch.setenv("CONFIG_PATH", str(tmp_path))
+
+        # cli.main runs its own event loop; keep this test's loop free
+        rc = await asyncio.to_thread(cli.main, [
+            "submit", "--id", "cli-job", "--name", "A Show",
+            "--type", "TV", "--source", "torrent",
+            "--uri", "magnet:?xt=urn:btih:" + "00" * 20,
+        ])
+        assert rc == 0
+
+        from downloader_tpu.mq.amqp import AmqpQueue
+
+        got: list = []
+        done = asyncio.Event()
+
+        async def handler(delivery):
+            got.append(delivery.body)
+            await delivery.ack()
+            done.set()
+
+        mq = AmqpQueue(server.url, heartbeat=0)
+        await mq.connect()
+        try:
+            await mq.listen(schemas.DOWNLOAD_QUEUE, handler)
+            async with asyncio.timeout(10):
+                await done.wait()
+        finally:
+            await mq.close()
+
+        msg = schemas.decode(schemas.Download, got[0])
+        assert msg.media.id == "cli-job"
+        assert msg.media.source == schemas.SourceType.Value("TORRENT")
+    finally:
+        await server.stop()
