@@ -40,12 +40,12 @@ def _build_parser() -> argparse.ArgumentParser:
     submit.add_argument("--creator-id", default="cli",
                         help="creator/card id used in telemetry")
     submit.add_argument(
-        "--type", default="MOVIE",
-        choices=[n for n in schemas.MediaType.keys()],
+        "--type", default="MOVIE", type=str.upper,
+        choices=list(schemas.MediaType.keys()),
     )
     submit.add_argument(
-        "--source", default="http",
-        choices=[n.lower() for n in schemas.SourceType.keys()],
+        "--source", default="HTTP", type=str.upper,
+        choices=list(schemas.SourceType.keys()),
     )
     submit.add_argument("--uri", required=True,
                         help="magnet:, http(s)://, file://, or bucket:// URI")
@@ -57,7 +57,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="announce URL (repeatable)")
     mk.add_argument("--webseed", action="append", default=[],
                     help="BEP 19 HTTP seed URL (repeatable)")
-    mk.add_argument("--piece-length", type=int, default=1 << 18)
+    def _piece_length(value: str) -> int:
+        n = int(value)
+        if n < (1 << 14):
+            raise argparse.ArgumentTypeError(
+                "piece length must be >= 16384 (BEP 3 block size)"
+            )
+        return n
+
+    mk.add_argument("--piece-length", type=_piece_length, default=1 << 18)
     mk.add_argument("--out", required=True, help="output .torrent path")
 
     mag = sub.add_parser("magnet", help="print the magnet link of a .torrent")
@@ -85,7 +93,7 @@ async def _submit(args) -> int:
             creator_id=args.creator_id,
             name=args.name,
             type=schemas.MediaType.Value(args.type),
-            source=schemas.SourceType.Value(args.source.upper()),
+            source=schemas.SourceType.Value(args.source),
             source_uri=args.uri,
         )
     )

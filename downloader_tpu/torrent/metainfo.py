@@ -145,6 +145,12 @@ def make_metainfo(
     Directory sources become multi-file torrents with deterministic
     (sorted) file order.
     """
+    if piece_length < BLOCK_SIZE:
+        # non-positive values would spin _feed forever; tiny ones break
+        # the universal 16 KiB request granularity
+        raise ValueError(
+            f"piece_length {piece_length} < BLOCK_SIZE {BLOCK_SIZE}"
+        )
     root = os.path.abspath(root)
     name = name or os.path.basename(root)
 

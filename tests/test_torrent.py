@@ -679,3 +679,10 @@ async def test_tracker_reannounce_registers_replica(swarm, tmp_path):
         await client_a.close()
         await client_b.close()
         await tracker.stop()
+
+
+def test_make_metainfo_rejects_tiny_piece_length(tmp_path):
+    src = tmp_path / "f.bin"
+    src.write_bytes(b"x" * 100)
+    with pytest.raises(ValueError):
+        make_metainfo(str(src), piece_length=0)
