@@ -22,7 +22,7 @@ import posixpath
 import shutil
 
 from .. import schemas
-from ..utils.hashing import md5_file_hex
+from ..utils.hashing import md5_file_hex, multipart_etag_hex
 from .base import Job, StageContext, StageFn
 
 STAGING_BUCKET = "triton-staging"
@@ -57,6 +57,18 @@ async def _already_staged(store, name: str, file_path: str) -> bool:
         return False
     if not info.etag or info.size != os.path.getsize(file_path):
         return False
+    if "-" in info.etag:
+        # multipart object: its etag is md5-of-part-md5s at the store's
+        # part size, which we can recompute locally — without this, every
+        # large (multipart) file would re-upload on redelivery, exactly
+        # the files resume matters for
+        part_size = getattr(store, "multipart_part_size", None)
+        if not part_size:
+            return False
+        expected = await asyncio.to_thread(
+            multipart_etag_hex, file_path, part_size
+        )
+        return info.etag == expected
     return info.etag == await asyncio.to_thread(md5_file_hex, file_path)
 
 

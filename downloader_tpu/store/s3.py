@@ -14,10 +14,12 @@ Works against MinIO, AWS S3, GCS interop mode, or the in-repo test server
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import hashlib
 import hmac
 import os
+import re
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import AsyncIterator, Dict, Optional
@@ -151,6 +153,11 @@ class S3ObjectStore(ObjectStore):
         self._host = parsed.netloc
         self._signer = SigV4Signer(access_key, secret_key, region)
         self._session = session
+        # multipart kicks in above the threshold; 64 MiB parts match the
+        # common S3 client defaults (min part size is 5 MiB per the API)
+        self.multipart_threshold = 64 << 20
+        self.multipart_part_size = 64 << 20
+        self.multipart_concurrency = 3
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -236,9 +243,18 @@ class S3ObjectStore(ObjectStore):
             resp.release()
 
     async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
-        """Streaming PUT from disk using an UNSIGNED-PAYLOAD SigV4 signature,
-        so large files are neither slurped into memory nor double-hashed."""
+        """Upload a file from disk.
+
+        Small files go up as one streaming PUT with an UNSIGNED-PAYLOAD
+        SigV4 signature (no slurping, no double hashing).  Files over
+        ``multipart_threshold`` use S3 multipart upload: fixed-size parts
+        with per-part retry, so one dropped connection at the 60-GB mark of
+        a media file costs one part, not the whole transfer; failures abort
+        the upload server-side so no orphaned parts accrue storage."""
         size = os.path.getsize(file_path)
+        if size > self.multipart_threshold:
+            await self._multipart_upload(bucket, name, file_path, size)
+            return
         path = self._object_path(bucket, name)
         headers = self._signer.sign(
             "PUT", self._host, path, {}, "UNSIGNED-PAYLOAD"
@@ -257,6 +273,123 @@ class S3ObjectStore(ObjectStore):
         if resp.status not in (200, 204):
             raise RuntimeError(f"fput_object failed: {resp.status} {body!r}")
 
+    # -- multipart upload ----------------------------------------------
+    async def _multipart_upload(self, bucket: str, name: str,
+                                file_path: str, size: int) -> None:
+        path = self._object_path(bucket, name)
+        resp = await self._request("POST", path, query={"uploads": ""})
+        body = await resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"initiate multipart failed: {resp.status} {body!r}"
+            )
+        match = re.search(rb"<UploadId>([^<]+)</UploadId>", body)
+        if match is None:
+            raise RuntimeError(f"initiate multipart: no UploadId in {body!r}")
+        upload_id = match.group(1).decode()
+
+        try:
+            etags = await self._upload_parts(path, upload_id, file_path, size)
+            manifest = "".join(
+                f"<Part><PartNumber>{num}</PartNumber>"
+                f"<ETag>{etag}</ETag></Part>"
+                for num, etag in etags
+            )
+            payload = (
+                f"<CompleteMultipartUpload>{manifest}"
+                f"</CompleteMultipartUpload>"
+            ).encode()
+            resp = await self._request(
+                "POST", path, query={"uploadId": upload_id}, data=payload
+            )
+            body = await resp.read()
+            if resp.status != 200 or b"<Error>" in body:
+                raise RuntimeError(
+                    f"complete multipart failed: {resp.status} {body!r}"
+                )
+        except BaseException:
+            # abort so the server drops the stored parts (otherwise they
+            # bill storage forever with no visible object)
+            try:
+                resp = await self._request(
+                    "DELETE", path, query={"uploadId": upload_id}
+                )
+                resp.release()
+            except Exception:
+                pass
+            raise
+
+    async def _upload_parts(self, path: str, upload_id: str,
+                            file_path: str, size: int):
+        """Upload fixed-size parts with bounded concurrency + per-part
+        retry; returns [(part_number, etag)] in order."""
+        part_size = self.multipart_part_size
+        part_count = (size + part_size - 1) // part_size
+        sem = asyncio.Semaphore(self.multipart_concurrency)
+
+        def _read_region(offset: int, length: int) -> bytes:
+            with open(file_path, "rb") as fh:
+                fh.seek(offset)
+                return fh.read(length)
+
+        async def _one(part_number: int):
+            offset = (part_number - 1) * part_size
+            length = min(part_size, size - offset)
+            async with sem:
+                # re-read per attempt (in a thread: a 64 MiB read must not
+                # stall the event loop) — the file region is the source of
+                # truth, a shared buffer would pin memory for queued parts
+                last: Optional[Exception] = None
+                for attempt in range(3):
+                    data = await asyncio.to_thread(
+                        _read_region, offset, length
+                    )
+                    try:
+                        resp = await self._request(
+                            "PUT", path,
+                            query={
+                                "partNumber": str(part_number),
+                                "uploadId": upload_id,
+                            },
+                            data=data,
+                        )
+                        body = await resp.read()
+                        if resp.status == 200:
+                            etag = resp.headers.get("ETag", "").strip('"')
+                            if not etag:
+                                # fabricating a local md5 here would turn a
+                                # proxy quirk into a confusing InvalidPart
+                                # at complete time — fail where the cause is
+                                raise RuntimeError(
+                                    f"part {part_number}: response has no "
+                                    "ETag header"
+                                )
+                            return part_number, etag
+                        last = RuntimeError(
+                            f"part {part_number}: {resp.status} {body!r}"
+                        )
+                    except (aiohttp.ClientError, OSError) as err:
+                        last = err
+                    await asyncio.sleep(0.2 * (attempt + 1))
+                raise RuntimeError(
+                    f"part {part_number} failed after retries: {last}"
+                )
+
+        tasks = [
+            asyncio.create_task(_one(n)) for n in range(1, part_count + 1)
+        ]
+        try:
+            results = await asyncio.gather(*tasks)
+        except BaseException:
+            # settle the siblings BEFORE the caller aborts the upload: a
+            # part PUT landing after AbortMultipartUpload re-creates
+            # orphaned (billed) parts on real S3
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        return sorted(results)
+
     async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
         resp = await self._request("HEAD", self._object_path(bucket, name))
         resp.release()
@@ -264,11 +397,11 @@ class S3ObjectStore(ObjectStore):
             raise ObjectNotFound(bucket, name)
         if resp.status != 200:
             raise RuntimeError(f"stat_object failed: {resp.status}")
-        # S3 ETag is the MD5 hex for single-part uploads; multipart etags
-        # (``...-N``) are not content MD5s, so expose those as unknown
+        # S3 ETag: MD5 hex for single-part uploads, md5-of-part-md5s with
+        # a ``-N`` suffix for multipart — exposed verbatim; callers that
+        # verify content handle both forms (see stages/upload.py
+        # _already_staged / utils.hashing.multipart_etag_hex)
         etag = resp.headers.get("ETag", "").strip('"')
-        if "-" in etag:
-            etag = ""
         return ObjectInfo(
             name=name,
             size=int(resp.headers.get("Content-Length", 0)),

@@ -19,3 +19,27 @@ def md5_file_hex(path: str) -> str:
         while chunk := fh.read(_CHUNK):
             digest.update(chunk)
     return digest.hexdigest()
+
+
+def multipart_etag_hex(path: str, part_size: int) -> str:
+    """The S3 multipart ETag for a file at a given part size:
+    ``md5(concat(md5(part_i)))-N`` — verifiable locally, so the upload
+    stage's resume guard works for multipart objects too."""
+    digests = []
+    with open(path, "rb") as fh:
+        while True:
+            part = hashlib.md5()
+            remaining = part_size
+            got = 0
+            while remaining > 0:
+                chunk = fh.read(min(_CHUNK, remaining))
+                if not chunk:
+                    break
+                part.update(chunk)
+                got += len(chunk)
+                remaining -= len(chunk)
+            if got == 0:
+                break
+            digests.append(part.digest())
+    combined = hashlib.md5(b"".join(digests)).hexdigest()
+    return f"{combined}-{len(digests)}"

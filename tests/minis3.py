@@ -31,6 +31,9 @@ class MiniS3:
         self.page_size = page_size  # small to force pagination in tests
         self.buckets: dict = {}
         self.auth_failures: list = []
+        self.multipart_uploads: dict = {}  # uploadId -> {bucket,key,parts}
+        self.etags: dict = {}  # bucket -> {key -> multipart etag}
+        self.fail_parts: set = set()  # part numbers to 500 once (chaos)
         self._runner = None
         self.port = None
 
@@ -159,22 +162,84 @@ class MiniS3:
         return web.Response(status=405)
 
     async def _object_op(self, request, bucket, key, body):
+        # -- multipart upload (initiate / part / complete / abort) -------
+        if request.method == "POST" and "uploads" in request.query:
+            upload_id = f"up-{len(self.multipart_uploads)}"
+            self.multipart_uploads[upload_id] = {
+                "bucket": bucket, "key": key, "parts": {},
+            }
+            xml = (
+                "<InitiateMultipartUploadResult>"
+                f"<Bucket>{bucket}</Bucket><Key>{saxutils.escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            )
+            return web.Response(body=xml.encode(), content_type="application/xml")
+        if request.method == "PUT" and "uploadId" in request.query:
+            upload = self.multipart_uploads.get(request.query["uploadId"])
+            if upload is None:
+                return web.Response(status=404, text="NoSuchUpload")
+            part_number = int(request.query["partNumber"])
+            if self.fail_parts and part_number in self.fail_parts:
+                self.fail_parts.discard(part_number)  # fail once, then heal
+                return web.Response(status=500, text="InternalError")
+            upload["parts"][part_number] = body
+            import hashlib
+
+            return web.Response(
+                status=200,
+                headers={"ETag": f'"{hashlib.md5(body).hexdigest()}"'},
+            )
+        if request.method == "POST" and "uploadId" in request.query:
+            upload = self.multipart_uploads.pop(
+                request.query["uploadId"], None
+            )
+            if upload is None:
+                return web.Response(status=404, text="NoSuchUpload")
+            import hashlib
+
+            ordered = [data for _n, data in sorted(upload["parts"].items())]
+            assembled = b"".join(ordered)
+            self.buckets.setdefault(bucket, {})[key] = assembled
+            # real S3 multipart etag: md5 of the binary part-md5s + "-N"
+            combined = hashlib.md5(
+                b"".join(hashlib.md5(p).digest() for p in ordered)
+            ).hexdigest()
+            self.etags.setdefault(bucket, {})[key] = f"{combined}-{len(ordered)}"
+            xml = (
+                "<CompleteMultipartUploadResult>"
+                f"<Key>{saxutils.escape(key)}</Key>"
+                "</CompleteMultipartUploadResult>"
+            )
+            return web.Response(body=xml.encode(), content_type="application/xml")
+        if request.method == "DELETE" and "uploadId" in request.query:
+            existed = self.multipart_uploads.pop(
+                request.query["uploadId"], None
+            )
+            return web.Response(status=204 if existed else 404)
+
         if request.method == "PUT":
             self.buckets.setdefault(bucket, {})[key] = body
+            # single PUT overwrites any earlier multipart identity
+            self.etags.get(bucket, {}).pop(key, None)
             return web.Response(status=200)
         if request.method in ("GET", "HEAD"):
             data = self.buckets.get(bucket, {}).get(key)
             if data is None:
                 return web.Response(status=404, text="NoSuchKey")
             if request.method == "HEAD":
-                # like real S3: metadata-only, Content-Length + MD5 ETag
+                # like real S3: metadata-only; multipart objects report
+                # their md5-of-part-md5s etag, others the content MD5
                 import hashlib
 
+                etag = self.etags.get(bucket, {}).get(
+                    key, hashlib.md5(data).hexdigest()
+                )
                 return web.Response(
                     body=b"",
                     headers={
                         "Content-Length": str(len(data)),
-                        "ETag": f'"{hashlib.md5(data).hexdigest()}"',
+                        "ETag": f'"{etag}"',
                     },
                 )
             return web.Response(body=data)

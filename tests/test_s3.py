@@ -1,6 +1,8 @@
 """S3 driver tests against the in-process mini-S3 server (SigV4 verified
 server-side)."""
 
+import os
+
 import pytest
 
 from downloader_tpu.mq import InMemoryBroker
@@ -136,3 +138,86 @@ async def test_bucket_stage_uses_s3_driver(server, tmp_path):
     with open(f"{result['path']}/ep1.mkv", "rb") as fh:
         assert fh.read() == b"episode-one"
     assert server.auth_failures == []
+
+
+# -- multipart upload ---------------------------------------------------
+async def test_fput_multipart_roundtrip(client, server, tmp_path):
+    """A file over the threshold goes up in parts and reassembles exactly."""
+    client.multipart_threshold = 1 << 16   # 64 KiB for the test
+    client.multipart_part_size = 1 << 16
+    payload = bytes(range(256)) * 1024     # 256 KiB -> 4 parts
+    src = tmp_path / "big.mkv"
+    src.write_bytes(payload)
+    await client.make_bucket("staging")
+    await client.fput_object("staging", "media/big.mkv", str(src))
+    assert server.buckets["staging"]["media/big.mkv"] == payload
+    assert not server.multipart_uploads  # completed, not dangling
+
+
+async def test_fput_multipart_retries_failed_part(client, server, tmp_path):
+    """A part that 500s once is retried and the object still assembles."""
+    client.multipart_threshold = 1 << 16
+    client.multipart_part_size = 1 << 16
+    server.fail_parts = {2}
+    payload = b"q" * (3 * (1 << 16) + 17)
+    src = tmp_path / "flaky.mkv"
+    src.write_bytes(payload)
+    await client.make_bucket("staging")
+    await client.fput_object("staging", "flaky.mkv", str(src))
+    assert server.buckets["staging"]["flaky.mkv"] == payload
+
+
+async def test_fput_multipart_aborts_on_hard_failure(client, server, tmp_path):
+    """If a part keeps failing, the upload aborts server-side: no object,
+    no dangling parts accruing storage."""
+    client.multipart_threshold = 1 << 16
+    client.multipart_part_size = 1 << 16
+    # fail part 2 on every attempt (refill the chaos set on each hit)
+    class Always(set):
+        def discard(self, _item):
+            pass
+    server.fail_parts = Always({2})
+    payload = b"z" * (3 * (1 << 16))
+    src = tmp_path / "doomed.mkv"
+    src.write_bytes(payload)
+    await client.make_bucket("staging")
+    with pytest.raises(RuntimeError):
+        await client.fput_object("staging", "doomed.mkv", str(src))
+    assert "doomed.mkv" not in server.buckets.get("staging", {})
+    assert not server.multipart_uploads
+
+
+async def test_fput_below_threshold_stays_single_put(client, server, tmp_path):
+    payload = b"s" * 1024
+    src = tmp_path / "small.mkv"
+    src.write_bytes(payload)
+    await client.make_bucket("staging")
+    await client.fput_object("staging", "small.mkv", str(src))
+    assert server.buckets["staging"]["small.mkv"] == payload
+    assert not server.multipart_uploads
+
+
+async def test_multipart_object_resume_guard(client, server, tmp_path):
+    """After a multipart upload, the upload stage's resume guard verifies
+    the staged object via the md5-of-part-md5s etag — a redelivered job
+    skips re-uploading the large file instead of always re-sending it."""
+    from downloader_tpu.stages.upload import _already_staged
+    from downloader_tpu.utils.hashing import multipart_etag_hex
+
+    client.multipart_threshold = 1 << 16
+    client.multipart_part_size = 1 << 16
+    payload = os.urandom(3 * (1 << 16) + 123)
+    src = tmp_path / "resume.mkv"
+    src.write_bytes(payload)
+    await client.make_bucket("triton-staging")
+    await client.fput_object("triton-staging", "resume.mkv", str(src))
+
+    info = await client.stat_object("triton-staging", "resume.mkv")
+    assert info.etag.endswith("-4")
+    assert info.etag == multipart_etag_hex(str(src), 1 << 16)
+    assert await _already_staged(client, "resume.mkv", str(src))
+
+    # different local bytes must NOT short-circuit
+    other = tmp_path / "other.mkv"
+    other.write_bytes(os.urandom(len(payload)))
+    assert not await _already_staged(client, "resume.mkv", str(other))
