@@ -80,6 +80,16 @@ class Metrics:
             ["queue"],
             registry=self.registry,
         )
+        self.torrent_hash_failures = Counter(
+            f"{ns}_torrent_piece_hash_failures_total",
+            "Torrent pieces that failed SHA-1 verification",
+            registry=self.registry,
+        )
+        self.torrent_bytes_served = Counter(
+            f"{ns}_torrent_bytes_served_total",
+            "Bytes served back to the swarm while leeching/seeding",
+            registry=self.registry,
+        )
 
     def render(self) -> bytes:
         """Prometheus text exposition of the registry."""

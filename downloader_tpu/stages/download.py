@@ -205,6 +205,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 last_emitted[0] = percent
                 await telemetry.emit_progress(file_id, downloading, percent)
 
+        stats: dict = {}
         await client.download(
             resource_url,
             download_path,
@@ -213,7 +214,22 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             progress_interval=PROGRESS_INTERVAL_SECONDS,
             on_progress=on_progress,
             seed_linger=seed_linger,
+            stats_out=stats,
         )
+        if ctx.metrics is not None and stats:
+            m = ctx.metrics
+            m.bytes_downloaded.labels(protocol="torrent-peer").inc(
+                stats["bytes_from_peers"]
+            )
+            m.bytes_downloaded.labels(protocol="torrent-webseed").inc(
+                stats["bytes_from_webseeds"]
+            )
+            m.torrent_hash_failures.inc(stats["hash_failures"])
+            m.torrent_bytes_served.inc(stats["bytes_served"])
+        if stats:
+            logger.info("torrent complete", **{
+                k: v for k, v in stats.items()
+            })
 
     async def http(resource_url: str, file_id: str, download_path: str, job: Job):
         logger.info("http", url=resource_url)

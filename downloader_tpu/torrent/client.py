@@ -74,6 +74,10 @@ class _Swarm:
         self.discovered: asyncio.Queue = asyncio.Queue()
         # our serving socket, advertised to peers (BEP 10 ``p``)
         self.listen_port: Optional[int] = None
+        # accounting for observability (surfaced via download(stats_out=))
+        self.hash_failures = 0
+        self.bytes_resumed = 0
+        self.bytes_from_webseeds = 0
 
     @property
     def complete(self) -> bool:
@@ -169,6 +173,7 @@ class TorrentClient:
         listen: bool = True,
         listen_host: str = "0.0.0.0",
         seed_linger: float = 0.0,
+        stats_out: Optional[dict] = None,
     ) -> Metainfo:
         """Fetch the torrent behind ``uri`` into ``download_path``.
 
@@ -192,6 +197,8 @@ class TorrentClient:
 
         if swarm.complete:
             self._log("all pieces already on disk")
+            if stats_out is not None:
+                stats_out.update(self._swarm_stats(swarm, None))
             if on_progress is not None:
                 await on_progress(1.0)
             return meta
@@ -232,10 +239,26 @@ class TorrentClient:
                                  swarm.listen_port)
                 else:
                     await server.stop()
+            if stats_out is not None:
+                stats_out.update(self._swarm_stats(swarm, server))
 
         if on_progress is not None:
             await on_progress(1.0)
         return meta
+
+    @staticmethod
+    def _swarm_stats(swarm: _Swarm, server) -> dict:
+        """Per-download accounting for the caller's metrics."""
+        return {
+            "pieces": len(swarm.done),
+            "bytes_total": swarm.bytes_done,
+            "bytes_resumed": swarm.bytes_resumed,
+            "bytes_from_webseeds": swarm.bytes_from_webseeds,
+            "bytes_from_peers": (swarm.bytes_done - swarm.bytes_resumed
+                                 - swarm.bytes_from_webseeds),
+            "hash_failures": swarm.hash_failures,
+            "bytes_served": server.bytes_served if server is not None else 0,
+        }
 
     def _linger(self, meta: Metainfo, server, seconds: float,
                 port: int) -> None:
@@ -655,9 +678,11 @@ class TorrentClient:
                     if piece not in swarm.done:  # endgame duplicate guard
                         storage.write_piece(piece, data)
                         swarm.finish(piece)
+                        swarm.bytes_from_webseeds += meta.piece_size(piece)
                 else:
                     self._log("webseed piece hash mismatch", piece=piece,
                               url=base_url)
+                    swarm.hash_failures += 1
                     swarm.release(piece)
                     failures += 1
                     if failures >= 3:
@@ -681,6 +706,7 @@ class TorrentClient:
             swarm.pending.discard(index)
             swarm.done.add(index)
             swarm.bytes_done += meta.piece_size(index)
+            swarm.bytes_resumed += meta.piece_size(index)
         if swarm.done:
             self._log("resumed pieces from disk", count=len(swarm.done))
 
@@ -848,6 +874,7 @@ class TorrentClient:
                                 swarm.finish(claimed)
                         else:
                             self._log("piece hash mismatch", piece=claimed)
+                            swarm.hash_failures += 1
                             swarm.release(claimed)
                         claimed = None
                         buffer = None

@@ -49,6 +49,7 @@ class Seeder:
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None
         self.connections: int = 0
+        self.bytes_served: int = 0
         self._conn_tasks: Set[asyncio.Task] = set()
         self._peers: Set[wire.PeerWire] = set()
         # peers that advertised a listen port: PeerWire -> (host, port)
@@ -154,6 +155,7 @@ class Seeder:
                     index * self.meta.piece_length + begin, length
                 )
                 await peer.send_piece(index, begin, data)
+                self.bytes_served += len(data)
             elif msg_id == wire.MSG_EXTENDED:
                 await self._serve_extended(peer, payload)
             # choke/have/bitfield/cancel from a leech need no reply here

@@ -686,3 +686,35 @@ def test_make_metainfo_rejects_tiny_piece_length(tmp_path):
     src.write_bytes(b"x" * 100)
     with pytest.raises(ValueError):
         make_metainfo(str(src), piece_length=0)
+
+
+async def test_download_stats_accounting(swarm, tmp_path):
+    """stats_out splits bytes by source and counts served bytes."""
+    stats: dict = {}
+    uri = make_magnet(swarm.meta.info_hash, swarm.meta.name,
+                      [swarm.tracker_url])
+    await TorrentClient().download(uri, str(tmp_path / "dl-stats"),
+                                   stats_out=stats)
+    assert stats["bytes_total"] == swarm.meta.total_length
+    assert stats["bytes_from_peers"] == swarm.meta.total_length
+    assert stats["bytes_from_webseeds"] == 0
+    assert stats["bytes_resumed"] == 0
+    assert stats["hash_failures"] == 0
+    assert stats["pieces"] == swarm.meta.num_pieces
+
+
+async def test_webseed_stats_accounting(tmp_path):
+    stats: dict = {}
+    src, files = make_payload_dir(tmp_path, [2 * (1 << 14) + 9])
+    runner, base = await _start_webseed_server(src.parent)
+    try:
+        meta = make_metainfo(str(src), piece_length=1 << 14,
+                             webseeds=[base + "/"])
+        tf = tmp_path / "s.torrent"
+        tf.write_bytes(meta.to_torrent_bytes())
+        await TorrentClient().download(str(tf), str(tmp_path / "dl-ws-stats"),
+                                       peers=[], stats_out=stats)
+        assert stats["bytes_from_webseeds"] == meta.total_length
+        assert stats["bytes_from_peers"] == 0
+    finally:
+        await runner.cleanup()
