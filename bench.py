@@ -196,6 +196,37 @@ def bench_compute(timeout_s: float = 240.0):
         return {"error": f"compute bench bad output: {proc.stdout[:200]!r}"}
 
 
+async def bench_torrent(mib: int = 64) -> dict:
+    """Secondary: loopback swarm throughput (seeder -> leeching client,
+    real peer wire protocol, SHA-1 verification, disk on both ends)."""
+    import tempfile
+
+    from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
+
+    with tempfile.TemporaryDirectory() as tmp:
+        src_dir = os.path.join(tmp, "seed", "payload")
+        os.makedirs(src_dir)
+        with open(os.path.join(src_dir, "media.mkv"), "wb") as fh:
+            fh.write(os.urandom(mib << 20))
+        meta = make_metainfo(os.path.join(tmp, "seed", "payload"),
+                             piece_length=1 << 20)
+        seeder = Seeder(meta, os.path.join(tmp, "seed"))
+        port = await seeder.start()
+        torrent_path = os.path.join(tmp, "t.torrent")
+        with open(torrent_path, "wb") as fh:
+            fh.write(meta.to_torrent_bytes())
+        from downloader_tpu.torrent.tracker import Peer
+
+        started = time.monotonic()
+        await TorrentClient().download(
+            torrent_path, os.path.join(tmp, "dl"),
+            peers=[Peer("127.0.0.1", port)], listen=False,
+        )
+        elapsed = time.monotonic() - started
+        await seeder.stop()
+    return {"torrent_swarm_mbps": round(mib * (1 << 20) / 1e6 / elapsed, 1)}
+
+
 def main() -> None:
     pipeline = asyncio.run(bench_pipeline())
     extra = {
@@ -203,6 +234,7 @@ def main() -> None:
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
+        **asyncio.run(bench_torrent()),
         **bench_compute(),
     }
     value = round(pipeline["mbps"], 1)

@@ -35,7 +35,10 @@ from .storage import TorrentStorage
 ProgressCb = Callable[[float], Awaitable[None]]
 
 CONNECT_TIMEOUT = 10.0
-PIPELINE_DEPTH = 16
+# outstanding 16 KiB requests per peer: 64 = 1 MiB in flight, measured
+# fastest on the loopback swarm (sweep: 64 > 32 > 128 > 16) and in line
+# with what mainstream clients keep queued
+PIPELINE_DEPTH = 64
 MAX_PEERS = 8
 # biggest file we'll accept from a webseed that ignores Range requests —
 # without ranges every piece re-streams the file prefix (quadratic)
