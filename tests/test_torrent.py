@@ -718,3 +718,52 @@ async def test_webseed_stats_accounting(tmp_path):
         assert stats["bytes_from_peers"] == 0
     finally:
         await runner.cleanup()
+
+
+# -- bencode fuzzing ----------------------------------------------------
+def _random_bvalue(rng, depth=0):
+    kind = rng.randrange(4 if depth < 3 else 2)
+    if kind == 0:
+        return rng.randrange(-10**12, 10**12)
+    if kind == 1:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+    if kind == 2:
+        return [_random_bvalue(rng, depth + 1)
+                for _ in range(rng.randrange(0, 5))]
+    return {
+        bytes(rng.randrange(256) for _ in range(rng.randrange(1, 10))): (
+            _random_bvalue(rng, depth + 1)
+        )
+        for _ in range(rng.randrange(0, 5))
+    }
+
+
+def test_bencode_fuzz_roundtrip():
+    import random as random_mod
+
+    rng = random_mod.Random(0xBEEF)
+    for _ in range(200):
+        value = _random_bvalue(rng)
+        assert bdecode(bencode(value)) == value
+
+
+def test_bdecode_fuzz_never_hangs_or_crashes():
+    """Random byte soup must raise ValueError (or decode), never crash
+    with an unexpected exception type or loop forever."""
+    import random as random_mod
+
+    rng = random_mod.Random(0xF00D)
+    corpus = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, 60)))
+              for _ in range(500)]
+    # also mutate VALID encodings — nastier than pure noise
+    for _ in range(200):
+        good = bytearray(bencode(_random_bvalue(rng)))
+        if good:
+            for _ in range(rng.randrange(1, 4)):
+                good[rng.randrange(len(good))] = rng.randrange(256)
+        corpus.append(bytes(good))
+    for blob in corpus:
+        try:
+            bdecode(blob)
+        except ValueError:
+            pass
