@@ -1,0 +1,89 @@
+"""Checkpoint save/restore for the compute stage's training state.
+
+The staging pipeline's own "checkpointing" is job-level (the S3 ``done``
+marker + byte/piece/part-level transfer resume — SURVEY.md §5); this
+module is the tensor-side counterpart for the converter demo: orbax-backed
+save/restore of (params, opt_state, step) that round-trips sharded
+arrays.  On restore the arrays are placed back onto the caller's mesh
+shardings, so training resumes with the same (data x model) layout it
+left off with — single-chip and multi-chip states are interchangeable
+because orbax stores the logical array, not the device layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+def _manager(directory: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_state(directory: str, step: int, params: Any, opt_state: Any) -> None:
+    """Write checkpoint ``step`` under ``directory`` (keeps last 3)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(os.path.abspath(directory))
+    mgr.save(
+        step,
+        args=ocp.args.Composite(
+            params=ocp.args.StandardSave(params),
+            opt_state=ocp.args.StandardSave(opt_state),
+        ),
+    )
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(os.path.abspath(directory))
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+def restore_state(directory: str, params_like: Any, opt_state_like: Any,
+                  step: Optional[int] = None,
+                  plan=None) -> Tuple[int, Any, Any]:
+    """Restore (step, params, opt_state).
+
+    ``params_like``/``opt_state_like`` are abstract or concrete pytrees
+    giving shapes/dtypes (e.g. a freshly-initialized state).  When
+    ``plan`` (a :class:`~.parallel.mesh.MeshPlan`) is given, restored
+    params are placed straight into the plan's shardings — resume on a
+    different mesh shape than the save ran on Just Works.
+    """
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(os.path.abspath(directory))
+    try:
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        restored = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(params_like),
+                opt_state=ocp.args.StandardRestore(opt_state_like),
+            ),
+        )
+    finally:
+        mgr.close()
+    params, opt_state = restored["params"], restored["opt_state"]
+    if plan is not None:
+        from .parallel.mesh import shard_params
+
+        params = shard_params(plan, params)
+        opt_state = jax.device_put(opt_state, plan.replicated)
+    return step, params, opt_state
