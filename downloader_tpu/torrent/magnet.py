@@ -51,6 +51,8 @@ def parse_magnet(uri: str) -> MagnetLink:
             port_num = int(port)
         except ValueError:
             continue
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]  # bracketed IPv6 literal
         if host and 0 < port_num < 65536:  # unconnectable ports waste a
             peer_addrs.append((host, port_num))  # MAX_PEERS worker slot
     return MagnetLink(

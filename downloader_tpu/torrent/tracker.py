@@ -119,6 +119,10 @@ async def announce_http(
             out.append(
                 Peer(entry[b"ip"].decode(), entry[b"port"])
             )
+    # BEP 7: IPv6 peers arrive in a parallel compact list
+    peers6 = data.get(b"peers6", b"")
+    if isinstance(peers6, bytes):
+        out.extend(parse_compact_peers6(peers6))
     return out
 
 
@@ -167,6 +171,17 @@ def parse_compact_peers(blob: bytes) -> List[Peer]:
     for i in range(0, len(blob) - len(blob) % 6, 6):
         host = socket.inet_ntoa(blob[i:i + 4])
         (peer_port,) = struct.unpack(">H", blob[i + 4:i + 6])
+        if peer_port:
+            out.append(Peer(host, peer_port))
+    return out
+
+
+def parse_compact_peers6(blob: bytes) -> List[Peer]:
+    """BEP 7 compact IPv6 peers: 16-byte address + 2-byte port each."""
+    out = []
+    for i in range(0, len(blob) - len(blob) % 18, 18):
+        host = socket.inet_ntop(socket.AF_INET6, blob[i:i + 16])
+        (peer_port,) = struct.unpack(">H", blob[i + 16:i + 18])
         if peer_port:
             out.append(Peer(host, peer_port))
     return out

@@ -232,17 +232,24 @@ def pack_compact_peers(addrs: Iterable[Tuple[str, int]]) -> bytes:
 
 
 def parse_pex(body: bytes) -> List[Tuple[str, int]]:
-    """Extract usable (host, port) peers from a ut_pex message body."""
+    """Extract usable (host, port) peers from a ut_pex message body
+    (both the IPv4 ``added`` and IPv6 ``added6`` lists)."""
     data, _ = bdecode_prefix(body)
     if not isinstance(data, dict):  # untrusted wire bytes
         return []
-    added = data.get(b"added", b"")
-    if not isinstance(added, bytes):
-        return []
     out: List[Tuple[str, int]] = []
-    for i in range(0, len(added) - len(added) % 6, 6):
-        host = socket.inet_ntoa(added[i:i + 4])
-        (port,) = struct.unpack(">H", added[i + 4:i + 6])
-        if 0 < port < 65536:
-            out.append((host, port))
+    added = data.get(b"added", b"")
+    if isinstance(added, bytes):
+        for i in range(0, len(added) - len(added) % 6, 6):
+            host = socket.inet_ntoa(added[i:i + 4])
+            (port,) = struct.unpack(">H", added[i + 4:i + 6])
+            if 0 < port < 65536:
+                out.append((host, port))
+    added6 = data.get(b"added6", b"")
+    if isinstance(added6, bytes):
+        for i in range(0, len(added6) - len(added6) % 18, 18):
+            host = socket.inet_ntop(socket.AF_INET6, added6[i:i + 16])
+            (port,) = struct.unpack(">H", added6[i + 16:i + 18])
+            if 0 < port < 65536:
+                out.append((host, port))
     return out

@@ -21,8 +21,10 @@ class MiniTracker:
     """Like a real tracker, announcers are registered and served back to
     later announcers (minus the requester), on top of a fixed seed list."""
 
-    def __init__(self, peers: List[Tuple[str, int]]):
+    def __init__(self, peers: List[Tuple[str, int]],
+                 peers6: List[Tuple[str, int]] = ()):
         self.peers = list(peers)
+        self.peers6 = list(peers6)
         self.announces: list = []
         self.registered: dict = {}  # (ip, port) -> peer_id
         self._runner = None
@@ -57,9 +59,14 @@ class MiniTracker:
             socket.inet_aton(host) + struct.pack(">H", port)
             for host, port in swarm
         )
-        return web.Response(
-            body=bencode({b"interval": 60, b"peers": compact})
-        )
+        reply = {b"interval": 60, b"peers": compact}
+        if self.peers6:
+            reply[b"peers6"] = b"".join(
+                socket.inet_pton(socket.AF_INET6, host)
+                + struct.pack(">H", port)
+                for host, port in self.peers6
+            )
+        return web.Response(body=bencode(reply))
 
     async def start(self) -> str:
         app = web.Application()

@@ -5,6 +5,7 @@ webtorrent at /root/reference/lib/download.js:43-123)."""
 import asyncio
 import hashlib
 import os
+import socket
 import struct
 
 import pytest
@@ -779,3 +780,75 @@ def test_bdecode_fuzz_never_hangs_or_crashes():
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+
+
+# -- IPv6 (BEP 7) -------------------------------------------------------
+def test_parse_compact_peers6():
+    from downloader_tpu.torrent.tracker import parse_compact_peers6
+
+    blob = (socket.inet_pton(socket.AF_INET6, "::1") + struct.pack(">H", 6881)
+            + socket.inet_pton(socket.AF_INET6, "2001:db8::2")
+            + struct.pack(">H", 0))  # port 0 dropped
+    peers = parse_compact_peers6(blob)
+    assert peers == [Peer("::1", 6881)]
+
+
+def test_parse_pex_added6():
+    from downloader_tpu.torrent import wire
+    from downloader_tpu.torrent.bencode import bencode as benc
+
+    body = benc({
+        b"added": socket.inet_aton("10.0.0.1") + struct.pack(">H", 51413),
+        b"added6": socket.inet_pton(socket.AF_INET6, "::1")
+        + struct.pack(">H", 51414),
+    })
+    assert wire.parse_pex(body) == [("10.0.0.1", 51413), ("::1", 51414)]
+
+
+def test_magnet_x_pe_ipv6_brackets():
+    info_hash = hashlib.sha1(b"y").digest()
+    uri = (f"magnet:?xt=urn:btih:{info_hash.hex()}"
+           "&x.pe=[::1]:6881&x.pe=9.9.9.9:1000")
+    magnet = parse_magnet(uri)
+    assert ("::1", 6881) in magnet.peer_addrs
+    assert ("9.9.9.9", 1000) in magnet.peer_addrs
+
+
+async def test_announce_returns_peers6(tmp_path):
+    tracker = MiniTracker([("127.0.0.1", 1234)], peers6=[("::1", 4321)])
+    url = await tracker.start()
+    try:
+        peers = await announce(url, b"\x01" * 20, b"-DT0001-xxxxxxxxxxxx",
+                               port=0)
+        assert Peer("127.0.0.1", 1234) in peers
+        assert Peer("::1", 4321) in peers
+    finally:
+        await tracker.stop()
+
+
+async def test_ipv6_swarm_download(tmp_path):
+    """Full download over an IPv6 loopback peer connection."""
+    import socket as socket_mod
+
+    if not socket_mod.has_ipv6:
+        pytest.skip("no IPv6 support on host")
+    src, files = make_payload_dir(tmp_path, [50_000])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent))
+    try:
+        port = await seeder.start(host="::1")
+    except OSError:
+        pytest.skip("IPv6 loopback unavailable")
+    try:
+        tf = tmp_path / "v6.torrent"
+        tf.write_bytes(meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-v6")
+        got = await TorrentClient().download(
+            str(tf), dest, peers=[Peer("::1", port)]
+        )
+        assert got.info_hash == meta.info_hash
+        for name, data in files.items():
+            with open(os.path.join(dest, meta.name, name), "rb") as fh:
+                assert fh.read() == data
+    finally:
+        await seeder.stop()
