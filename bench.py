@@ -227,6 +227,15 @@ async def bench_torrent(mib: int = 64) -> dict:
     return {"torrent_swarm_mbps": round(mib * (1 << 20) / 1e6 / elapsed, 1)}
 
 
+def _bench_torrent_safe() -> dict:
+    """Like bench_compute: a secondary metric's failure must not discard
+    the primary pipeline result."""
+    try:
+        return asyncio.run(bench_torrent())
+    except Exception as err:
+        return {"torrent_error": f"{type(err).__name__}: {err}"[:200]}
+
+
 def main() -> None:
     pipeline = asyncio.run(bench_pipeline())
     extra = {
@@ -234,7 +243,7 @@ def main() -> None:
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
-        **asyncio.run(bench_torrent()),
+        **_bench_torrent_safe(),
         **bench_compute(),
     }
     value = round(pipeline["mbps"], 1)

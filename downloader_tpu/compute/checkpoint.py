@@ -15,8 +15,6 @@ from __future__ import annotations
 import os
 from typing import Any, Optional, Tuple
 
-import jax
-
 
 def _manager(directory: str):
     import orbax.checkpoint as ocp
@@ -32,15 +30,17 @@ def save_state(directory: str, step: int, params: Any, opt_state: Any) -> None:
     import orbax.checkpoint as ocp
 
     mgr = _manager(os.path.abspath(directory))
-    mgr.save(
-        step,
-        args=ocp.args.Composite(
-            params=ocp.args.StandardSave(params),
-            opt_state=ocp.args.StandardSave(opt_state),
-        ),
-    )
-    mgr.wait_until_finished()
-    mgr.close()
+    try:
+        mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -85,5 +85,8 @@ def restore_state(directory: str, params_like: Any, opt_state_like: Any,
         from .parallel.mesh import shard_params
 
         params = shard_params(plan, params)
-        opt_state = jax.device_put(opt_state, plan.replicated)
+        # optimizer moments are param-shaped: same tensor-parallel layout
+        # (a replicated Adam state would multiply per-device memory by the
+        # model-axis factor versus a fresh multichip init)
+        opt_state = shard_params(plan, opt_state)
     return step, params, opt_state

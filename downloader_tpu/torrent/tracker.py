@@ -271,6 +271,12 @@ async def announce_udp(
             raise TrackerError(resp[8:].decode("utf-8", "replace"))
         if action != _ACTION_ANNOUNCE or len(resp) < 20:
             raise TrackerError("malformed udp announce response")
-        return _parse_compact_peers(resp[20:])
+        # BEP 15: a tracker reached over IPv6 answers with 18-byte
+        # (address, port) entries; slicing those on 6-byte boundaries
+        # would fabricate garbage IPv4 peers
+        sockname = transport.get_extra_info("sockname")
+        if sockname is not None and len(sockname) == 4:  # AF_INET6 tuple
+            return parse_compact_peers6(resp[20:])
+        return parse_compact_peers(resp[20:])
     finally:
         transport.close()

@@ -221,7 +221,8 @@ def build_bitfield(have, num_pieces: int) -> bytes:
 
 def pack_compact_peers(addrs: Iterable[Tuple[str, int]]) -> bytes:
     """IPv4 (host, port) pairs -> BEP 11/23 compact 6-byte entries.
-    Non-IPv4 hosts are skipped (ut_pex's ``added6`` is not implemented)."""
+    Non-IPv4 hosts are skipped on the send side (we gossip only ``added``;
+    incoming ``added6`` is parsed by :func:`parse_pex`)."""
     out = bytearray()
     for host, port in addrs:
         try:
@@ -233,23 +234,19 @@ def pack_compact_peers(addrs: Iterable[Tuple[str, int]]) -> bytes:
 
 def parse_pex(body: bytes) -> List[Tuple[str, int]]:
     """Extract usable (host, port) peers from a ut_pex message body
-    (both the IPv4 ``added`` and IPv6 ``added6`` lists)."""
+    (both the IPv4 ``added`` and IPv6 ``added6`` lists — same compact
+    forms as tracker responses, so the tracker module's parsers own the
+    decode)."""
+    from .tracker import parse_compact_peers, parse_compact_peers6
+
     data, _ = bdecode_prefix(body)
     if not isinstance(data, dict):  # untrusted wire bytes
         return []
     out: List[Tuple[str, int]] = []
     added = data.get(b"added", b"")
     if isinstance(added, bytes):
-        for i in range(0, len(added) - len(added) % 6, 6):
-            host = socket.inet_ntoa(added[i:i + 4])
-            (port,) = struct.unpack(">H", added[i + 4:i + 6])
-            if 0 < port < 65536:
-                out.append((host, port))
+        out.extend((p.host, p.port) for p in parse_compact_peers(added))
     added6 = data.get(b"added6", b"")
     if isinstance(added6, bytes):
-        for i in range(0, len(added6) - len(added6) % 18, 18):
-            host = socket.inet_ntop(socket.AF_INET6, added6[i:i + 16])
-            (port,) = struct.unpack(">H", added6[i + 16:i + 18])
-            if 0 < port < 65536:
-                out.append((host, port))
+        out.extend((p.host, p.port) for p in parse_compact_peers6(added6))
     return out
