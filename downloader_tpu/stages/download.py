@@ -112,6 +112,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
     bucket_client_factory = getattr(ctx, "bucket_client_factory", None) or make_bucket_client
 
+    # service-wide ingress cap (bytes/s), shared by every job's transfers
+    # regardless of protocol; unset = unlimited (reference behavior)
+    from ..utils.ratelimit import bucket_from_config
+
+    limiter = bucket_from_config(ctx.config, "download_rate_limit")
+
     # One long-lived DHT node shared by every torrent job the orchestrator
     # runs (webtorrent likewise keeps a single bundled DHT instance for the
     # client's lifetime, lib/download.js:19).  Created lazily on the first
@@ -164,7 +170,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         # bittorrent-dht (lib/download.js:19).  Bootstrap routers come from
         # DHT_BOOTSTRAP=host:port,... or config.instance.dht_bootstrap;
         # unset means tracker-only discovery.
-        client = TorrentClient(logger=logger, dht=await _shared_dht(logger))
+        client = TorrentClient(logger=logger, dht=await _shared_dht(logger),
+                               rate_limiter=limiter)
 
         # seed-while-leech: verified pieces are served back to the swarm
         # during the download; SEED_LINGER/config.instance.seed_linger keeps
@@ -330,6 +337,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             decoder = _decoder_for(resp)
             with open(partial, mode, buffering=0) as fh:
                 async for raw in resp.content.iter_any():
+                    if limiter is not None:
+                        await limiter.consume(len(raw))
                     # watchdog tracks raw network progress; ``total`` counts
                     # decoded bytes written to disk
                     fetched[0] += len(raw)

@@ -129,12 +129,15 @@ class _Swarm:
 
 class TorrentClient:
     def __init__(self, logger=None, peer_id: Optional[bytes] = None,
-                 dht=None):
+                 dht=None, rate_limiter=None):
         """``dht`` is an optional started :class:`~.dht.DHTNode`; when set,
         it is queried as an additional peer source next to trackers (the
         reference's webtorrent does the same via bittorrent-dht,
-        /root/reference/lib/download.js:19,64)."""
+        /root/reference/lib/download.js:19,64).  ``rate_limiter`` is an
+        optional token bucket (``await consume(n)``) charged for every
+        payload byte received from peers and webseeds."""
         self.logger = logger
+        self.rate_limiter = rate_limiter
         self.peer_id = peer_id or (
             b"-DT0001-" + bytes(random.randrange(48, 58) for _ in range(12))
         )
@@ -665,6 +668,8 @@ class TorrentClient:
                     data = await self._fetch_webseed_piece(
                         session, base_url, meta, piece
                     )
+                    if self.rate_limiter is not None:
+                        await self.rate_limiter.consume(len(data))
                 except (aiohttp.ClientError, TimeoutError, OSError) as err:
                     swarm.release(piece)
                     failures += 1
@@ -859,6 +864,8 @@ class TorrentClient:
                         for addr in wire.parse_pex(payload[1:]):
                             swarm.discovered.put_nowait(addr)
                 elif msg_id == wire.MSG_PIECE:
+                    if self.rate_limiter is not None:
+                        await self.rate_limiter.consume(len(payload))
                     index, begin = struct.unpack(">II", payload[:8])
                     data = payload[8:]
                     if index != claimed or buffer is None:

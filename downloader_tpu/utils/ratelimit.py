@@ -1,0 +1,63 @@
+"""Async token-bucket rate limiting.
+
+Beyond-reference production knob: the reference downloads at whatever the
+NIC allows (webtorrent/request have no caps wired up,
+/root/reference/lib/download.js), which on a shared media host starves
+co-tenant services.  One bucket is shared across all of a service's
+transfers, so the cap is per-process, not per-job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+
+class TokenBucket:
+    """Classic token bucket: sustained ``rate`` bytes/s, bursts up to
+    ``burst`` bytes (default: one second's worth).
+
+    ``consume(n)`` deducts immediately and sleeps off any deficit, which
+    paces the *average* rate without chunk-size-dependent stalls: a 1 MiB
+    chunk against a 64 KiB/s cap sleeps ~16 s once instead of deadlocking
+    on an undersized bucket.
+    """
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        if rate <= 0:
+            raise ValueError(f"rate must be positive, got {rate}")
+        self.rate = float(rate)
+        self.capacity = float(burst if burst is not None else rate)
+        self.tokens = self.capacity
+        self.updated = time.monotonic()
+        self._lock = asyncio.Lock()
+
+    async def consume(self, n: int) -> None:
+        if n <= 0:
+            return
+        async with self._lock:
+            now = time.monotonic()
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self.updated) * self.rate
+            )
+            self.updated = now
+            self.tokens -= n
+            deficit = -self.tokens
+        if deficit > 0:
+            await asyncio.sleep(deficit / self.rate)
+
+
+def bucket_from_config(config, key: str) -> Optional[TokenBucket]:
+    """Build a bucket from ``config.instance.<key>`` (bytes/s; absent,
+    empty, or non-positive disables limiting)."""
+    raw = getattr(config.instance, key, None)
+    if raw in (None, "", 0):
+        return None
+    try:
+        rate = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if rate <= 0:
+        return None
+    return TokenBucket(rate)
