@@ -847,6 +847,30 @@ class TorrentClient:
                     if not interested_sent:
                         await peer.send_message(wire.MSG_INTERESTED)
                         interested_sent = True
+                elif msg_id == wire.MSG_HAVE_ALL:  # BEP 6
+                    fresh = set(range(meta.num_pieces)) - have
+                    have |= fresh
+                    swarm.availability.update(fresh)
+                    if not interested_sent:
+                        await peer.send_message(wire.MSG_INTERESTED)
+                        interested_sent = True
+                elif msg_id == wire.MSG_HAVE_NONE:  # BEP 6
+                    swarm.availability.subtract(have)
+                    have.clear()
+                elif msg_id == wire.MSG_REJECT_REQUEST:  # BEP 6
+                    index, begin, _length = struct.unpack(">III", payload)
+                    if index == claimed:
+                        # the peer won't serve this piece after all: treat
+                        # it as not-held, put the piece back for others
+                        if index in have:
+                            have.discard(index)
+                            swarm.availability[index] -= 1
+                        swarm.release(claimed)
+                        claimed = None
+                        buffer = None
+                        received = set()
+                        requested = set()
+                        await _pump_requests()
                 elif msg_id == wire.MSG_UNCHOKE:
                     choked = False
                     await _pump_requests()

@@ -113,6 +113,7 @@ class Seeder:
                 await peer.close()
                 return
             self.connections += 1
+            peer.supports_fast = handshake.supports_fast
             await peer.send_handshake(self.meta.info_hash, self.peer_id)
             if handshake.supports_extensions:
                 await peer.send_ext_handshake(
@@ -123,9 +124,15 @@ class Seeder:
             # bitfield or HAVE-broadcast (never silently missed), and the
             # broadcast task cannot run before the bitfield is buffered
             self._peers.add(peer)
-            await peer.send_bitfield(wire.build_bitfield(
-                self._have_indices(), self.meta.num_pieces
-            ))
+            have = self._have_indices()
+            if handshake.supports_fast and self.have is None:
+                await peer.send_have_all()  # BEP 6: 5 bytes, any piece count
+            elif handshake.supports_fast and not self.have:
+                await peer.send_have_none()
+            else:
+                await peer.send_bitfield(wire.build_bitfield(
+                    have, self.meta.num_pieces
+                ))
             await self._serve(peer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
@@ -146,10 +153,14 @@ class Seeder:
                 if (index >= self.meta.num_pieces or length > (1 << 17)
                         or begin + length > self.meta.piece_size(index)
                         or not self._available(index)):
-                    # requesting a piece we never advertised — or bytes
-                    # past its boundary — is a protocol violation, and
-                    # serving it would leak preallocated zeros/unverified
-                    # bytes as content
+                    # a piece we never advertised, or bytes past its
+                    # boundary: with the fast extension (BEP 6) we can
+                    # reject politely — e.g. a race against a HAVE the
+                    # peer hasn't processed; without it, serving would
+                    # leak preallocated zeros, so drop the connection
+                    if getattr(peer, "supports_fast", False):
+                        await peer.send_reject_request(index, begin, length)
+                        continue
                     raise wire.WireError("bad request")
                 data = self.storage.read(
                     index * self.meta.piece_length + begin, length

@@ -16,8 +16,9 @@ from typing import Iterable, List, Optional, Tuple
 from .bencode import bdecode_prefix, bencode
 
 PSTR = b"BitTorrent protocol"
-# reserved byte 5, bit 0x10: supports the extension protocol (BEP 10)
-RESERVED = bytes([0, 0, 0, 0, 0, 0x10, 0, 0])
+# reserved byte 5, bit 0x10: extension protocol (BEP 10);
+# reserved byte 7, bit 0x04: fast extension (BEP 6)
+RESERVED = bytes([0, 0, 0, 0, 0, 0x10, 0, 0x04])
 
 MSG_CHOKE = 0
 MSG_UNCHOKE = 1
@@ -28,6 +29,12 @@ MSG_BITFIELD = 5
 MSG_REQUEST = 6
 MSG_PIECE = 7
 MSG_CANCEL = 8
+# BEP 6 fast extension
+MSG_SUGGEST_PIECE = 13
+MSG_HAVE_ALL = 14
+MSG_HAVE_NONE = 15
+MSG_REJECT_REQUEST = 16
+MSG_ALLOWED_FAST = 17
 MSG_EXTENDED = 20
 
 EXT_HANDSHAKE_ID = 0
@@ -52,6 +59,7 @@ class Handshake:
     info_hash: bytes
     peer_id: bytes
     supports_extensions: bool
+    supports_fast: bool = False
 
 
 class PeerWire:
@@ -87,6 +95,7 @@ class PeerWire:
             info_hash=info_hash,
             peer_id=peer_id,
             supports_extensions=bool(reserved[5] & 0x10),
+            supports_fast=bool(reserved[7] & 0x04),
         )
 
     # -- framing --------------------------------------------------------
@@ -125,6 +134,19 @@ class PeerWire:
 
     async def send_have(self, index: int) -> None:
         await self.send_message(MSG_HAVE, struct.pack(">I", index))
+
+    # -- fast extension (BEP 6) -----------------------------------------
+    async def send_have_all(self) -> None:
+        await self.send_message(MSG_HAVE_ALL)
+
+    async def send_have_none(self) -> None:
+        await self.send_message(MSG_HAVE_NONE)
+
+    async def send_reject_request(self, index: int, begin: int,
+                                  length: int) -> None:
+        await self.send_message(
+            MSG_REJECT_REQUEST, struct.pack(">III", index, begin, length)
+        )
 
     # -- extension protocol ---------------------------------------------
     async def send_ext_handshake(self, metadata_size: Optional[int] = None,

@@ -562,7 +562,13 @@ async def test_partial_seeder_broadcasts_have(tmp_path):
     try:
         reader, writer = await asyncio.open_connection("127.0.0.1", port)
         peer = wire.PeerWire(reader, writer)
-        await peer.send_handshake(meta.info_hash, b"-TS0001-xxxxxxxxxxxx")
+        # legacy handshake (no fast bit): this test pins the pre-BEP 6
+        # behavior — empty bitfield + hard disconnect on a bad request;
+        # the fast-extension path has its own test below
+        reserved = bytes([0, 0, 0, 0, 0, 0x10, 0, 0])
+        writer.write(bytes([len(wire.PSTR)]) + wire.PSTR + reserved
+                     + meta.info_hash + b"-TS0001-xxxxxxxxxxxx")
+        await writer.drain()
         await peer.recv_handshake()
         await peer.send_ext_handshake()
         # seeder sends ext handshake + bitfield; bitfield must be empty
@@ -852,3 +858,134 @@ async def test_ipv6_swarm_download(tmp_path):
                 assert fh.read() == data
     finally:
         await seeder.stop()
+
+
+# -- fast extension (BEP 6) ---------------------------------------------
+async def _raw_peer(port, info_hash, fast=True):
+    from downloader_tpu.torrent import wire as w
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    peer = w.PeerWire(reader, writer)
+    if not fast:
+        # strip the fast bit from our handshake
+        reserved = bytes([0, 0, 0, 0, 0, 0x10, 0, 0])
+        writer.write(bytes([len(w.PSTR)]) + w.PSTR + reserved
+                     + info_hash + b"-RW0001-xxxxxxxxxxxx")
+        await writer.drain()
+    else:
+        await peer.send_handshake(info_hash, b"-RW0001-xxxxxxxxxxxx")
+    await peer.recv_handshake()
+    return peer
+
+
+async def test_complete_seeder_sends_have_all_to_fast_peer(swarm):
+    from downloader_tpu.torrent import wire as w
+
+    peer = await _raw_peer(swarm.seeder.port, swarm.meta.info_hash)
+    try:
+        while True:
+            msg_id, payload = await asyncio.wait_for(peer.recv_message(), 5)
+            if msg_id in (w.MSG_BITFIELD, w.MSG_HAVE_ALL):
+                assert msg_id == w.MSG_HAVE_ALL
+                assert payload == b""
+                break
+    finally:
+        await peer.close()
+
+
+async def test_complete_seeder_sends_bitfield_to_legacy_peer(swarm):
+    from downloader_tpu.torrent import wire as w
+
+    peer = await _raw_peer(swarm.seeder.port, swarm.meta.info_hash,
+                           fast=False)
+    try:
+        while True:
+            msg_id, payload = await asyncio.wait_for(peer.recv_message(), 5)
+            if msg_id in (w.MSG_BITFIELD, w.MSG_HAVE_ALL):
+                assert msg_id == w.MSG_BITFIELD
+                assert w.parse_bitfield(payload, swarm.meta.num_pieces) == set(
+                    range(swarm.meta.num_pieces)
+                )
+                break
+    finally:
+        await peer.close()
+
+
+async def test_partial_seeder_rejects_politely_with_fast(tmp_path):
+    """A fast-extension peer asking for an unadvertised piece gets
+    REJECT_REQUEST and keeps its connection; a legacy peer is dropped."""
+    from downloader_tpu.torrent import Seeder
+    from downloader_tpu.torrent import wire as w
+    from downloader_tpu.torrent.storage import TorrentStorage
+
+    src, _files = make_payload_dir(tmp_path, [2 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    storage = TorrentStorage(meta, str(tmp_path / "partial"))
+    storage.preallocate()
+    seeder = Seeder(meta, storage=storage, have=set())
+    port = await seeder.start()
+    try:
+        peer = await _raw_peer(port, meta.info_hash)
+        msg_id, _ = await asyncio.wait_for(peer.recv_message(), 5)
+        while msg_id not in (w.MSG_HAVE_NONE, w.MSG_BITFIELD):
+            msg_id, _ = await asyncio.wait_for(peer.recv_message(), 5)
+        assert msg_id == w.MSG_HAVE_NONE  # empty + fast -> HAVE_NONE
+        await peer.send_request(0, 0, 1 << 14)
+        msg_id, payload = await asyncio.wait_for(peer.recv_message(), 5)
+        assert msg_id == w.MSG_REJECT_REQUEST
+        assert struct.unpack(">III", payload) == (0, 0, 1 << 14)
+        # connection still alive: a keepalive round-trips
+        await peer.send_keepalive()
+        await peer.close()
+
+        legacy = await _raw_peer(port, meta.info_hash, fast=False)
+        await legacy.send_request(0, 0, 1 << 14)
+        with pytest.raises((asyncio.IncompleteReadError, ConnectionError,
+                            TimeoutError)):
+            while True:
+                await asyncio.wait_for(legacy.recv_message(), 5)
+    finally:
+        await seeder.stop()
+
+
+async def test_download_completes_despite_rejecting_peer(swarm, tmp_path):
+    """A peer that advertises everything but rejects every request must
+    not wedge the download — rejected pieces return to the pool and the
+    real seeder finishes the job."""
+    from downloader_tpu.torrent import wire as w
+
+    async def rejecting_peer(reader, writer):
+        peer = w.PeerWire(reader, writer)
+        try:
+            await peer.recv_handshake()
+            await peer.send_handshake(swarm.meta.info_hash,
+                                      b"-RJ0001-xxxxxxxxxxxx")
+            await peer.send_have_all()
+            while True:
+                msg_id, payload = await peer.recv_message()
+                if msg_id == w.MSG_INTERESTED:
+                    await peer.send_message(w.MSG_UNCHOKE)
+                elif msg_id == w.MSG_REQUEST:
+                    index, begin, length = struct.unpack(">III", payload)
+                    await peer.send_reject_request(index, begin, length)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await peer.close()
+
+    server = await asyncio.start_server(rejecting_peer, "127.0.0.1", 0)
+    reject_port = server.sockets[0].getsockname()[1]
+    try:
+        dest = str(tmp_path / "dl-reject")
+        tf = tmp_path / "r.torrent"
+        tf.write_bytes(swarm.meta.to_torrent_bytes())
+        got = await TorrentClient().download(
+            str(tf), dest,
+            peers=[Peer("127.0.0.1", reject_port),
+                   Peer("127.0.0.1", swarm.seeder.port)],
+        )
+        assert got.info_hash == swarm.meta.info_hash
+        assert_downloaded(swarm, dest)
+    finally:
+        server.close()
+        await server.wait_closed()
