@@ -772,6 +772,17 @@ class TorrentClient:
         buffer: Optional[bytearray] = None
         received: Set[int] = set()
         requested: Set[int] = set()
+        # unchoked REJECT_REQUESTs per block of the current claim (BEP 6)
+        reject_counts: dict = {}
+
+        async def _add_have(indices: Set[int]) -> None:
+            nonlocal interested_sent
+            fresh = indices - have
+            have.update(fresh)
+            swarm.availability.update(fresh)
+            if not interested_sent:
+                await peer.send_message(wire.MSG_INTERESTED)
+                interested_sent = True
 
         def _blocks(piece: int) -> List[int]:
             return list(range(0, meta.piece_size(piece), BLOCK_SIZE))
@@ -791,7 +802,7 @@ class TorrentClient:
             requested = set()
 
         async def _pump_requests() -> None:
-            nonlocal claimed, buffer, received, requested
+            nonlocal claimed, buffer, received, requested, reject_counts
             await _abandon_if_done_elsewhere()
             if choked:
                 return
@@ -803,6 +814,7 @@ class TorrentClient:
                 buffer = bytearray(meta.piece_size(piece))
                 received = set()
                 requested = set()
+                reject_counts = {}
             outstanding = requested - received
             for begin in _blocks(claimed):
                 if len(outstanding) >= PIPELINE_DEPTH:
@@ -833,35 +845,31 @@ class TorrentClient:
                 if msg_id is None:
                     continue
                 if msg_id == wire.MSG_BITFIELD:
-                    fresh = wire.parse_bitfield(payload, meta.num_pieces) - have
-                    have |= fresh
-                    swarm.availability.update(fresh)
-                    if not interested_sent:
-                        await peer.send_message(wire.MSG_INTERESTED)
-                        interested_sent = True
+                    await _add_have(wire.parse_bitfield(payload,
+                                                       meta.num_pieces))
                 elif msg_id == wire.MSG_HAVE:
                     (index,) = struct.unpack(">I", payload)
-                    if index not in have:
-                        have.add(index)
-                        swarm.availability[index] += 1
-                    if not interested_sent:
-                        await peer.send_message(wire.MSG_INTERESTED)
-                        interested_sent = True
+                    await _add_have({index})
                 elif msg_id == wire.MSG_HAVE_ALL:  # BEP 6
-                    fresh = set(range(meta.num_pieces)) - have
-                    have |= fresh
-                    swarm.availability.update(fresh)
-                    if not interested_sent:
-                        await peer.send_message(wire.MSG_INTERESTED)
-                        interested_sent = True
+                    await _add_have(set(range(meta.num_pieces)))
                 elif msg_id == wire.MSG_HAVE_NONE:  # BEP 6
                     swarm.availability.subtract(have)
                     have.clear()
                 elif msg_id == wire.MSG_REJECT_REQUEST:  # BEP 6
                     index, begin, _length = struct.unpack(">III", payload)
-                    if index == claimed:
-                        # the peer won't serve this piece after all: treat
-                        # it as not-held, put the piece back for others
+                    if index != claimed:
+                        continue
+                    requested.discard(begin)
+                    if choked:
+                        # BEP 6: fast peers reject all in-flight requests
+                        # when choking — the piece is fine, the unchoke
+                        # re-pump re-requests it; the blocks we already
+                        # hold stay held
+                        continue
+                    reject_counts[begin] = reject_counts.get(begin, 0) + 1
+                    if reject_counts[begin] >= 2:
+                        # repeatedly refused while unchoked: this peer
+                        # won't serve the piece — hand it to the others
                         if index in have:
                             have.discard(index)
                             swarm.availability[index] -= 1
@@ -870,7 +878,8 @@ class TorrentClient:
                         buffer = None
                         received = set()
                         requested = set()
-                        await _pump_requests()
+                        reject_counts = {}
+                    await _pump_requests()
                 elif msg_id == wire.MSG_UNCHOKE:
                     choked = False
                     await _pump_requests()

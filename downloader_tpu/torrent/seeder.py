@@ -124,14 +124,13 @@ class Seeder:
             # bitfield or HAVE-broadcast (never silently missed), and the
             # broadcast task cannot run before the bitfield is buffered
             self._peers.add(peer)
-            have = self._have_indices()
             if handshake.supports_fast and self.have is None:
                 await peer.send_have_all()  # BEP 6: 5 bytes, any piece count
             elif handshake.supports_fast and not self.have:
                 await peer.send_have_none()
             else:
                 await peer.send_bitfield(wire.build_bitfield(
-                    have, self.meta.num_pieces
+                    self._have_indices(), self.meta.num_pieces
                 ))
             await self._serve(peer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -151,13 +150,16 @@ class Seeder:
             elif msg_id == wire.MSG_REQUEST:
                 index, begin, length = struct.unpack(">III", payload)
                 if (index >= self.meta.num_pieces or length > (1 << 17)
-                        or begin + length > self.meta.piece_size(index)
-                        or not self._available(index)):
-                    # a piece we never advertised, or bytes past its
-                    # boundary: with the fast extension (BEP 6) we can
-                    # reject politely — e.g. a race against a HAVE the
-                    # peer hasn't processed; without it, serving would
-                    # leak preallocated zeros, so drop the connection
+                        or begin + length > self.meta.piece_size(index)):
+                    # malformed geometry is a protocol violation from any
+                    # peer — fast extension or not, disconnect (a polite
+                    # reject would let a hostile peer spin forever)
+                    raise wire.WireError("bad request")
+                if not self._available(index):
+                    # valid request for a piece we haven't advertised
+                    # (or a race against an in-flight HAVE): BEP 6 lets
+                    # us reject politely; legacy peers get dropped since
+                    # serving would leak preallocated zeros as content
                     if getattr(peer, "supports_fast", False):
                         await peer.send_reject_request(index, begin, length)
                         continue

@@ -50,14 +50,23 @@ class TokenBucket:
 
 def bucket_from_config(config, key: str) -> Optional[TokenBucket]:
     """Build a bucket from ``config.instance.<key>`` (bytes/s; absent,
-    empty, or non-positive disables limiting)."""
+    empty, or 0 disables limiting).
+
+    A malformed or negative value raises instead of silently running
+    uncapped — an operator who set a cap must not get unlimited ingress
+    because of a typo like ``"128k"``.
+    """
     raw = getattr(config.instance, key, None)
     if raw in (None, "", 0):
         return None
     try:
         rate = float(raw)
     except (TypeError, ValueError):
-        return None
-    if rate <= 0:
+        raise ValueError(
+            f"config instance.{key}={raw!r} is not a number of bytes/s"
+        ) from None
+    if rate < 0:
+        raise ValueError(f"config instance.{key}={raw!r} must be >= 0")
+    if rate == 0:
         return None
     return TokenBucket(rate)

@@ -42,8 +42,11 @@ def test_bucket_from_config():
     assert bucket_from_config(ConfigNode({"instance": {}}), "x") is None
     assert bucket_from_config(
         ConfigNode({"instance": {"x": 0}}), "x") is None
-    assert bucket_from_config(
-        ConfigNode({"instance": {"x": "garbage"}}), "x") is None
+    # a typo'd cap must fail loudly, not run uncapped
+    with pytest.raises(ValueError):
+        bucket_from_config(ConfigNode({"instance": {"x": "128k"}}), "x")
+    with pytest.raises(ValueError):
+        bucket_from_config(ConfigNode({"instance": {"x": -1}}), "x")
     bucket = bucket_from_config(
         ConfigNode({"instance": {"x": "250000"}}), "x")
     assert bucket is not None and bucket.rate == 250000.0

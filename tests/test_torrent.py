@@ -989,3 +989,64 @@ async def test_download_completes_despite_rejecting_peer(swarm, tmp_path):
     finally:
         server.close()
         await server.wait_closed()
+
+
+async def test_choke_cycle_rejects_do_not_strip_pieces(swarm, tmp_path):
+    """BEP 6: a compliant peer rejects in-flight requests whenever it
+    chokes. Those rejects must not make the client forget the peer holds
+    the pieces — after the unchoke, the download completes from this
+    single peer."""
+    from downloader_tpu.torrent import wire as w
+    from downloader_tpu.torrent.storage import TorrentStorage
+
+    storage = TorrentStorage(swarm.meta, str(tmp_path / "seed"))
+    choke_cycles = [0]
+
+    async def churning_seeder(reader, writer):
+        peer = w.PeerWire(reader, writer)
+        unchoked_requests = 0
+        try:
+            await peer.recv_handshake()
+            await peer.send_handshake(swarm.meta.info_hash,
+                                      b"-CH0001-xxxxxxxxxxxx")
+            await peer.send_have_all()
+            while True:
+                msg_id, payload = await peer.recv_message()
+                if msg_id == w.MSG_INTERESTED:
+                    await peer.send_message(w.MSG_UNCHOKE)
+                elif msg_id == w.MSG_REQUEST:
+                    index, begin, length = struct.unpack(">III", payload)
+                    unchoked_requests += 1
+                    if unchoked_requests % 7 == 0 and choke_cycles[0] < 3:
+                        # churn: choke + reject the in-flight request,
+                        # then immediately unchoke (BEP 6 choke behavior)
+                        choke_cycles[0] += 1
+                        await peer.send_message(w.MSG_CHOKE)
+                        await peer.send_reject_request(index, begin, length)
+                        await peer.send_message(w.MSG_UNCHOKE)
+                        continue
+                    data = storage.read(
+                        index * swarm.meta.piece_length + begin, length
+                    )
+                    await peer.send_piece(index, begin, data)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await peer.close()
+
+    server = await asyncio.start_server(churning_seeder, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        tf = tmp_path / "churn2.torrent"
+        tf.write_bytes(swarm.meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-churn")
+        got = await TorrentClient().download(
+            str(tf), dest, peers=[Peer("127.0.0.1", port)],
+            stall_timeout=20,
+        )
+        assert got.info_hash == swarm.meta.info_hash
+        assert choke_cycles[0] >= 1, "fixture never actually churned"
+        assert_downloaded(swarm, dest)
+    finally:
+        server.close()
+        await server.wait_closed()
