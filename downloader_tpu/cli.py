@@ -71,6 +71,14 @@ def _build_parser() -> argparse.ArgumentParser:
     mag = sub.add_parser("magnet", help="print the magnet link of a .torrent")
     mag.add_argument("torrent", help=".torrent file path")
 
+    watch = sub.add_parser(
+        "watch", help="tail job status/progress telemetry from the queue"
+    )
+    watch.add_argument("--id", default=None,
+                       help="only show events for this media id")
+    watch.add_argument("--count", type=int, default=0,
+                       help="exit after N events (0 = run until ^C)")
+
     return parser
 
 
@@ -104,6 +112,61 @@ async def _submit(args) -> int:
     finally:
         await mq.close()
     print(f"submitted {args.id} -> {args.queue}")
+    return 0
+
+
+async def _watch(args) -> int:
+    from .mq import new_queue, resolve_backend
+    from .platform.telemetry import PROGRESS_QUEUE, STATUS_QUEUE
+
+    config = load_config("converter")
+    logger = get_logger("downloader-cli")
+    if resolve_backend(config) == "memory":
+        print(
+            "config selects the in-memory queue backend; telemetry from a "
+            "running service is not reachable from this process. Configure "
+            "`rabbitmq: {backend: amqp}` first.",
+            file=sys.stderr,
+        )
+        return 2
+
+    seen = 0
+    done = asyncio.Event()
+
+    def _emit(line: str) -> None:
+        nonlocal seen
+        print(line, flush=True)
+        seen += 1
+        if args.count and seen >= args.count:
+            done.set()
+
+    async def on_status(delivery):
+        event = schemas.decode(schemas.TelemetryStatusEvent, delivery.body)
+        await delivery.ack()
+        if args.id and event.media_id != args.id:
+            return
+        name = schemas.TelemetryStatus.Name(event.status)
+        _emit(f"{event.media_id}\tstatus\t{name}")
+
+    async def on_progress(delivery):
+        event = schemas.decode(schemas.TelemetryProgressEvent, delivery.body)
+        await delivery.ack()
+        if args.id and event.media_id != args.id:
+            return
+        name = schemas.TelemetryStatus.Name(event.status)
+        _emit(f"{event.media_id}\tprogress\t{name}\t{event.percent}%")
+
+    mq = new_queue(config, logger=logger)
+    await mq.connect()
+    try:
+        await mq.listen(STATUS_QUEUE, on_status)
+        await mq.listen(PROGRESS_QUEUE, on_progress)
+        try:
+            await done.wait()
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+    finally:
+        await mq.close()
     return 0
 
 
@@ -141,6 +204,8 @@ def main(argv=None) -> int:
         return _mktorrent(args)
     if args.command == "magnet":
         return _magnet(args)
+    if args.command == "watch":
+        return asyncio.run(_watch(args))
     raise AssertionError("unreachable")
 
 

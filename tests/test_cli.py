@@ -112,3 +112,42 @@ def test_submit_flags_case_insensitive(tmp_path, monkeypatch):
         "--source", "HTTP", "--uri", "http://h/x.mkv",
     ])
     assert rc == 2
+
+
+async def test_watch_tails_telemetry(tmp_path, monkeypatch, capsys):
+    """watch prints status + progress events from the real queue."""
+    server = await MiniAmqpServer().start()
+    try:
+        (tmp_path / "converter.yaml").write_text(
+            "rabbitmq: {backend: amqp}\n"
+            f"services: {{rabbitmq: \"{server.url}\"}}\n"
+        )
+        monkeypatch.setenv("CONFIG_PATH", str(tmp_path))
+
+        from downloader_tpu.mq.amqp import AmqpQueue
+        from downloader_tpu.platform.telemetry import Telemetry
+
+        async def publish_events():
+            mq = AmqpQueue(server.url, heartbeat=0)
+            await mq.connect()
+            telem = Telemetry(mq)
+            try:
+                await asyncio.sleep(0.3)  # let watch subscribe first
+                await telem.emit_status(
+                    "w-job", schemas.TelemetryStatus.Value("DOWNLOADING"))
+                await telem.emit_progress(
+                    "w-job", schemas.TelemetryStatus.Value("DOWNLOADING"), 50)
+            finally:
+                await mq.close()
+
+        publisher = asyncio.create_task(publish_events())
+        rc = await asyncio.to_thread(
+            cli.main, ["watch", "--id", "w-job", "--count", "2"]
+        )
+        await publisher
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "w-job\tstatus\tDOWNLOADING" in out
+        assert "w-job\tprogress\tDOWNLOADING\t50%" in out
+    finally:
+        await server.stop()
