@@ -62,6 +62,7 @@ class Orchestrator:
         logger: Optional[Logger] = None,
         stages: Optional[List[str]] = None,
         prefetch: int = 1,
+        poison_threshold: int = 5,
     ):
         self.config = config
         self.mq = mq
@@ -82,6 +83,14 @@ class Orchestrator:
         # teardown callables, run once at shutdown
         self.stage_resources: dict = {}
         self.stage_cleanups: list = []
+
+        # poison-job guard: the reference nacks failed jobs forever
+        # (lib/main.js:148-150), which on RabbitMQ without a dead-letter
+        # policy hot-loops a deterministically-failing job at the head of
+        # the queue.  After this many failures of one job in this process,
+        # drop it (ack + ERRORED) instead of redelivering.  0 disables.
+        self.poison_threshold = poison_threshold
+        self._failure_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -226,6 +235,18 @@ class Orchestrator:
                 await self.telemetry.emit_status(
                     job_id, schemas.TelemetryStatus.Value("ERRORED")
                 )
+                failures = self._failure_counts.get(job_id, 0) + 1
+                self._failure_counts[job_id] = failures
+                if self.poison_threshold and failures >= self.poison_threshold:
+                    logger.error(
+                        "dropping poison job after repeated failures",
+                        failures=failures,
+                    )
+                    if self.metrics is not None:
+                        self.metrics.jobs_failed.labels(reason="poison").inc()
+                    self._failure_counts.pop(job_id, None)
+                    await delivery.ack()
+                    return
                 await delivery.nack()
                 return
             logger.info("creating convert job")
@@ -253,5 +274,8 @@ class Orchestrator:
             return
 
         await delivery.ack()
+        # success clears the poison counter: transient-failure retries that
+        # eventually succeed must not count against a later redelivery
+        self._failure_counts.pop(job_id, None)
         if self.metrics is not None:
             self.metrics.jobs_completed.inc()

@@ -1,0 +1,11 @@
+"""Test-only stage that always fails; counts invocations."""
+
+CALLS = [0]
+
+
+async def stage_factory(ctx):
+    async def fail(job):
+        CALLS[0] += 1
+        raise RuntimeError("boom")
+
+    return fail

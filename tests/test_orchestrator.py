@@ -211,3 +211,32 @@ async def test_graceful_shutdown_drains_inflight_job(tmp_path):
     finally:
         await orchestrator.shutdown(grace_seconds=1)
         await runner.cleanup()
+
+
+async def test_poison_job_dropped_after_threshold(tmp_path):
+    """A deterministically-failing job is dropped (ack + ERRORED) after
+    poison_threshold failures instead of redelivering forever; a later
+    healthy job is unaffected."""
+    import fake_fail_stage
+    from downloader_tpu.stages.base import register_stage
+
+    fake_fail_stage.CALLS[0] = 0
+    register_stage("failing", "fake_fail_stage")
+    # broker without its own redelivery cap: the orchestrator must cope
+    broker = InMemoryBroker(max_redeliveries=None)
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store, stages=["failing"], poison_threshold=3
+    )
+    broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg("http://x/"))
+    await broker.join(schemas.DOWNLOAD_QUEUE, timeout=10)
+
+    assert fake_fail_stage.CALLS[0] == 3  # threshold failures, then dropped
+    assert broker.published(schemas.CONVERT_QUEUE) == []
+    statuses = [
+        schemas.decode(schemas.TelemetryStatusEvent, raw).status
+        for raw in broker.published(STATUS_QUEUE)
+    ]
+    assert statuses.count(schemas.TelemetryStatus.Value("ERRORED")) == 3
+    assert orchestrator._failure_counts == {}
+    await orchestrator.shutdown(grace_seconds=5)
