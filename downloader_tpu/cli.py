@@ -71,6 +71,11 @@ def _build_parser() -> argparse.ArgumentParser:
     mag = sub.add_parser("magnet", help="print the magnet link of a .torrent")
     mag.add_argument("torrent", help=".torrent file path")
 
+    scrape = sub.add_parser(
+        "scrape", help="swarm stats (seeders/leechers) for a .torrent"
+    )
+    scrape.add_argument("torrent", help=".torrent file path")
+
     watch = sub.add_parser(
         "watch", help="tail job status/progress telemetry from the queue"
     )
@@ -186,6 +191,28 @@ def _mktorrent(args) -> int:
     return 0
 
 
+async def _scrape(args) -> int:
+    from .torrent import tracker as tracker_mod
+    from .torrent.metainfo import parse_torrent_bytes
+
+    with open(args.torrent, "rb") as fh:
+        meta = parse_torrent_bytes(fh.read())
+    if not meta.trackers:
+        print("torrent has no trackers to scrape", file=sys.stderr)
+        return 2
+    failures = 0
+    for url in meta.trackers:
+        try:
+            stats = await tracker_mod.scrape(url, meta.info_hash)
+        except Exception as err:
+            print(f"{url}\terror\t{err}", file=sys.stderr)
+            failures += 1
+            continue
+        print(f"{url}\tseeders={stats.seeders}\tleechers={stats.leechers}"
+              f"\tcompleted={stats.completed}")
+    return 0 if failures < len(meta.trackers) else 1
+
+
 def _magnet(args) -> int:
     from .torrent.magnet import make_magnet
     from .torrent.metainfo import parse_torrent_bytes
@@ -204,6 +231,8 @@ def main(argv=None) -> int:
         return _mktorrent(args)
     if args.command == "magnet":
         return _magnet(args)
+    if args.command == "scrape":
+        return asyncio.run(_scrape(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
     raise AssertionError("unreachable")

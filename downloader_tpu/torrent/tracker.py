@@ -126,6 +126,64 @@ async def announce_http(
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class ScrapeStats:
+    """Per-infohash swarm statistics from a tracker scrape."""
+    seeders: int
+    completed: int
+    leechers: int
+
+
+async def scrape(tracker_url: str, info_hash: bytes) -> ScrapeStats:
+    """Scrape swarm stats for one infohash.
+
+    HTTP trackers use the /announce -> /scrape URL convention; UDP
+    trackers use BEP 15 action 2.  Raises TrackerError when the tracker
+    does not support scraping.
+    """
+    if tracker_url.startswith("udp://"):
+        return await scrape_udp(tracker_url, info_hash)
+    return await scrape_http(tracker_url, info_hash)
+
+
+def _scrape_url(tracker_url: str) -> str:
+    """BEP 48 convention: the last path segment 'announce' -> 'scrape'."""
+    parts = urllib.parse.urlsplit(tracker_url)
+    head, sep, last = parts.path.rpartition("/")
+    if not last.startswith("announce"):
+        raise TrackerError(f"tracker does not support scrape: {tracker_url}")
+    return urllib.parse.urlunsplit(parts._replace(
+        path=head + sep + "scrape" + last[len("announce"):]
+    ))
+
+
+async def scrape_http(tracker_url: str, info_hash: bytes) -> ScrapeStats:
+    query = urllib.parse.urlencode(
+        {"info_hash": info_hash}, quote_via=urllib.parse.quote
+    )
+    url = _scrape_url(tracker_url)
+    sep = "&" if "?" in url else "?"
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            yarl.URL(f"{url}{sep}{query}", encoded=True)
+        ) as resp:
+            if resp.status != 200:
+                raise TrackerError(f"scrape answered {resp.status}")
+            body = await resp.read()
+    data = bdecode(body)
+    if b"failure reason" in data:
+        raise TrackerError(data[b"failure reason"].decode("utf-8", "replace"))
+    files = data.get(b"files", {})
+    entry = files.get(info_hash)
+    if not isinstance(entry, dict):
+        raise TrackerError("scrape response missing our infohash")
+    return ScrapeStats(
+        seeders=int(entry.get(b"complete", 0)),
+        completed=int(entry.get(b"downloaded", 0)),
+        leechers=int(entry.get(b"incomplete", 0)),
+    )
+
+
 # ---------------------------------------------------------------------------
 # UDP tracker protocol (BEP 15)
 # ---------------------------------------------------------------------------
@@ -133,7 +191,77 @@ async def announce_http(
 _UDP_MAGIC = 0x41727101980
 _ACTION_CONNECT = 0
 _ACTION_ANNOUNCE = 1
+_ACTION_SCRAPE = 2
 _ACTION_ERROR = 3
+
+
+async def _udp_roundtrip(loop, transport, proto, payload_fn,
+                         timeout: float, retries: int) -> bytes:
+    """One retried request/response exchange against a UDP tracker."""
+    last: Exception = TrackerError("udp tracker unreachable")
+    for _ in range(max(1, retries + 1)):
+        tid = random.getrandbits(32)
+        fut: asyncio.Future = loop.create_future()
+        proto.waiters[tid] = fut
+        transport.sendto(payload_fn(tid))
+        try:
+            async with asyncio.timeout(timeout):
+                return await fut
+        except TimeoutError:
+            proto.waiters.pop(tid, None)
+            last = TrackerError(f"udp tracker timed out after {timeout}s")
+        except TrackerError as err:
+            last = err
+    raise last
+
+
+async def _udp_connect(loop, transport, proto, timeout, retries) -> int:
+    """BEP 15 connect round trip -> connection id."""
+    resp = await _udp_roundtrip(
+        loop, transport, proto,
+        lambda tid: struct.pack(">QII", _UDP_MAGIC, _ACTION_CONNECT, tid),
+        timeout, retries,
+    )
+    (action,) = struct.unpack_from(">I", resp, 0)
+    if action == _ACTION_ERROR:
+        raise TrackerError(resp[8:].decode("utf-8", "replace"))
+    if action != _ACTION_CONNECT or len(resp) < 16:
+        raise TrackerError("malformed udp connect response")
+    (connection_id,) = struct.unpack_from(">Q", resp, 8)
+    return connection_id
+
+
+async def scrape_udp(tracker_url: str, info_hash: bytes,
+                     timeout: float = 5.0, retries: int = 2) -> ScrapeStats:
+    """BEP 15 action-2 scrape for one infohash."""
+    parts = urllib.parse.urlsplit(tracker_url)
+    if parts.hostname is None or parts.port is None:
+        raise TrackerError(f"udp tracker needs host:port: {tracker_url}")
+    loop = asyncio.get_running_loop()
+    transport, proto = await loop.create_datagram_endpoint(
+        _UdpTrackerProtocol, remote_addr=(parts.hostname, parts.port)
+    )
+    try:
+        connection_id = await _udp_connect(
+            loop, transport, proto, timeout, retries
+        )
+        resp = await _udp_roundtrip(
+            loop, transport, proto,
+            lambda tid: struct.pack(
+                ">QII20s", connection_id, _ACTION_SCRAPE, tid, info_hash
+            ),
+            timeout, retries,
+        )
+        (action,) = struct.unpack_from(">I", resp, 0)
+        if action == _ACTION_ERROR:
+            raise TrackerError(resp[8:].decode("utf-8", "replace"))
+        if action != _ACTION_SCRAPE or len(resp) < 20:
+            raise TrackerError("malformed udp scrape response")
+        seeders, completed, leechers = struct.unpack_from(">III", resp, 8)
+        return ScrapeStats(seeders=seeders, completed=completed,
+                           leechers=leechers)
+    finally:
+        transport.close()
 
 
 class _UdpTrackerProtocol(asyncio.DatagramProtocol):
@@ -221,24 +349,10 @@ async def announce_udp(
         _UdpTrackerProtocol, remote_addr=addr
     )
     try:
-        async def _roundtrip(payload_fn) -> bytes:
-            last: Exception = TrackerError("udp tracker unreachable")
-            for _ in range(max(1, retries + 1)):
-                tid = random.getrandbits(32)
-                fut: asyncio.Future = loop.create_future()
-                proto.waiters[tid] = fut
-                transport.sendto(payload_fn(tid))
-                try:
-                    async with asyncio.timeout(timeout):
-                        return await fut
-                except TimeoutError:
-                    proto.waiters.pop(tid, None)
-                    last = TrackerError(
-                        f"udp tracker timed out after {timeout}s"
-                    )
-                except TrackerError as err:
-                    last = err
-            raise last
+        def _roundtrip(payload_fn):
+            return _udp_roundtrip(
+                loop, transport, proto, payload_fn, timeout, retries
+            )
 
         # connect round trip
         resp = await _roundtrip(

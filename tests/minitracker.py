@@ -27,6 +27,7 @@ class MiniTracker:
         self.peers6 = list(peers6)
         self.announces: list = []
         self.registered: dict = {}  # (ip, port) -> peer_id
+        self.completed = 0  # reported in scrape responses
         self._runner = None
         self.port = None
 
@@ -68,9 +69,26 @@ class MiniTracker:
             )
         return web.Response(body=bencode(reply))
 
+    async def scrape(self, request: web.Request) -> web.Response:
+        raw_qs = request.rel_url.raw_query_string
+        hashes = [
+            urllib.parse.unquote_to_bytes(pair.split("=", 1)[1])
+            for pair in raw_qs.split("&") if pair.startswith("info_hash=")
+        ]
+        files = {
+            h: {
+                b"complete": len(self.peers),
+                b"downloaded": self.completed,
+                b"incomplete": len(self.registered),
+            }
+            for h in hashes if len(h) == 20
+        }
+        return web.Response(body=bencode({b"files": files}))
+
     async def start(self) -> str:
         app = web.Application()
         app.router.add_get("/announce", self.handle)
+        app.router.add_get("/scrape", self.scrape)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
@@ -115,9 +133,17 @@ class MiniUdpTracker:
             self._connection_ids.add(cid)
             self._transport.sendto(struct.pack(">IIQ", 0, tid, cid), addr)
             return
-        # announce request
         (cid,) = struct.unpack_from(">Q", data, 0)
         action, tid = struct.unpack_from(">II", data, 8)
+        if action == 2 and cid in self._connection_ids:
+            # scrape: 12 bytes (seeders, completed, leechers) per hash
+            n_hashes = (len(data) - 16) // 20
+            body = b"".join(
+                struct.pack(">III", len(self.peers), 7, 2)
+                for _ in range(n_hashes)
+            )
+            self._transport.sendto(struct.pack(">II", 2, tid) + body, addr)
+            return
         if action != 1 or cid not in self._connection_ids:
             self._transport.sendto(
                 struct.pack(">II", 3, tid) + b"bad connection id", addr)

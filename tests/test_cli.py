@@ -151,3 +151,24 @@ async def test_watch_tails_telemetry(tmp_path, monkeypatch, capsys):
         assert "w-job\tprogress\tDOWNLOADING\t50%" in out
     finally:
         await server.stop()
+
+
+async def test_cli_scrape(tmp_path, capsys):
+    import os as os_mod
+
+    from minitracker import MiniTracker
+    from downloader_tpu.torrent import make_metainfo
+
+    tracker = MiniTracker([("127.0.0.1", 9)])
+    url = await tracker.start()
+    try:
+        src = tmp_path / "m.mkv"
+        src.write_bytes(os_mod.urandom(30_000))
+        meta = make_metainfo(str(src), piece_length=1 << 14, trackers=[url])
+        tf = tmp_path / "m.torrent"
+        tf.write_bytes(meta.to_torrent_bytes())
+        rc = await asyncio.to_thread(cli.main, ["scrape", str(tf)])
+        assert rc == 0
+        assert "seeders=1" in capsys.readouterr().out
+    finally:
+        await tracker.stop()

@@ -1050,3 +1050,37 @@ async def test_choke_cycle_rejects_do_not_strip_pieces(swarm, tmp_path):
     finally:
         server.close()
         await server.wait_closed()
+
+
+# -- scrape -------------------------------------------------------------
+async def test_http_scrape(swarm):
+    from downloader_tpu.torrent.tracker import scrape
+
+    swarm.tracker.completed = 11
+    stats = await scrape(swarm.tracker_url, swarm.meta.info_hash)
+    assert stats.seeders == len(swarm.tracker.peers)
+    assert stats.completed == 11
+
+
+async def test_udp_scrape(swarm):
+    from downloader_tpu.torrent.tracker import scrape
+
+    udp = MiniUdpTracker([("127.0.0.1", swarm.seeder.port)])
+    url = await udp.start()
+    try:
+        stats = await scrape(url, swarm.meta.info_hash)
+        assert stats.seeders == 1
+        assert stats.completed == 7
+        assert stats.leechers == 2
+    finally:
+        await udp.stop()
+
+
+def test_scrape_url_convention():
+    from downloader_tpu.torrent.tracker import TrackerError, _scrape_url
+
+    assert _scrape_url("http://t/announce") == "http://t/scrape"
+    assert (_scrape_url("http://t/announce.php?key=1")
+            == "http://t/scrape.php?key=1")
+    with pytest.raises(TrackerError):
+        _scrape_url("http://t/notannounce")
