@@ -121,8 +121,10 @@ async def _submit(args) -> int:
 
 
 async def _watch(args) -> int:
+    import os
+
     from .mq import new_queue, resolve_backend
-    from .platform.telemetry import PROGRESS_QUEUE, STATUS_QUEUE
+    from .platform.telemetry import PROGRESS_EXCHANGE, STATUS_EXCHANGE
 
     config = load_config("converter")
     logger = get_logger("downloader-cli")
@@ -164,8 +166,16 @@ async def _watch(args) -> int:
     mq = new_queue(config, logger=logger)
     await mq.connect()
     try:
-        await mq.listen(STATUS_QUEUE, on_status)
-        await mq.listen(PROGRESS_QUEUE, on_progress)
+        # tap queues bound to the telemetry fanout exchanges: we receive
+        # COPIES of every event without stealing deliveries from the real
+        # telemetry consumers on the canonical work queues
+        tap = os.urandom(4).hex()
+        status_q = f"v1.telemetry.tap.{tap}.status"
+        progress_q = f"v1.telemetry.tap.{tap}.progress"
+        await mq.bind_queue(status_q, STATUS_EXCHANGE, exclusive=True)
+        await mq.bind_queue(progress_q, PROGRESS_EXCHANGE, exclusive=True)
+        await mq.listen(status_q, on_status)
+        await mq.listen(progress_q, on_progress)
         try:
             await done.wait()
         except (KeyboardInterrupt, asyncio.CancelledError):
@@ -200,12 +210,16 @@ async def _scrape(args) -> int:
     if not meta.trackers:
         print("torrent has no trackers to scrape", file=sys.stderr)
         return 2
+    # trackers are independent: query them concurrently so dead ones
+    # don't serialize their timeouts in front of the live ones
+    results = await asyncio.gather(
+        *(tracker_mod.scrape(url, meta.info_hash) for url in meta.trackers),
+        return_exceptions=True,
+    )
     failures = 0
-    for url in meta.trackers:
-        try:
-            stats = await tracker_mod.scrape(url, meta.info_hash)
-        except Exception as err:
-            print(f"{url}\terror\t{err}", file=sys.stderr)
+    for url, stats in zip(meta.trackers, results):
+        if isinstance(stats, BaseException):
+            print(f"{url}\terror\t{stats}", file=sys.stderr)
             failures += 1
             continue
         print(f"{url}\tseeders={stats.seeders}\tleechers={stats.leechers}"

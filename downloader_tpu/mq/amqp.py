@@ -78,12 +78,14 @@ class _PendingPublish:
     relies on for publish reliability.
     """
 
-    __slots__ = ("queue", "body", "fut")
+    __slots__ = ("queue", "body", "fut", "exchange")
 
-    def __init__(self, queue: str, body: bytes, fut: asyncio.Future):
-        self.queue = queue
+    def __init__(self, queue: str, body: bytes, fut: asyncio.Future,
+                 exchange: str = ""):
+        self.queue = queue          # routing key when exchange is ""
         self.body = body
         self.fut = fut
+        self.exchange = exchange    # fanout exchange name, "" = default
 
 
 class _AmqpDelivery(Delivery):
@@ -161,6 +163,9 @@ class AmqpQueue(MessageQueue):
         self._pending_rpc: Optional[Tuple[Tuple[int, int], asyncio.Future]] = None
 
         self._declared: Set[str] = set()
+        self._declared_exchanges: Set[str] = set()
+        # (queue, exchange, exclusive) bindings, replayed on reconnect
+        self._bindings: List[Tuple[str, str, bool]] = []
         self._subscriptions: Dict[str, _Subscription] = {}  # by consumer tag
         self._consuming = True
         self._next_tag = 0
@@ -212,6 +217,7 @@ class AmqpQueue(MessageQueue):
         self._reader, self._writer = reader, writer
         self._epoch += 1
         self._declared.clear()
+        self._declared_exchanges.clear()
         self._publish_seq = 0
         self._unconfirmed.clear()
         self._last_recv = time.monotonic()
@@ -219,6 +225,12 @@ class AmqpQueue(MessageQueue):
         if self._heartbeat:
             self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
         self._connected.set()
+        # re-establish exchange bindings (exclusive tap queues died with
+        # the old connection and must be re-created before re-binding)
+        for queue, exchange, exclusive in list(self._bindings):
+            await self._ensure_exchange(exchange)
+            await self._ensure_queue(queue, exclusive=exclusive)
+            await self._send_bind(queue, exchange)
         # restore consumers on a fresh connection
         if self._consuming:
             for sub in list(self._subscriptions.values()):
@@ -503,16 +515,52 @@ class AmqpQueue(MessageQueue):
             await self._writer.drain()
             return await asyncio.wait_for(fut, timeout)
 
-    async def _ensure_queue(self, queue: str) -> None:
+    async def _ensure_queue(self, queue: str, exclusive: bool = False) -> None:
         if queue in self._declared:
             return
+        # exclusive queues (telemetry taps) are transient: not durable,
+        # auto-deleted with the connection; work queues are durable
         await self._rpc(
             wire.encode_method(
                 self.CHANNEL, wire.QUEUE_DECLARE,
-                0, queue, False, True, False, False, False, None),
+                0, queue, False, not exclusive, exclusive, exclusive,
+                False, None),
             wire.QUEUE_DECLARE_OK,
         )
         self._declared.add(queue)
+
+    async def _ensure_exchange(self, exchange: str) -> None:
+        if exchange in self._declared_exchanges:
+            return
+        await self._rpc(
+            wire.encode_method(
+                self.CHANNEL, wire.EXCHANGE_DECLARE,
+                0, exchange, "fanout", False, True, False, False, False,
+                None),
+            wire.EXCHANGE_DECLARE_OK,
+        )
+        self._declared_exchanges.add(exchange)
+
+    async def _send_bind(self, queue: str, exchange: str) -> None:
+        await self._rpc(
+            wire.encode_method(
+                self.CHANNEL, wire.QUEUE_BIND,
+                0, queue, exchange, "", False, None),
+            wire.QUEUE_BIND_OK,
+        )
+
+    async def bind_queue(self, queue: str, exchange: str,
+                         exclusive: bool = False) -> None:
+        """Declare a fanout ``exchange`` and bind ``queue`` to it (declaring
+        the queue too; ``exclusive`` makes it a transient per-connection tap
+        queue).  Bindings are replayed after a reconnect."""
+        await self._connected.wait()
+        await self._ensure_exchange(exchange)
+        await self._ensure_queue(queue, exclusive=exclusive)
+        await self._send_bind(queue, exchange)
+        entry = (queue, exchange, exclusive)
+        if entry not in self._bindings:
+            self._bindings.append(entry)
 
     async def _settle(self, delivery_tag: int, epoch: int, ack: bool,
                       requeue: bool = True) -> None:
@@ -552,11 +600,14 @@ class AmqpQueue(MessageQueue):
                     ConnectionError("broker rejected publish (basic.nack)"))
 
     async def _send_publish(self, entry: _PendingPublish) -> None:
-        await self._ensure_queue(entry.queue)
+        if entry.exchange:
+            await self._ensure_exchange(entry.exchange)
+        else:
+            await self._ensure_queue(entry.queue)
         frames = [
             wire.encode_method(
                 self.CHANNEL, wire.BASIC_PUBLISH,
-                0, "", entry.queue, False, False),
+                0, entry.exchange, entry.queue, False, False),
             wire.encode_content_header(
                 self.CHANNEL, len(entry.body), {"delivery_mode": 2}),
         ]
@@ -589,6 +640,25 @@ class AmqpQueue(MessageQueue):
             # anything a reconnect can't repair (e.g. RPC timeout on a live
             # connection) must surface, not hang on a confirm that will
             # never arrive
+            self._pending_publishes.pop(entry, None)
+            raise
+        await fut
+
+    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+        """Publish to a fanout exchange: every bound queue gets a copy."""
+        if self._closing:
+            raise RuntimeError("publish on closed queue connection")
+        await self._connected.wait()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        entry = _PendingPublish("", body, fut, exchange=exchange)
+        self._pending_publishes[entry] = None
+        try:
+            await self._send_publish(entry)
+        except (ConnectionError, OSError):
+            if self._closing:
+                self._pending_publishes.pop(entry, None)
+                raise
+        except BaseException:
             self._pending_publishes.pop(entry, None)
             raise
         await fut

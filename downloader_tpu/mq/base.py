@@ -75,3 +75,20 @@ class MessageQueue(abc.ABC):
         (the reference passes prefetch params ``(1, 2)`` to its AMQP
         constructor, lib/main.js:46).
         """
+
+    # -- fanout (optional capability) -----------------------------------
+    # Work queues split deliveries among consumers; telemetry wants every
+    # interested party to see every event.  Backends that support it
+    # expose fanout exchanges: publish_exchange copies to all bound
+    # queues; bind_queue attaches a (possibly exclusive/transient) queue.
+
+    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support fanout exchanges"
+        )
+
+    async def bind_queue(self, queue: str, exchange: str,
+                         exclusive: bool = False) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support fanout exchanges"
+        )

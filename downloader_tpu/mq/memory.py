@@ -81,6 +81,8 @@ class InMemoryBroker:
         self._unsettled: Dict[str, int] = collections.defaultdict(int)
         self.max_redeliveries = max_redeliveries
         self.dropped: List[Tuple[str, bytes]] = []
+        # fanout exchanges: name -> bound queue names (ordered, deduped)
+        self._exchanges: Dict[str, Dict[str, None]] = collections.defaultdict(dict)
 
     # -- introspection helpers for tests --------------------------------
     def published(self, queue: str) -> List[bytes]:
@@ -126,6 +128,14 @@ class InMemoryBroker:
     def publish(self, queue: str, body: bytes) -> None:
         self._published[queue].append(body)
         self._push(queue, _Message(body))
+
+    def bind(self, queue: str, exchange: str) -> None:
+        self._exchanges[exchange][queue] = None
+
+    def publish_exchange(self, exchange: str, body: bytes) -> None:
+        """Fanout: every bound queue gets its own copy."""
+        for queue in self._exchanges[exchange]:
+            self.publish(queue, body)
 
     async def pop(self, queue: str) -> _Message:
         q = self._queues[queue]
@@ -177,6 +187,17 @@ class MemoryQueue(MessageQueue):
         if not self._connected:
             raise RuntimeError("publish on closed queue connection")
         self._broker.publish(queue, body)
+
+    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+        if not self._connected:
+            raise RuntimeError("publish on closed queue connection")
+        self._broker.publish_exchange(exchange, body)
+
+    async def bind_queue(self, queue: str, exchange: str,
+                         exclusive: bool = False) -> None:
+        if not self._connected:
+            raise RuntimeError("bind on closed queue connection")
+        self._broker.bind(queue, exchange)
 
     async def listen(self, queue: str, handler: Handler, prefetch: int = 1) -> None:
         if not self._connected:

@@ -45,8 +45,13 @@ CHANNEL_OPEN_OK = (20, 11)
 CHANNEL_CLOSE = (20, 40)
 CHANNEL_CLOSE_OK = (20, 41)
 
+EXCHANGE_DECLARE = (40, 10)
+EXCHANGE_DECLARE_OK = (40, 11)
+
 QUEUE_DECLARE = (50, 10)
 QUEUE_DECLARE_OK = (50, 11)
+QUEUE_BIND = (50, 20)
+QUEUE_BIND_OK = (50, 21)
 
 BASIC_QOS = (60, 10)
 BASIC_QOS_OK = (60, 11)
@@ -78,8 +83,12 @@ METHOD_ARGS: Dict[Tuple[int, int], str] = {
     CHANNEL_OPEN_OK: "S",
     CHANNEL_CLOSE: "hshh",
     CHANNEL_CLOSE_OK: "",
+    EXCHANGE_DECLARE: "hssbbbbbF",
+    EXCHANGE_DECLARE_OK: "",
     QUEUE_DECLARE: "hsbbbbbF",
     QUEUE_DECLARE_OK: "sll",
+    QUEUE_BIND: "hsssbF",
+    QUEUE_BIND_OK: "",
     BASIC_QOS: "lhb",
     BASIC_QOS_OK: "",
     BASIC_CONSUME: "hssbbbbF",

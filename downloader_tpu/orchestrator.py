@@ -225,28 +225,38 @@ class Orchestrator:
                 if getattr(err, "code", None) == "ERRDLSTALL":
                     if self.metrics is not None:
                         self.metrics.jobs_failed.labels(reason="stalled").inc()
+                    self._failure_counts.pop(job_id, None)  # job is settled
                     await delivery.ack()
                     return
 
                 # anything else -> ERRORED + redelivery
                 # (reference lib/main.js:148-150)
-                if self.metrics is not None:
-                    self.metrics.jobs_failed.labels(reason="stage_error").inc()
                 await self.telemetry.emit_status(
                     job_id, schemas.TelemetryStatus.Value("ERRORED")
                 )
                 failures = self._failure_counts.get(job_id, 0) + 1
                 self._failure_counts[job_id] = failures
+                # bound the counter dict: jobs whose redeliveries land on
+                # other replicas (or get dead-lettered) would otherwise
+                # leak one entry each for the process lifetime
+                if len(self._failure_counts) > 10_000:
+                    self._failure_counts.pop(
+                        next(iter(self._failure_counts))
+                    )
                 if self.poison_threshold and failures >= self.poison_threshold:
                     logger.error(
                         "dropping poison job after repeated failures",
                         failures=failures,
                     )
+                    # one failure, one count: this attempt is recorded as
+                    # the drop, not double-counted as a stage_error too
                     if self.metrics is not None:
                         self.metrics.jobs_failed.labels(reason="poison").inc()
                     self._failure_counts.pop(job_id, None)
                     await delivery.ack()
                     return
+                if self.metrics is not None:
+                    self.metrics.jobs_failed.labels(reason="stage_error").inc()
                 await delivery.nack()
                 return
             logger.info("creating convert job")

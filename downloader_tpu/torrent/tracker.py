@@ -141,7 +141,8 @@ async def scrape(tracker_url: str, info_hash: bytes) -> ScrapeStats:
     trackers use BEP 15 action 2.  Raises TrackerError when the tracker
     does not support scraping.
     """
-    if tracker_url.startswith("udp://"):
+    scheme = urllib.parse.urlsplit(tracker_url).scheme.lower()
+    if scheme == "udp":
         return await scrape_udp(tracker_url, info_hash)
     return await scrape_http(tracker_url, info_hash)
 
@@ -354,18 +355,9 @@ async def announce_udp(
                 loop, transport, proto, payload_fn, timeout, retries
             )
 
-        # connect round trip
-        resp = await _roundtrip(
-            lambda tid: struct.pack(
-                ">QII", _UDP_MAGIC, _ACTION_CONNECT, tid
-            )
+        connection_id = await _udp_connect(
+            loop, transport, proto, timeout, retries
         )
-        (action,) = struct.unpack_from(">I", resp, 0)
-        if action == _ACTION_ERROR:
-            raise TrackerError(resp[8:].decode("utf-8", "replace"))
-        if action != _ACTION_CONNECT or len(resp) < 16:
-            raise TrackerError("malformed udp connect response")
-        (connection_id,) = struct.unpack_from(">Q", resp, 8)
 
         # announce round trip
         resp = await _roundtrip(

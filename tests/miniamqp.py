@@ -87,6 +87,8 @@ class MiniAmqpServer:
         self._consumers: Dict[str, Deque[Tuple[_Conn, str]]] = (
             collections.defaultdict(collections.deque))
         self._published: Dict[str, List[bytes]] = collections.defaultdict(list)
+        # fanout exchanges: name -> {bound queue: None}
+        self._exchanges: Dict[str, Dict[str, None]] = {}
         self.auth_failures = 0
 
     @property
@@ -141,9 +143,17 @@ class MiniAmqpServer:
         self._queues[queue].append(_Msg(body))
         self._pump(queue)
 
-    def _finish_publish(self, conn: _Conn, queue: str, body: bytes) -> None:
-        """Route a completed publish and confirm it if the channel asked."""
-        self._publish(queue, body)
+    def _finish_publish(self, conn: _Conn, exchange: str, routing_key: str,
+                        body: bytes) -> None:
+        """Route a completed publish and confirm it if the channel asked.
+
+        A named exchange fans the body out to every bound queue; the
+        default exchange ("") routes straight to the routing-key queue."""
+        if exchange:
+            for queue in self._exchanges.get(exchange, {}):
+                self._publish(queue, body)
+        else:
+            self._publish(routing_key, body)
         conn.publish_seq += 1
         if conn.confirm_mode:
             conn.send(wire.encode_method(
@@ -240,7 +250,7 @@ class MiniAmqpServer:
             return method, args
 
     async def _frame_loop(self, conn: _Conn) -> None:
-        pending_publish: Optional[str] = None
+        pending_publish: "Optional[Tuple[str, str]]" = None
         pending_size = 0
         chunks: List[bytes] = []
         while True:
@@ -253,7 +263,7 @@ class MiniAmqpServer:
                 pending_size, _props = wire.decode_content_header(payload)
                 chunks = []
                 if pending_size == 0 and pending_publish is not None:
-                    self._finish_publish(conn, pending_publish, b"")
+                    self._finish_publish(conn, *pending_publish, b"")
                     pending_publish = None
                     await conn.writer.drain()
                 continue
@@ -261,7 +271,7 @@ class MiniAmqpServer:
                 chunks.append(payload)
                 if (pending_publish is not None
                         and sum(map(len, chunks)) >= pending_size):
-                    self._finish_publish(conn, pending_publish, b"".join(chunks))
+                    self._finish_publish(conn, *pending_publish, b"".join(chunks))
                     pending_publish = None
                     chunks = []
                     await conn.writer.drain()
@@ -294,8 +304,18 @@ class MiniAmqpServer:
             elif method == wire.CONFIRM_SELECT:
                 conn.confirm_mode = True
                 conn.send(wire.encode_method(channel, wire.CONFIRM_SELECT_OK))
+            elif method == wire.EXCHANGE_DECLARE:
+                self._exchanges.setdefault(args[1], {})
+                conn.send(wire.encode_method(
+                    channel, wire.EXCHANGE_DECLARE_OK))
+            elif method == wire.QUEUE_BIND:
+                queue, exchange = args[1], args[2]
+                self._queues[queue]  # ensure exists
+                self._exchanges.setdefault(exchange, {})[queue] = None
+                conn.send(wire.encode_method(channel, wire.QUEUE_BIND_OK))
             elif method == wire.BASIC_PUBLISH:
-                pending_publish = args[2]  # routing key = queue (default exchange)
+                # (exchange, routing key); "" exchange = direct to queue
+                pending_publish = (args[1], args[2])
             elif method == wire.BASIC_ACK:
                 conn.unacked.pop(args[0], None)
                 for queue in list(conn.consumers.values()):

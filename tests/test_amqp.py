@@ -474,3 +474,58 @@ async def test_heartbeats_flow(server):
         await mq.close()
     finally:
         await srv.stop()
+
+
+async def test_telemetry_tap_does_not_steal_from_consumer(server):
+    """Fanout telemetry: the canonical queue consumer AND an observer tap
+    each receive EVERY event (a tap used to compete on the work queue and
+    destroy events for the real consumer)."""
+    from downloader_tpu.platform.telemetry import (
+        STATUS_EXCHANGE,
+        STATUS_QUEUE,
+        Telemetry,
+    )
+
+    pub_mq = AmqpQueue(server.url, heartbeat=0)
+    telem = Telemetry(pub_mq)
+    await telem.connect()
+
+    consumer = AmqpQueue(server.url, heartbeat=0)
+    await consumer.connect()
+    tap = AmqpQueue(server.url, heartbeat=0)
+    await tap.connect()
+
+    got_consumer: list = []
+    got_tap: list = []
+    done = asyncio.Event()
+
+    def _check():
+        if len(got_consumer) == 3 and len(got_tap) == 3:
+            done.set()
+
+    async def on_consumer(delivery):
+        got_consumer.append(delivery.body)
+        await delivery.ack()
+        _check()
+
+    async def on_tap(delivery):
+        got_tap.append(delivery.body)
+        await delivery.ack()
+        _check()
+
+    try:
+        await consumer.listen(STATUS_QUEUE, on_consumer)
+        await tap.bind_queue("tap.test", STATUS_EXCHANGE, exclusive=True)
+        await tap.listen("tap.test", on_tap)
+
+        for i in range(3):
+            await telem.emit_status(f"job-{i}", 2)
+        async with asyncio.timeout(10):
+            await done.wait()
+        assert len(got_consumer) == 3
+        assert len(got_tap) == 3
+        assert sorted(got_consumer) == sorted(got_tap)
+    finally:
+        await consumer.close()
+        await tap.close()
+        await telem.close()

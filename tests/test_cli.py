@@ -129,8 +129,8 @@ async def test_watch_tails_telemetry(tmp_path, monkeypatch, capsys):
 
         async def publish_events():
             mq = AmqpQueue(server.url, heartbeat=0)
-            await mq.connect()
             telem = Telemetry(mq)
+            await telem.connect()  # engages the fanout exchanges
             try:
                 await asyncio.sleep(0.3)  # let watch subscribe first
                 await telem.emit_status(
