@@ -185,6 +185,11 @@ class AmqpQueue(MessageQueue):
     # -- connection lifecycle -------------------------------------------
 
     async def connect(self) -> None:
+        if self._connected.is_set() and not self._closing:
+            # idempotent: a second connect() (e.g. Telemetry.connect after
+            # the caller already connected the queue) must not stack a new
+            # connection over the live one
+            return
         delay = self._reconnect_initial
         attempt = 0
         while True:

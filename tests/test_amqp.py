@@ -529,3 +529,71 @@ async def test_telemetry_tap_does_not_steal_from_consumer(server):
         await consumer.close()
         await tap.close()
         await telem.close()
+
+
+async def test_job_survives_broker_outage_mid_download(server, tmp_path):
+    """Chaos: the broker drops every connection while a job is mid-
+    download. The download finishes regardless, the stale ack is
+    discarded, the broker redelivers, and the idempotency marker turns
+    the duplicate run into a skip that still publishes Convert."""
+    from helpers import start_media_server
+    from downloader_tpu import schemas
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+    from downloader_tpu.store import InMemoryObjectStore
+    from test_orchestrator import make_download_msg
+
+    payload = b"V" * 300_000
+    runner, base = await start_media_server(payload, delay=0.5)
+    mq = AmqpQueue(server.url, heartbeat=0, reconnect_initial=0.02)
+    telem_mq = AmqpQueue(server.url, heartbeat=0, reconnect_initial=0.02)
+    telem = Telemetry(telem_mq)
+    await telem.connect()
+    store = InMemoryObjectStore()
+    orchestrator = Orchestrator(
+        config=ConfigNode(
+            {"instance": {"download_path": str(tmp_path / "dl")}}
+        ),
+        mq=mq,
+        store=store,
+        telemetry=telem,
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    try:
+        await mq.publish(
+            schemas.DOWNLOAD_QUEUE, make_download_msg(f"{base}/show.mkv")
+        )
+        await asyncio.sleep(0.2)  # job started; download sleeping in fixture
+        await server.drop_connections()
+
+        # drain: first run's ack is stale, broker redelivers, duplicate
+        # run skips via the done marker and re-publishes Convert
+        async with asyncio.timeout(30):
+            while True:
+                if (server.published(schemas.CONVERT_QUEUE)
+                        and server.unacked() == 0
+                        and not orchestrator.active_jobs):
+                    try:
+                        await server.join(schemas.DOWNLOAD_QUEUE, timeout=1)
+                        break
+                    except TimeoutError:
+                        pass
+                await asyncio.sleep(0.1)
+
+        staged = await store.get_object(
+            STAGING_BUCKET, object_name("job-1", "show.mkv")
+        )
+        assert staged == payload
+        assert (await store.get_object(
+            STAGING_BUCKET, "job-1/original/done") == b"true")
+        converts = server.published(schemas.CONVERT_QUEUE)
+        assert len(converts) >= 1  # duplicate runs may re-publish: at-least-once
+        for raw in converts:
+            assert schemas.decode(schemas.Convert, raw).media.id == "job-1"
+    finally:
+        await orchestrator.shutdown(grace_seconds=10)
+        await runner.cleanup()
