@@ -559,6 +559,8 @@ class AmqpQueue(MessageQueue):
         """Declare a fanout ``exchange`` and bind ``queue`` to it (declaring
         the queue too; ``exclusive`` makes it a transient per-connection tap
         queue).  Bindings are replayed after a reconnect."""
+        if self._closing:
+            raise RuntimeError("bind on closed queue connection")
         await self._connected.wait()
         await self._ensure_exchange(exchange)
         await self._ensure_queue(queue, exclusive=exclusive)
@@ -624,12 +626,10 @@ class AmqpQueue(MessageQueue):
             self._writer.write(b"".join(frames))
             await self._writer.drain()
 
-    async def publish(self, queue: str, body: bytes) -> None:
+    async def _publish_entry(self, entry: _PendingPublish) -> None:
         if self._closing:
             raise RuntimeError("publish on closed queue connection")
         await self._connected.wait()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        entry = _PendingPublish(queue, body, fut)
         self._pending_publishes[entry] = None
         try:
             await self._send_publish(entry)
@@ -647,26 +647,18 @@ class AmqpQueue(MessageQueue):
             # never arrive
             self._pending_publishes.pop(entry, None)
             raise
-        await fut
+        await entry.fut
+
+    async def publish(self, queue: str, body: bytes) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._publish_entry(_PendingPublish(queue, body, fut))
 
     async def publish_exchange(self, exchange: str, body: bytes) -> None:
         """Publish to a fanout exchange: every bound queue gets a copy."""
-        if self._closing:
-            raise RuntimeError("publish on closed queue connection")
-        await self._connected.wait()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        entry = _PendingPublish("", body, fut, exchange=exchange)
-        self._pending_publishes[entry] = None
-        try:
-            await self._send_publish(entry)
-        except (ConnectionError, OSError):
-            if self._closing:
-                self._pending_publishes.pop(entry, None)
-                raise
-        except BaseException:
-            self._pending_publishes.pop(entry, None)
-            raise
-        await fut
+        await self._publish_entry(
+            _PendingPublish("", body, fut, exchange=exchange)
+        )
 
     async def listen(self, queue: str, handler: Handler, prefetch: int = 1) -> None:
         if self._closing:

@@ -234,7 +234,9 @@ class Orchestrator:
                 await self.telemetry.emit_status(
                     job_id, schemas.TelemetryStatus.Value("ERRORED")
                 )
-                failures = self._failure_counts.get(job_id, 0) + 1
+                failures = self._failure_counts.pop(job_id, 0) + 1
+                # re-insert at the back: dict eviction below then drops the
+                # LEAST-recently-failing job, never an actively hot one
                 self._failure_counts[job_id] = failures
                 # bound the counter dict: jobs whose redeliveries land on
                 # other replicas (or get dead-lettered) would otherwise
