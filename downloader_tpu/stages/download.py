@@ -138,6 +138,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             from ..torrent.dht import DHTNode, parse_bootstrap
 
             routers = parse_bootstrap(bootstrap_spec)  # validate BEFORE binding
+            # routing-table cache: a restarted service rejoins the DHT from
+            # the nodes it knew, not just the public routers
+            state_path = os.environ.get("DHT_STATE_PATH") or getattr(
+                ctx.config.instance, "dht_state_path", None
+            )
+            if state_path:
+                cached = DHTNode.load_nodes(state_path)
+                if cached:
+                    logger.info("dht node cache loaded", count=len(cached))
+                routers = routers + cached
             node = DHTNode(logger=logger)
             await node.start()
             try:
@@ -153,7 +163,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 return None
             logger.info("dht bootstrapped", routing_table=found)
             ctx.resources["dht_node"] = node
-            ctx.cleanups.append(node.close)
+
+            async def _shutdown_dht() -> None:
+                if state_path:
+                    try:
+                        saved = node.save_nodes(state_path)
+                        logger.info("dht node cache saved", count=saved)
+                    except OSError as err:
+                        logger.warn("dht node cache save failed",
+                                    error=str(err))
+                await node.close()
+
+            ctx.cleanups.append(_shutdown_dht)
             return node
 
     async def torrent(resource_url: str, file_id: str, download_path: str, job: Job):

@@ -225,6 +225,48 @@ class DHTNode:
                 fut.cancel()
         self._pending.clear()
 
+    # -- routing-table persistence ---------------------------------------
+    def dump_nodes(self, limit: int = 200) -> List[Tuple[str, int]]:
+        """Known-good node addresses, most-recently-seen first — feed them
+        back into :meth:`bootstrap` on the next start so a restarted
+        service rejoins the DHT without waiting on the public routers."""
+        nodes = [
+            node for bucket in self.table.buckets for node in bucket
+        ]
+        nodes.sort(
+            key=lambda n: self.table.last_seen.get(n.node_id, 0.0),
+            reverse=True,
+        )
+        return [(n.host, n.port) for n in nodes[:limit]]
+
+    def save_nodes(self, path: str) -> int:
+        """Persist :meth:`dump_nodes` as JSON; returns the count saved."""
+        import json
+
+        nodes = self.dump_nodes()
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(nodes, fh)
+        os.replace(tmp, path)
+        return len(nodes)
+
+    @staticmethod
+    def load_nodes(path: str) -> List[Tuple[str, int]]:
+        """Addresses previously saved with :meth:`save_nodes`; empty on
+        any problem (a corrupt cache must not block bootstrap)."""
+        import json
+
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                raw = json.load(fh)
+            return [
+                (str(host), int(port))
+                for host, port in raw
+                if 0 < int(port) < 65536
+            ]
+        except (OSError, ValueError, TypeError):
+            return []
+
     async def bootstrap(self, nodes: Iterable[Tuple[str, int]]) -> int:
         """Ping the given routers and walk toward our own id to fill the
         table.  Returns the resulting routing-table size."""
