@@ -205,3 +205,39 @@ def test_checkpoint_restore_onto_mesh(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(params),
                     jax.tree_util.tree_leaves(r_params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_infer_pipeline_uint8_roundtrip():
+    """uint8 frames in, correctly-shaped uint8 frames out, matching the
+    unfused reference computation."""
+    import numpy as np
+
+    from downloader_tpu.compute.infer import make_infer_fn, upscale_frames
+    from downloader_tpu.compute.models.upscaler import (
+        UpscalerConfig,
+        init_params,
+    )
+
+    config = UpscalerConfig(features=128, depth=2)
+    _model, params = init_params(jax.random.PRNGKey(3), config,
+                                 sample_shape=(1, 16, 16, 3))
+    frames = np.random.randint(0, 256, (2, 16, 16, 3), dtype=np.uint8)
+
+    out = np.asarray(make_infer_fn(config)(params, jnp.asarray(frames)))
+    assert out.shape == (2, 32, 32, 3)
+    assert out.dtype == np.uint8
+
+    # reference path: forward + clip/round/cast without the fused tail
+    model = __import__(
+        "downloader_tpu.compute.models.upscaler", fromlist=["Upscaler"]
+    ).Upscaler(config)
+    x = jnp.asarray(frames).astype(jnp.float32) / 255.0
+    ref = jnp.clip(
+        jnp.round(model.apply(params, x).astype(jnp.float32) * 255.0),
+        0, 255,
+    ).astype(jnp.uint8)
+    np.testing.assert_array_equal(out, np.asarray(ref))
+
+    # cached wrapper produces the same result
+    again = np.asarray(upscale_frames(params, jnp.asarray(frames), config))
+    np.testing.assert_array_equal(out, again)
