@@ -14,39 +14,30 @@ import jax
 import jax.numpy as jnp
 
 from .models.upscaler import Upscaler, UpscalerConfig
-from .ops.pixel_shuffle import _pallas_quantize_u8
+from .ops.pixel_shuffle import quantize_u8
 
 
+@functools.lru_cache(maxsize=4)
 def make_infer_fn(config: UpscalerConfig = UpscalerConfig()):
-    """Returns ``infer(params, frames_u8) -> upscaled_u8``.
+    """Returns ``infer(params, frames_u8) -> upscaled_u8`` (cached per
+    config, so every caller shares one compiled function).
 
     Input frames are uint8 (B, H, W, C) as a media decoder would hand
     them; output is uint8 (B, H*scale, W*scale, C).  Normalization to the
     model's [0, 1] float range and re-quantization live inside the jit.
     """
     model = Upscaler(config)
-    # backend choice is a trace-time constant: the Pallas quantize kernel
-    # is verified on TPU hardware; other backends take the XLA path
-    use_pallas = jax.default_backend() == "tpu"
 
     @jax.jit
     def infer(params, frames_u8: jax.Array) -> jax.Array:
         x = frames_u8.astype(jnp.float32) / 255.0
         out = model.apply(params, x)           # bf16 forward (incl. shuffle)
-        scaled = out.astype(jnp.float32) * 255.0
-        if use_pallas:
-            return _pallas_quantize_u8(scaled)
-        return jnp.clip(jnp.round(scaled), 0, 255).astype(jnp.uint8)
+        return quantize_u8(out.astype(jnp.float32) * 255.0)
 
     return infer
 
 
-@functools.lru_cache(maxsize=4)
-def _cached_infer(config: UpscalerConfig):
-    return make_infer_fn(config)
-
-
 def upscale_frames(params, frames_u8,
                    config: UpscalerConfig = UpscalerConfig()):
-    """Convenience wrapper with a cached jitted function per config."""
-    return _cached_infer(config)(params, frames_u8)
+    """Convenience wrapper around the cached jitted function."""
+    return make_infer_fn(config)(params, frames_u8)

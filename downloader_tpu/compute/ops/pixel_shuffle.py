@@ -38,13 +38,19 @@ def pixel_shuffle_clip_u8(x: jax.Array, scale: int) -> jax.Array:
     on TPU (verified on hardware; Mosaic needs the i32 cast bridge), with
     the XLA path as fallback elsewhere (CPU tests, driver dry runs).
     """
-    shuffled = pixel_shuffle(x.astype(jnp.float32), scale)
+    return quantize_u8(pixel_shuffle(x.astype(jnp.float32), scale))
+
+
+def quantize_u8(x: jax.Array) -> jax.Array:
+    """clip(round(x), 0, 255) -> uint8, via the Pallas kernel on TPU with
+    the XLA path as fallback — the one dispatch point for the quantize
+    tail (inference uses it too)."""
     if jax.default_backend() == "tpu":
         try:
-            return _pallas_quantize_u8(shuffled)
+            return _pallas_quantize_u8(x)
         except Exception:  # pragma: no cover - pallas availability varies
             pass
-    return jnp.clip(jnp.round(shuffled), 0, 255).astype(jnp.uint8)
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
 
 
 def _pallas_shuffle_clip(x: jax.Array, scale: int, interpret: bool = False) -> jax.Array:

@@ -228,9 +228,9 @@ def test_infer_pipeline_uint8_roundtrip():
     assert out.dtype == np.uint8
 
     # reference path: forward + clip/round/cast without the fused tail
-    model = __import__(
-        "downloader_tpu.compute.models.upscaler", fromlist=["Upscaler"]
-    ).Upscaler(config)
+    from downloader_tpu.compute.models.upscaler import Upscaler
+
+    model = Upscaler(config)
     x = jnp.asarray(frames).astype(jnp.float32) / 255.0
     ref = jnp.clip(
         jnp.round(model.apply(params, x).astype(jnp.float32) * 255.0),
