@@ -20,7 +20,7 @@ import asyncio
 import hashlib
 import random
 import struct
-from collections import Counter
+from collections import Counter, deque
 from typing import Awaitable, Callable, List, Optional, Set
 
 import aiohttp
@@ -46,6 +46,42 @@ WEBSEED_NO_RANGE_MAX = 32 << 20
 # worker/session cap, like MAX_PEERS — a hostile url-list must not be able
 # to spawn one task + HTTP session per entry
 MAX_WEBSEEDS = 4
+# pieces a peer worker assembles concurrently: claiming the next piece
+# before the current one's tail blocks land keeps the request pipeline
+# full across piece boundaries
+MAX_ACTIVE_CLAIMS = 2
+
+
+class _Assembly:
+    """In-flight piece reassembly for one (worker, piece)."""
+
+    __slots__ = ("buffer", "received", "requested", "rejects", "pending")
+
+    def __init__(self, size: int):
+        self.buffer = bytearray(size)
+        self.received: Set[int] = set()
+        self.requested: Set[int] = set()
+        # unchoked REJECT_REQUEST counts per block (BEP 6)
+        self.rejects: dict = {}
+        # block offsets not yet requested, in order — the pump pops from
+        # here (O(1) per request) instead of rescanning every block
+        self.pending = deque(range(0, size, BLOCK_SIZE))
+
+    def requeue(self, begin: int) -> None:
+        """A request for ``begin`` was lost (reject): offer it again."""
+        self.requested.discard(begin)
+        if begin not in self.received:
+            self.pending.append(begin)
+
+    def rebuild_pending(self) -> None:
+        """After a choke wiped the peer's request queue: everything not
+        yet received must be re-requested."""
+        self.requested &= self.received
+        size = len(self.buffer)
+        self.pending = deque(
+            b for b in range(0, size, BLOCK_SIZE)
+            if b not in self.received
+        )
 
 
 class TorrentError(RuntimeError):
@@ -757,7 +793,6 @@ class TorrentClient:
     async def _peer_worker(self, peer_addr, storage: TorrentStorage,
                            swarm: _Swarm) -> None:
         meta = swarm.meta
-        claimed: Optional[int] = None
         try:
             peer = await self._connect(peer_addr, meta.info_hash,
                                        listen_port=swarm.listen_port)
@@ -768,12 +803,11 @@ class TorrentClient:
         choked = True
         interested_sent = False
 
-        # per-piece assembly state
-        buffer: Optional[bytearray] = None
-        received: Set[int] = set()
-        requested: Set[int] = set()
-        # unchoked REJECT_REQUESTs per block of the current claim (BEP 6)
-        reject_counts: dict = {}
+        # per-piece assembly state: up to MAX_ACTIVE_CLAIMS pieces are in
+        # flight at once, so the request pipeline never drains while the
+        # tail blocks of one piece are still in transit (a single-claim
+        # worker stalls at every piece boundary)
+        active: Dict[int, _Assembly] = {}
 
         async def _add_have(indices: Set[int]) -> None:
             nonlocal interested_sent
@@ -788,43 +822,43 @@ class TorrentClient:
             return list(range(0, meta.piece_size(piece), BLOCK_SIZE))
 
         async def _abandon_if_done_elsewhere() -> None:
-            # endgame: another worker finished our piece first — cancel the
-            # in-flight requests (BEP 3) and free this peer for other work
-            nonlocal claimed, buffer, received, requested
-            if claimed is None or claimed not in swarm.done:
-                return
-            for begin in requested - received:
-                length = min(BLOCK_SIZE, meta.piece_size(claimed) - begin)
-                await peer.send_cancel(claimed, begin, length)
-            claimed = None
-            buffer = None
-            received = set()
-            requested = set()
+            # endgame: another worker finished one of our pieces first —
+            # cancel its in-flight requests (BEP 3) and free the slot
+            for piece in [p for p in active if p in swarm.done]:
+                asm = active.pop(piece)
+                for begin in asm.requested - asm.received:
+                    length = min(BLOCK_SIZE, meta.piece_size(piece) - begin)
+                    await peer.send_cancel(piece, begin, length)
 
         async def _pump_requests() -> None:
-            nonlocal claimed, buffer, received, requested, reject_counts
             await _abandon_if_done_elsewhere()
             if choked:
                 return
-            if claimed is None:
-                piece = swarm.claim(have)
-                if piece is None:
+            outstanding = sum(
+                len(a.requested - a.received) for a in active.values()
+            )
+            while outstanding < PIPELINE_DEPTH:
+                for piece, asm in list(active.items()):
+                    while asm.pending and outstanding < PIPELINE_DEPTH:
+                        begin = asm.pending.popleft()
+                        if begin in asm.requested or begin in asm.received:
+                            continue
+                        length = min(
+                            BLOCK_SIZE, meta.piece_size(piece) - begin
+                        )
+                        await peer.send_request(piece, begin, length)
+                        asm.requested.add(begin)
+                        outstanding += 1
+                if outstanding >= PIPELINE_DEPTH:
                     return
-                claimed = piece
-                buffer = bytearray(meta.piece_size(piece))
-                received = set()
-                requested = set()
-                reject_counts = {}
-            outstanding = requested - received
-            for begin in _blocks(claimed):
-                if len(outstanding) >= PIPELINE_DEPTH:
-                    break
-                if begin in requested:
-                    continue
-                length = min(BLOCK_SIZE, meta.piece_size(claimed) - begin)
-                await peer.send_request(claimed, begin, length)
-                requested.add(begin)
-                outstanding.add(begin)
+                if len(active) >= MAX_ACTIVE_CLAIMS:
+                    return
+                piece = swarm.claim(have)
+                if piece is None or piece in active:
+                    # nothing claimable — or endgame handed back one of
+                    # our own in-flight pieces
+                    return
+                active[piece] = _Assembly(meta.piece_size(piece))
 
         idle_rounds = 0
         try:
@@ -857,28 +891,25 @@ class TorrentClient:
                     have.clear()
                 elif msg_id == wire.MSG_REJECT_REQUEST:  # BEP 6
                     index, begin, _length = struct.unpack(">III", payload)
-                    if index != claimed:
+                    asm = active.get(index)
+                    if asm is None:
                         continue
-                    requested.discard(begin)
+                    asm.requeue(begin)
                     if choked:
                         # BEP 6: fast peers reject all in-flight requests
                         # when choking — the piece is fine, the unchoke
                         # re-pump re-requests it; the blocks we already
                         # hold stay held
                         continue
-                    reject_counts[begin] = reject_counts.get(begin, 0) + 1
-                    if reject_counts[begin] >= 2:
+                    asm.rejects[begin] = asm.rejects.get(begin, 0) + 1
+                    if asm.rejects[begin] >= 2:
                         # repeatedly refused while unchoked: this peer
                         # won't serve the piece — hand it to the others
                         if index in have:
                             have.discard(index)
                             swarm.availability[index] -= 1
-                        swarm.release(claimed)
-                        claimed = None
-                        buffer = None
-                        received = set()
-                        requested = set()
-                        reject_counts = {}
+                        swarm.release(index)
+                        active.pop(index, None)
                     await _pump_requests()
                 elif msg_id == wire.MSG_UNCHOKE:
                     choked = False
@@ -887,7 +918,8 @@ class TorrentClient:
                     choked = True
                     # BEP 3: a choke discards the peer's request queue, so
                     # undelivered requests must be re-sent after unchoke
-                    requested &= received
+                    for asm in active.values():
+                        asm.rebuild_pending()
                 elif msg_id == wire.MSG_EXTENDED:
                     if payload[0] == wire.EXT_HANDSHAKE_ID:
                         peer.handle_ext_handshake(payload[1:])
@@ -901,26 +933,26 @@ class TorrentClient:
                         await self.rate_limiter.consume(len(payload))
                     index, begin = struct.unpack(">II", payload[:8])
                     data = payload[8:]
-                    if index != claimed or buffer is None:
+                    asm = active.get(index)
+                    if asm is None:
                         continue
-                    buffer[begin:begin + len(data)] = data
-                    received.add(begin)
-                    if received == set(_blocks(claimed)):
-                        piece_bytes = bytes(buffer)
+                    asm.buffer[begin:begin + len(data)] = data
+                    asm.received.add(begin)
+                    if asm.received == set(_blocks(index)):
+                        piece_bytes = bytes(asm.buffer)
                         digest = hashlib.sha1(piece_bytes).digest()
-                        if digest == meta.piece_hashes[claimed]:
+                        if digest == meta.piece_hashes[index]:
                             # skip when an endgame duplicate landed second —
                             # the winner already wrote it (no await between
                             # the check and finish, so this is atomic)
-                            if claimed not in swarm.done:
-                                storage.write_piece(claimed, piece_bytes)
-                                swarm.finish(claimed)
+                            if index not in swarm.done:
+                                storage.write_piece(index, piece_bytes)
+                                swarm.finish(index)
                         else:
-                            self._log("piece hash mismatch", piece=claimed)
+                            self._log("piece hash mismatch", piece=index)
                             swarm.hash_failures += 1
-                            swarm.release(claimed)
-                        claimed = None
-                        buffer = None
+                            swarm.release(index)
+                        active.pop(index, None)
                     await _pump_requests()
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 wire.WireError, struct.error, IndexError, ValueError,
@@ -930,8 +962,8 @@ class TorrentClient:
             # untrusted wire bytes, so treat them like a dead peer
             self._log("peer connection lost", peer=str(peer_addr), error=str(err))
         finally:
-            if claimed is not None:
-                swarm.release(claimed)
+            for piece in active:
+                swarm.release(piece)
             # this peer's copies no longer count toward piece availability
             swarm.availability.subtract(have)
             await peer.close()
