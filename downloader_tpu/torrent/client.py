@@ -21,7 +21,7 @@ import hashlib
 import random
 import struct
 from collections import Counter, deque
-from typing import Awaitable, Callable, List, Optional, Set
+from typing import Awaitable, Callable, Dict, List, Optional, Set
 
 import aiohttp
 
@@ -68,7 +68,14 @@ class _Assembly:
         self.pending = deque(range(0, size, BLOCK_SIZE))
 
     def requeue(self, begin: int) -> None:
-        """A request for ``begin`` was lost (reject): offer it again."""
+        """A request for ``begin`` was lost (reject): offer it again.
+
+        Only offsets we actually requested re-enter the queue — a forged
+        reject for a bogus offset must not reach the pump (a negative
+        computed length would kill the connection; a misaligned one would
+        wedge the piece)."""
+        if begin not in self.requested:
+            return
         self.requested.discard(begin)
         if begin not in self.received:
             self.pending.append(begin)
@@ -935,6 +942,13 @@ class TorrentClient:
                     data = payload[8:]
                     asm = active.get(index)
                     if asm is None:
+                        continue
+                    if (begin % BLOCK_SIZE
+                            or begin + len(data) > len(asm.buffer)):
+                        # untrusted wire bytes: a misaligned or oversized
+                        # block would silently grow the buffer (bytearray
+                        # slice assignment appends past the end) and
+                        # poison the completion check
                         continue
                     asm.buffer[begin:begin + len(data)] = data
                     asm.received.add(begin)
