@@ -1084,3 +1084,54 @@ def test_scrape_url_convention():
             == "http://t/scrape.php?key=1")
     with pytest.raises(TrackerError):
         _scrape_url("http://t/notannounce")
+
+
+async def test_malicious_piece_offsets_do_not_wedge_download(swarm, tmp_path):
+    """A hostile peer spraying misaligned/out-of-bounds PIECE payloads and
+    forged REJECTs must not stall the worker pool or grow buffers; the
+    honest seeder completes the download."""
+    from downloader_tpu.torrent import wire as w
+
+    async def hostile_peer(reader, writer):
+        peer = w.PeerWire(reader, writer)
+        try:
+            await peer.recv_handshake()
+            await peer.send_handshake(swarm.meta.info_hash,
+                                      b"-EV0001-xxxxxxxxxxxx")
+            await peer.send_have_all()
+            while True:
+                msg_id, payload = await peer.recv_message()
+                if msg_id == w.MSG_INTERESTED:
+                    await peer.send_message(w.MSG_UNCHOKE)
+                elif msg_id == w.MSG_REQUEST:
+                    index, begin, length = struct.unpack(">III", payload)
+                    # forged reject for an offset never requested
+                    await peer.send_reject_request(index, 0xFFFF0000, length)
+                    # misaligned block (begin=1)
+                    await peer.send_piece(index, 1, b"z" * 100)
+                    # out-of-bounds begin that would slice-append
+                    await peer.send_piece(index, 2 ** 30, b"z" * 100)
+                    # then reject the real request so the piece re-pools
+                    await peer.send_reject_request(index, begin, length)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await peer.close()
+
+    server = await asyncio.start_server(hostile_peer, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        tf = tmp_path / "evil.torrent"
+        tf.write_bytes(swarm.meta.to_torrent_bytes())
+        dest = str(tmp_path / "dl-evil")
+        got = await TorrentClient().download(
+            str(tf), dest,
+            peers=[Peer("127.0.0.1", port),
+                   Peer("127.0.0.1", swarm.seeder.port)],
+            stall_timeout=30,
+        )
+        assert got.info_hash == swarm.meta.info_hash
+        assert_downloaded(swarm, dest)
+    finally:
+        server.close()
+        await server.wait_closed()
