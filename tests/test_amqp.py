@@ -32,6 +32,7 @@ def test_parse_amqp_url():
         "user": "user",
         "password": "p@ss",
         "vhost": "vh",
+        "tls": False,
     }
 
 
