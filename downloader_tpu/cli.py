@@ -50,6 +50,9 @@ def _build_parser() -> argparse.ArgumentParser:
     submit.add_argument("--uri", required=True,
                         help="magnet:, http(s)://, file://, or bucket:// URI")
     submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
+    submit.add_argument("--wait", action="store_true",
+                        help="tap telemetry and block until the job "
+                             "reaches 100%% or errors")
 
     mk = sub.add_parser("mktorrent", help="build a .torrent from a path")
     mk.add_argument("path", help="file or directory to seed")
@@ -113,10 +116,62 @@ async def _submit(args) -> int:
     mq = new_queue(config, logger=logger)
     await mq.connect()
     try:
-        await mq.publish(args.queue, schemas.encode(msg))
+        if not args.wait:
+            await mq.publish(args.queue, schemas.encode(msg))
+            print(f"submitted {args.id} -> {args.queue}")
+            return 0
+        return await _submit_and_wait(mq, args, msg)
     finally:
         await mq.close()
-    print(f"submitted {args.id} -> {args.queue}")
+
+
+async def _submit_and_wait(mq, args, msg) -> int:
+    """Publish, then tap telemetry until the job finishes or errors.
+
+    The tap is bound BEFORE the publish so no event can be missed."""
+    import os
+
+    from .platform.telemetry import PROGRESS_EXCHANGE, STATUS_EXCHANGE
+
+    errored = schemas.TelemetryStatus.Value("ERRORED")
+    outcome: dict = {}
+    done = asyncio.Event()
+
+    async def on_status(delivery):
+        event = schemas.decode(schemas.TelemetryStatusEvent, delivery.body)
+        await delivery.ack()
+        if event.media_id != args.id:
+            return
+        name = schemas.TelemetryStatus.Name(event.status)
+        print(f"{args.id}\tstatus\t{name}", flush=True)
+        if event.status == errored:
+            outcome["failed"] = True
+            done.set()
+
+    async def on_progress(delivery):
+        event = schemas.decode(schemas.TelemetryProgressEvent, delivery.body)
+        await delivery.ack()
+        if event.media_id != args.id:
+            return
+        print(f"{args.id}\tprogress\t{event.percent}%", flush=True)
+        if event.percent >= 100:
+            done.set()
+
+    tap = os.urandom(4).hex()
+    await mq.bind_queue(f"v1.telemetry.tap.{tap}.status",
+                        STATUS_EXCHANGE, exclusive=True)
+    await mq.bind_queue(f"v1.telemetry.tap.{tap}.progress",
+                        PROGRESS_EXCHANGE, exclusive=True)
+    await mq.listen(f"v1.telemetry.tap.{tap}.status", on_status)
+    await mq.listen(f"v1.telemetry.tap.{tap}.progress", on_progress)
+
+    await mq.publish(args.queue, schemas.encode(msg))
+    print(f"submitted {args.id} -> {args.queue}", flush=True)
+    await done.wait()
+    if outcome.get("failed"):
+        print(f"{args.id} ERRORED", file=sys.stderr)
+        return 1
+    print(f"{args.id} staged")
     return 0
 
 

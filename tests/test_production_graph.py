@@ -96,3 +96,43 @@ async def test_full_production_graph(tmp_path, monkeypatch):
         await media.cleanup()
         await s3.stop()
         await amqp.stop()
+
+
+async def test_submit_wait_follows_job_to_completion(tmp_path, monkeypatch):
+    """`submit --wait` blocks until the staged job reports 100%."""
+    amqp = await MiniAmqpServer().start()
+    s3 = MiniS3()
+    s3_url = await s3.start()
+    payload = os.urandom(120_000)
+    media, base = await start_media_server(payload, path="/m.mkv")
+    try:
+        config = ConfigNode({
+            "instance": {"download_path": str(tmp_path / "dl")},
+            "rabbitmq": {"backend": "amqp"},
+            "minio": {
+                "backend": "s3", "endpoint": s3_url,
+                "access_key": s3.access_key, "secret_key": s3.secret_key,
+            },
+            "services": {"rabbitmq": amqp.url},
+        })
+        orchestrator, _metrics, _telem = build_service(config)
+        await orchestrator.start()
+
+        (tmp_path / "converter.yaml").write_text(
+            "rabbitmq: {backend: amqp}\n"
+            f"services: {{rabbitmq: \"{amqp.url}\"}}\n"
+        )
+        monkeypatch.setenv("CONFIG_PATH", str(tmp_path))
+        rc = await asyncio.to_thread(cli.main, [
+            "submit", "--id", "wait-job", "--name", "W",
+            "--type", "MOVIE", "--source", "http",
+            "--uri", f"{base}/m.mkv", "--wait",
+        ])
+        assert rc == 0
+        enc = base64.b64encode(b"m.mkv").decode()
+        assert s3.buckets["triton-staging"][f"wait-job/original/{enc}"] == payload
+        await orchestrator.shutdown(grace_seconds=10)
+    finally:
+        await media.cleanup()
+        await s3.stop()
+        await amqp.stop()
