@@ -51,8 +51,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="magnet:, http(s)://, file://, or bucket:// URI")
     submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
     submit.add_argument("--wait", action="store_true",
-                        help="tap telemetry and block until the job "
-                             "reaches 100%% or errors")
+                        help="tap telemetry and block until the job's "
+                             "Convert message confirms completion")
+    submit.add_argument("--wait-timeout", type=float, default=600.0,
+                        help="seconds before --wait gives up (exit 124; "
+                             "stall-dropped jobs emit no terminal event)")
 
     mk = sub.add_parser("mktorrent", help="build a .torrent from a path")
     mk.add_argument("path", help="file or directory to seed")
@@ -125,16 +128,35 @@ async def _submit(args) -> int:
         await mq.close()
 
 
-async def _submit_and_wait(mq, args, msg) -> int:
-    """Publish, then tap telemetry until the job finishes or errors.
-
-    The tap is bound BEFORE the publish so no event can be missed."""
+async def _bind_telemetry_taps(mq, on_status, on_progress) -> None:
+    """Bind exclusive tap queues to the telemetry fanout exchanges and
+    start consuming them — copies of every event, without stealing
+    deliveries from the real telemetry consumers."""
     import os
 
     from .platform.telemetry import PROGRESS_EXCHANGE, STATUS_EXCHANGE
 
+    tap = os.urandom(4).hex()
+    status_q = f"v1.telemetry.tap.{tap}.status"
+    progress_q = f"v1.telemetry.tap.{tap}.progress"
+    await mq.bind_queue(status_q, STATUS_EXCHANGE, exclusive=True)
+    await mq.bind_queue(progress_q, PROGRESS_EXCHANGE, exclusive=True)
+    await mq.listen(status_q, on_status)
+    await mq.listen(progress_q, on_progress)
+
+
+async def _submit_and_wait(mq, args, msg) -> int:
+    """Publish, then follow the job until its Convert message appears.
+
+    Taps are bound BEFORE the publish so no event can be missed.  The
+    Convert message is the only true completion signal: it is published
+    after the done marker, and ERRORED statuses are informational (the
+    job is redelivered and may still succeed).  Jobs the service drops
+    via the stall policy emit no terminal event at all, so the wait is
+    bounded by --wait-timeout (exit 124)."""
+    import os
+
     errored = schemas.TelemetryStatus.Value("ERRORED")
-    outcome: dict = {}
     done = asyncio.Event()
 
     async def on_status(delivery):
@@ -143,43 +165,45 @@ async def _submit_and_wait(mq, args, msg) -> int:
         if event.media_id != args.id:
             return
         name = schemas.TelemetryStatus.Name(event.status)
-        print(f"{args.id}\tstatus\t{name}", flush=True)
-        if event.status == errored:
-            outcome["failed"] = True
-            done.set()
+        suffix = "\t(will retry)" if event.status == errored else ""
+        print(f"{args.id}\tstatus\t{name}{suffix}", flush=True)
 
     async def on_progress(delivery):
         event = schemas.decode(schemas.TelemetryProgressEvent, delivery.body)
         await delivery.ack()
-        if event.media_id != args.id:
-            return
-        print(f"{args.id}\tprogress\t{event.percent}%", flush=True)
-        if event.percent >= 100:
+        if event.media_id == args.id:
+            print(f"{args.id}\tprogress\t{event.percent}%", flush=True)
+
+    async def on_convert(delivery):
+        event = schemas.decode(schemas.Convert, delivery.body)
+        await delivery.ack()
+        if event.media.id == args.id:
             done.set()
 
-    tap = os.urandom(4).hex()
-    await mq.bind_queue(f"v1.telemetry.tap.{tap}.status",
-                        STATUS_EXCHANGE, exclusive=True)
-    await mq.bind_queue(f"v1.telemetry.tap.{tap}.progress",
-                        PROGRESS_EXCHANGE, exclusive=True)
-    await mq.listen(f"v1.telemetry.tap.{tap}.status", on_status)
-    await mq.listen(f"v1.telemetry.tap.{tap}.progress", on_progress)
+    await _bind_telemetry_taps(mq, on_status, on_progress)
+    convert_tap = f"v1.convert.tap.{os.urandom(4).hex()}"
+    await mq.bind_queue(convert_tap, schemas.CONVERT_EXCHANGE,
+                        exclusive=True)
+    await mq.listen(convert_tap, on_convert)
 
     await mq.publish(args.queue, schemas.encode(msg))
     print(f"submitted {args.id} -> {args.queue}", flush=True)
-    await done.wait()
-    if outcome.get("failed"):
-        print(f"{args.id} ERRORED", file=sys.stderr)
-        return 1
-    print(f"{args.id} staged")
+    try:
+        async with asyncio.timeout(args.wait_timeout):
+            await done.wait()
+    except TimeoutError:
+        print(f"{args.id}: no completion within {args.wait_timeout:.0f}s "
+              "(stall-dropped jobs emit no terminal event)",
+              file=sys.stderr)
+        return 124
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        return 130
+    print(f"{args.id} staged (Convert published)")
     return 0
 
 
 async def _watch(args) -> int:
-    import os
-
     from .mq import new_queue, resolve_backend
-    from .platform.telemetry import PROGRESS_EXCHANGE, STATUS_EXCHANGE
 
     config = load_config("converter")
     logger = get_logger("downloader-cli")
@@ -221,16 +245,7 @@ async def _watch(args) -> int:
     mq = new_queue(config, logger=logger)
     await mq.connect()
     try:
-        # tap queues bound to the telemetry fanout exchanges: we receive
-        # COPIES of every event without stealing deliveries from the real
-        # telemetry consumers on the canonical work queues
-        tap = os.urandom(4).hex()
-        status_q = f"v1.telemetry.tap.{tap}.status"
-        progress_q = f"v1.telemetry.tap.{tap}.progress"
-        await mq.bind_queue(status_q, STATUS_EXCHANGE, exclusive=True)
-        await mq.bind_queue(progress_q, PROGRESS_EXCHANGE, exclusive=True)
-        await mq.listen(status_q, on_status)
-        await mq.listen(progress_q, on_progress)
+        await _bind_telemetry_taps(mq, on_status, on_progress)
         try:
             await done.wait()
         except (KeyboardInterrupt, asyncio.CancelledError):

@@ -97,6 +97,17 @@ class Orchestrator:
         """Connect and begin consuming (reference lib/main.js:47,172)."""
         await self.mq.connect()
         await self.telemetry.connect()
+        # route Convert through a fanout exchange bound to the canonical
+        # queue where the backend supports it: the downstream converter
+        # consumes the same queue as before, and observers (submit --wait)
+        # can tap completion events without stealing deliveries
+        try:
+            await self.mq.bind_queue(
+                schemas.CONVERT_QUEUE, schemas.CONVERT_EXCHANGE
+            )
+            self._convert_fanout = True
+        except NotImplementedError:
+            self._convert_fanout = False
         await self.mq.listen(
             schemas.DOWNLOAD_QUEUE, self.processor, prefetch=self.prefetch
         )
@@ -271,7 +282,14 @@ class Orchestrator:
         # (reference lib/main.js:153-167)
         payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
         try:
-            await self.mq.publish(schemas.CONVERT_QUEUE, schemas.encode(payload))
+            if getattr(self, "_convert_fanout", False):
+                await self.mq.publish_exchange(
+                    schemas.CONVERT_EXCHANGE, schemas.encode(payload)
+                )
+            else:
+                await self.mq.publish(
+                    schemas.CONVERT_QUEUE, schemas.encode(payload)
+                )
             if self.metrics is not None:
                 self.metrics.messages_published.labels(
                     queue=schemas.CONVERT_QUEUE

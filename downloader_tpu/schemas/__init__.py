@@ -30,6 +30,10 @@ from .downloader_pb2 import (  # noqa: F401  (re-exported)
 # Queue names (reference lib/main.js:164,172).
 DOWNLOAD_QUEUE = "v1.download"
 CONVERT_QUEUE = "v1.convert"
+# fanout exchange feeding CONVERT_QUEUE (when the backend supports
+# exchanges), so observers — e.g. `cli submit --wait` — can see job
+# completion without stealing the converter's deliveries
+CONVERT_EXCHANGE = CONVERT_QUEUE + ".fanout"
 
 _MESSAGE_TYPES = {
     "downloader.Download": Download,

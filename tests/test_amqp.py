@@ -604,6 +604,7 @@ def _self_signed_cert(tmp_path):
     """Generate a self-signed localhost cert (cryptography lib)."""
     import datetime
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
