@@ -82,6 +82,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     scrape.add_argument("torrent", help=".torrent file path")
 
+    status = sub.add_parser(
+        "status", help="query a running service's /health and key metrics"
+    )
+    status.add_argument("--url", default="http://127.0.0.1:3401",
+                        help="service base URL (default local health port)")
+
     watch = sub.add_parser(
         "watch", help="tail job status/progress telemetry from the queue"
     )
@@ -202,6 +208,33 @@ async def _submit_and_wait(mq, args, msg) -> int:
     return 0
 
 
+async def _status(args) -> int:
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    async with aiohttp.ClientSession() as session:
+        try:
+            async with session.get(f"{base}/health") as resp:
+                health = await resp.json()
+                # reference parity: an idle worker answers 500
+                busy = resp.status == 200
+        except aiohttp.ClientError as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+        print(f"health: {'busy' if busy else 'idle'} {health}")
+        async with session.get(f"{base}/metrics") as resp:
+            text = await resp.text()
+    wanted = ("jobs_consumed_total", "jobs_completed_total",
+              "jobs_failed_total", "jobs_skipped_total", "jobs_active",
+              "bytes_downloaded_total", "bytes_uploaded_total")
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        if any(key in line for key in wanted):
+            print(line)
+    return 0
+
+
 async def _watch(args) -> int:
     from .mq import new_queue, resolve_backend
 
@@ -317,6 +350,8 @@ def main(argv=None) -> int:
         return _magnet(args)
     if args.command == "scrape":
         return asyncio.run(_scrape(args))
+    if args.command == "status":
+        return asyncio.run(_status(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
     raise AssertionError("unreachable")

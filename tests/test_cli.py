@@ -172,3 +172,40 @@ async def test_cli_scrape(tmp_path, capsys):
         assert "seeders=1" in capsys.readouterr().out
     finally:
         await tracker.stop()
+
+
+async def test_cli_status_against_live_service(tmp_path, capsys):
+    from downloader_tpu.health import start_server
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform import metrics as prom
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    metrics = prom.new("downloader")
+    orch = Orchestrator(
+        config=ConfigNode({"instance": {"download_path": str(tmp_path)}}),
+        mq=MemoryQueue(broker), store=None,
+        telemetry=Telemetry(telem_mq), metrics=metrics, logger=NullLogger(),
+    )
+    runner = await start_server(orch, metrics=metrics, port=0)
+    port = runner.addresses[0][1]
+    try:
+        rc = await asyncio.to_thread(
+            cli.main, ["status", "--url", f"http://127.0.0.1:{port}"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "health: idle" in out
+        assert "downloader_jobs_consumed_total" in out
+
+        rc = await asyncio.to_thread(
+            cli.main, ["status", "--url", "http://127.0.0.1:1"]
+        )
+        assert rc == 2
+    finally:
+        await runner.cleanup()
