@@ -212,18 +212,19 @@ async def _status(args) -> int:
     import aiohttp
 
     base = args.url.rstrip("/")
-    async with aiohttp.ClientSession() as session:
+    timeout = aiohttp.ClientTimeout(total=10)  # diagnostics must not hang
+    async with aiohttp.ClientSession(timeout=timeout) as session:
         try:
             async with session.get(f"{base}/health") as resp:
                 health = await resp.json()
                 # reference parity: an idle worker answers 500
                 busy = resp.status == 200
-        except aiohttp.ClientError as err:
+            print(f"health: {'busy' if busy else 'idle'} {health}")
+            async with session.get(f"{base}/metrics") as resp:
+                text = await resp.text()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
             print(f"{base}: unreachable ({err})", file=sys.stderr)
             return 2
-        print(f"health: {'busy' if busy else 'idle'} {health}")
-        async with session.get(f"{base}/metrics") as resp:
-            text = await resp.text()
     wanted = ("jobs_consumed_total", "jobs_completed_total",
               "jobs_failed_total", "jobs_skipped_total", "jobs_active",
               "bytes_downloaded_total", "bytes_uploaded_total")
