@@ -6,14 +6,19 @@ protobuf ``api.Download`` messages onto ``v1.download``
 by hand.  This closes that gap:
 
     python -m downloader_tpu.cli submit --id my-movie --name "My Movie" \
-        --type MOVIE --source http --uri http://host/movie.mkv
+        --type MOVIE --source http --uri http://host/movie.mkv [--wait]
     python -m downloader_tpu.cli mktorrent /path/to/media \
         --tracker http://tracker:8000/announce --out media.torrent
     python -m downloader_tpu.cli magnet media.torrent
+    python -m downloader_tpu.cli scrape media.torrent
+    python -m downloader_tpu.cli status [--url http://host:3401]
+    python -m downloader_tpu.cli watch [--id my-movie]
 
-``submit`` publishes to the queue backend named in config (AMQP in
-production; refuses the in-memory backend, which cannot reach a running
-service in another process).
+``submit``/``watch`` talk to the queue backend named in config (AMQP in
+production; they refuse the in-memory backend, which cannot reach a
+running service in another process).  ``--wait`` and ``watch`` tap the
+fanout exchanges, so they observe without stealing deliveries from the
+service's real consumers.
 """
 
 from __future__ import annotations
