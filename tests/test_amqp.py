@@ -680,3 +680,73 @@ async def test_amqps_tls_roundtrip(tmp_path):
     finally:
         await mq.close()
         await server.stop()
+
+
+async def test_convert_tap_does_not_steal_from_converter(server, tmp_path):
+    """Convert fanout: the downstream converter's queue consumer AND a
+    completion observer each receive the Convert message."""
+    from helpers import start_media_server
+    from downloader_tpu import schemas
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import InMemoryObjectStore
+    from test_orchestrator import make_download_msg
+
+    payload = b"V" * 50_000
+    runner, base = await start_media_server(payload)
+    mq = AmqpQueue(server.url, heartbeat=0)
+    telem_mq = AmqpQueue(server.url, heartbeat=0)
+    telem = Telemetry(telem_mq)
+    await telem.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode(
+            {"instance": {"download_path": str(tmp_path / "dl")}}
+        ),
+        mq=mq, store=InMemoryObjectStore(), telemetry=telem,
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+
+    converter = AmqpQueue(server.url, heartbeat=0)
+    await converter.connect()
+    observer = AmqpQueue(server.url, heartbeat=0)
+    await observer.connect()
+    got_converter: list = []
+    got_observer: list = []
+    both = asyncio.Event()
+
+    def _check():
+        if got_converter and got_observer:
+            both.set()
+
+    async def on_converter(delivery):
+        got_converter.append(delivery.body)
+        await delivery.ack()
+        _check()
+
+    async def on_observer(delivery):
+        got_observer.append(delivery.body)
+        await delivery.ack()
+        _check()
+
+    try:
+        await converter.listen(schemas.CONVERT_QUEUE, on_converter)
+        await observer.bind_queue("convert.tap.test",
+                                  schemas.CONVERT_EXCHANGE, exclusive=True)
+        await observer.listen("convert.tap.test", on_observer)
+
+        await mq.publish(schemas.DOWNLOAD_QUEUE,
+                         make_download_msg(f"{base}/show.mkv"))
+        async with asyncio.timeout(20):
+            await both.wait()
+        assert len(got_converter) == 1 and len(got_observer) == 1
+        assert got_converter[0] == got_observer[0]
+        msg = schemas.decode(schemas.Convert, got_converter[0])
+        assert msg.media.id == "job-1"
+    finally:
+        await converter.close()
+        await observer.close()
+        await orchestrator.shutdown(grace_seconds=10)
+        await runner.cleanup()
