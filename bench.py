@@ -27,8 +27,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# Self-baseline (MB/s) from the round-1 measurement; see BASELINE.md.
-SELF_BASELINE_MBPS = 500.0
+# Harness version: bump when the measurement harness itself changes so
+# cross-round comparisons stay apples-to-apples (BASELINE.md).
+HARNESS_VERSION = 2
+
+# Self-baseline (MB/s): the round-1 number measured with THIS harness
+# version (sendfile fixture server, best-of-5) — BENCH_r01.json.
+SELF_BASELINE_MBPS = 678.8
 
 JOBS = int(os.environ.get("BENCH_JOBS", 8))
 MIB_PER_JOB = int(os.environ.get("BENCH_MIB_PER_JOB", 32))
@@ -129,46 +134,71 @@ import json, time
 import jax
 import jax.numpy as jnp
 from downloader_tpu.compute.models.upscaler import UpscalerConfig, init_params
+from downloader_tpu.compute.pipeline import (
+    device_peak_tflops, upscaler_flops_per_frame,
+)
 
 config = UpscalerConfig()
 rng = jax.random.PRNGKey(0)
-frames = jax.random.uniform(rng, (16, 180, 320, 3), jnp.float32)
-model, params = init_params(rng, config, sample_shape=frames.shape)
+model, params = init_params(rng, config, sample_shape=(1, 32, 32, 3))
 
-ITERS = 20
 
-def rollout(p, x0):
+def measure(batch, h, w, iters, reps=3):
     # the whole dependent iteration chain runs ON DEVICE via lax.scan: one
-    # dispatch instead of ITERS round-trips (over a tunneled TPU each
+    # dispatch instead of iters round-trips (over a tunneled TPU each
     # dispatch costs ~1s of RPC latency, which is NOT chip throughput).
     # Each step feeds the downsampled output back in, so steps stay
     # sequentially dependent and cannot be overlapped.
-    def step(x, _):
-        out = model.apply(p, x)
-        return (out[:, ::2, ::2, :].astype(x0.dtype),
-                jnp.sum(out.astype(jnp.float32)))
-    final, sums = jax.lax.scan(step, x0, None, length=ITERS)
-    # reduce to a scalar on device: fetching 4 bytes forces the full
-    # computation without timing a multi-MB transfer over the tunnel
-    # (block_until_ready is unreliable on the tunneled backend)
-    return jnp.sum(sums) + jnp.sum(final)
+    frames = jax.random.uniform(rng, (batch, h, w, 3), jnp.float32)
 
-fn = jax.jit(rollout)
-jax.device_get(fn(params, frames))  # compile + first run
-best = None
-for _ in range(3):
-    start = time.monotonic()
-    jax.device_get(fn(params, frames))
-    dt = time.monotonic() - start
-    best = dt if best is None else min(best, dt)
-print(json.dumps({
-    "backend": jax.default_backend(),
-    "upscaler_fps_180p_to_360p": frames.shape[0] * ITERS / best,
-}))
+    def rollout(p, x0):
+        def step(x, _):
+            out = model.apply(p, x)
+            return (out[:, ::2, ::2, :].astype(x0.dtype),
+                    jnp.sum(out.astype(jnp.float32)))
+        final, sums = jax.lax.scan(step, x0, None, length=iters)
+        # reduce to a scalar on device: fetching 4 bytes forces the full
+        # computation without timing a multi-MB transfer over the tunnel
+        # (block_until_ready is unreliable on the tunneled backend)
+        return jnp.sum(sums) + jnp.sum(final)
+
+    fn = jax.jit(rollout)
+    jax.device_get(fn(params, frames))  # compile + first run
+    best = None
+    for _ in range(reps):
+        start = time.monotonic()
+        jax.device_get(fn(params, frames))
+        dt = time.monotonic() - start
+        best = dt if best is None else min(best, dt)
+    return batch * iters / best
+
+
+out = {"backend": jax.default_backend()}
+# r01-comparable shape (180p -> 360p, 16-frame batch)
+out["upscaler_fps_180p_to_360p"] = measure(16, 180, 320, 20)
+
+# MFU at a realistic shape: 8 x 720p bf16 frames -> 1440p.  The flops
+# model counts conv MACs x2 (the MXU work) only; peak is the chip's
+# published dense-bf16 number, so mfu is the honest fraction-of-peak.
+fps_720 = measure(8, 720, 1280, 10)
+flop_per_frame = upscaler_flops_per_frame(config, 720, 1280)
+tflops = fps_720 * flop_per_frame / 1e12
+device_kind = jax.devices()[0].device_kind
+peak = device_peak_tflops(device_kind)
+out.update({
+    "upscaler_fps_720p_to_1440p": fps_720,
+    "frame_shape": [8, 720, 1280, 3],
+    "flop_per_frame": flop_per_frame,
+    "tflops": round(tflops, 2),
+    "device_kind": device_kind,
+    "peak_tflops": peak,
+    "mfu": round(tflops / peak, 4) if peak else None,
+})
+print(json.dumps(out))
 """
 
 
-def bench_compute(timeout_s: float = 240.0):
+def bench_compute(timeout_s: float = 420.0):
     """Secondary: upscaler throughput on the available accelerator.
 
     Runs in a subprocess with a hard timeout — a wedged TPU runtime (e.g.
@@ -239,6 +269,7 @@ def _bench_torrent_safe() -> dict:
 def main() -> None:
     pipeline = asyncio.run(bench_pipeline())
     extra = {
+        "harness_version": HARNESS_VERSION,
         "jobs_per_min": round(pipeline["jobs_per_min"], 1),
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,

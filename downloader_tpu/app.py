@@ -51,6 +51,17 @@ def build_service(config=None, broker=None, store=None):
 
     store = store if store is not None else new_client(config)
 
+    # config-gated TPU compute stage: insert ``upscale`` between process
+    # and upload (the reference has no compute stage; its downstream
+    # converter does the transform — see stages/upscale.py)
+    from .stages.base import STAGES
+    from .stages.upscale import upscale_enabled
+
+    stages = list(STAGES)
+    if upscale_enabled(config):
+        stages.insert(stages.index("upload"), "upscale")
+        logger.info("upscale stage enabled", stages=stages)
+
     orchestrator = Orchestrator(
         config=config,
         mq=mq,
@@ -59,6 +70,7 @@ def build_service(config=None, broker=None, store=None):
         metrics=metrics,
         tracer=tracer,
         logger=logger,
+        stages=stages,
     )
     return orchestrator, metrics, telemetry
 

@@ -1,0 +1,79 @@
+"""YCbCr <-> RGB conversion and chroma resampling, all jittable.
+
+The upscale stage feeds planar YCbCr straight off a Y4M stream to the
+device and gets planar YCbCr back: colorspace conversion, chroma
+up/downsampling, the model forward, and the quantize tail are ONE XLA
+computation, so no intermediate RGB frame ever round-trips HBM (let alone
+the host).  That fusion is the point of doing the conversion in jnp
+instead of on the CPU.
+
+Coefficients are BT.601 full-range (the JPEG/Y4M ``C420jpeg`` convention):
+    Y  =  0.299 R + 0.587 G + 0.114 B
+    Cb = -0.168736 R - 0.331264 G + 0.5 B        + 128
+    Cr =  0.5 R - 0.418688 G - 0.081312 B        + 128
+and the exact inverse.  Everything operates in the 0..255 float domain on
+(B, H, W) planes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# forward (RGB -> YCbCr) matrix, rows = (Y, Cb, Cr)
+_RGB2YCC = jnp.array(
+    [
+        [0.299, 0.587, 0.114],
+        [-0.168736, -0.331264, 0.5],
+        [0.5, -0.418688, -0.081312],
+    ],
+    dtype=jnp.float32,
+)
+
+# inverse (YCbCr -> RGB) matrix, rows = (R, G, B), applied to (Y, Cb-128, Cr-128)
+_YCC2RGB = jnp.array(
+    [
+        [1.0, 0.0, 1.402],
+        [1.0, -0.344136, -0.714136],
+        [1.0, 1.772, 0.0],
+    ],
+    dtype=jnp.float32,
+)
+
+
+def ycbcr_to_rgb(y: jax.Array, cb: jax.Array, cr: jax.Array) -> jax.Array:
+    """Full-res (B, H, W) float planes in 0..255 -> (B, H, W, 3) RGB 0..255."""
+    ycc = jnp.stack([y, cb - 128.0, cr - 128.0], axis=-1)
+    return ycc @ _YCC2RGB.T
+
+
+def rgb_to_ycbcr(rgb: jax.Array):
+    """(B, H, W, 3) RGB 0..255 -> three (B, H, W) float planes in 0..255."""
+    ycc = rgb @ _RGB2YCC.T
+    y = ycc[..., 0]
+    cb = ycc[..., 1] + 128.0
+    cr = ycc[..., 2] + 128.0
+    return y, cb, cr
+
+
+def upsample_chroma(plane: jax.Array, sub_h: int, sub_w: int) -> jax.Array:
+    """(B, H/sub_h, W/sub_w) -> (B, H, W) by nearest-neighbor repeat.
+
+    ``jnp.repeat`` with a static count lowers to a broadcast-reshape that
+    XLA folds into the consuming matmul/conv — no gather, no copy.
+    """
+    if sub_h > 1:
+        plane = jnp.repeat(plane, sub_h, axis=1)
+    if sub_w > 1:
+        plane = jnp.repeat(plane, sub_w, axis=2)
+    return plane
+
+
+def downsample_chroma(plane: jax.Array, sub_h: int, sub_w: int) -> jax.Array:
+    """(B, H, W) -> (B, H/sub_h, W/sub_w) by box (mean) filter — the
+    standard siting-agnostic decimation for re-encoding subsampled chroma."""
+    if sub_h == 1 and sub_w == 1:
+        return plane
+    b, h, w = plane.shape
+    plane = plane.reshape(b, h // sub_h, sub_h, w // sub_w, sub_w)
+    return plane.mean(axis=(2, 4))

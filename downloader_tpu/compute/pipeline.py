@@ -1,0 +1,210 @@
+"""Batched frame-upscaling engine: planar YCbCr in, planar YCbCr out.
+
+This is the compute half of the ``upscale`` pipeline stage
+(:mod:`downloader_tpu.stages.upscale`).  Design, TPU-first:
+
+- ONE jitted computation per frame geometry covers chroma upsample ->
+  YCbCr->RGB -> model forward (bf16 convs on the MXU) -> RGB->YCbCr ->
+  chroma downsample -> quantize to uint8.  Host<->device traffic is
+  exactly the uint8 planes in and out; every intermediate stays in HBM
+  and XLA fuses the elementwise colorspace math into the convs.
+- Static shapes only: frames are batched to a fixed ``batch`` size and
+  the final short batch is zero-padded (then sliced on the host), so one
+  compilation serves the whole stream.
+- Multi-device: the batch dim is sharded over a 1-axis ``data`` mesh
+  (pure data parallelism — inference has no gradient collectives), the
+  params are replicated, and XLA partitions the convs.  The same code
+  runs single-chip when only one device exists.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .models.upscaler import Upscaler, UpscalerConfig
+from .ops.colorspace import (
+    downsample_chroma,
+    rgb_to_ycbcr,
+    upsample_chroma,
+    ycbcr_to_rgb,
+)
+from .ops.pixel_shuffle import quantize_u8
+from .video import Y4MReader, Y4MWriter
+
+
+class FrameUpscaler:
+    """Holds params + compiled geometry-keyed upscale functions."""
+
+    def __init__(
+        self,
+        config: UpscalerConfig = UpscalerConfig(),
+        batch: int = 8,
+        checkpoint_dir: Optional[str] = None,
+        use_mesh: bool = True,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self.config = config
+        self.model = Upscaler(config)
+
+        rng = jax.random.PRNGKey(seed)
+        # fully-convolutional: params are geometry-independent
+        self.params = self.model.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32))
+        if checkpoint_dir is not None:
+            from .checkpoint import restore_state
+
+            # the upscale stage only needs params; a zero-size opt-state
+            # placeholder keeps restore_state's contract
+            import optax
+
+            opt_like = optax.adam(1e-3).init(self.params)
+            _step, self.params, _opt = restore_state(
+                checkpoint_dir, self.params, opt_like
+            )
+
+        devices = jax.devices()
+        self.n_devices = len(devices) if use_mesh else 1
+        # static batch: round the requested size up to a multiple of the
+        # data-axis size so every device gets equal shards
+        self.batch = max(1, -(-batch // self.n_devices) * self.n_devices)
+        if self.n_devices > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            self._mesh = Mesh(np.array(devices), axis_names=("data",))
+            self._plane_sharding = NamedSharding(self._mesh, P("data", None, None))
+            self._replicated = NamedSharding(self._mesh, P())
+            self.params = jax.device_put(self.params, self._replicated)
+        else:
+            self._mesh = None
+            self._plane_sharding = None
+
+    # ------------------------------------------------------------------
+    @functools.lru_cache(maxsize=8)
+    def _compiled(self, sub_h: int, sub_w: int):
+        """Jitted (params, y, cb, cr) -> (y', cb', cr') for one chroma
+        sampling; geometry specializes at trace time via the arg shapes."""
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+
+        def fn(params, y, cb, cr):
+            yf = y.astype(jnp.float32)
+            cbf = upsample_chroma(cb.astype(jnp.float32), sub_h, sub_w)
+            crf = upsample_chroma(cr.astype(jnp.float32), sub_h, sub_w)
+            rgb = ycbcr_to_rgb(yf, cbf, crf) / 255.0
+            out = model.apply(params, rgb)
+            y2, cb2, cr2 = rgb_to_ycbcr(out.astype(jnp.float32) * 255.0)
+            cb2 = downsample_chroma(cb2, sub_h, sub_w)
+            cr2 = downsample_chroma(cr2, sub_h, sub_w)
+            return quantize_u8(y2), quantize_u8(cb2), quantize_u8(cr2)
+
+        return jax.jit(fn)
+
+    def _place(self, arr: np.ndarray):
+        if self._plane_sharding is not None:
+            return self._jax.device_put(arr, self._plane_sharding)
+        return arr
+
+    # ------------------------------------------------------------------
+    def upscale_batch(
+        self,
+        y: np.ndarray,
+        cb: np.ndarray,
+        cr: np.ndarray,
+        sub_h: int,
+        sub_w: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Upscale (n, H, W)/(n, ch, cw) uint8 planes; n <= self.batch.
+
+        Pads n up to the static batch, runs the compiled fn, slices back.
+        """
+        n = y.shape[0]
+        pad = self.batch - n
+        if pad:
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], np.uint8)])
+            cb = np.concatenate([cb, np.zeros((pad,) + cb.shape[1:], np.uint8)])
+            cr = np.concatenate([cr, np.zeros((pad,) + cr.shape[1:], np.uint8)])
+        fn = self._compiled(sub_h, sub_w)
+        y2, cb2, cr2 = fn(self.params, self._place(y), self._place(cb), self._place(cr))
+        return (
+            np.asarray(y2)[:n],
+            np.asarray(cb2)[:n],
+            np.asarray(cr2)[:n],
+        )
+
+    def upscale_y4m(self, src_path: str, dst_path: str) -> int:
+        """Upscale a Y4M file; returns the number of frames written."""
+        with open(src_path, "rb") as src, open(dst_path, "wb") as dst:
+            reader = Y4MReader(src)
+            hdr = reader.header
+            writer = Y4MWriter(dst, hdr.scaled(self.config.scale))
+            sub_h, sub_w = hdr.subsampling
+            frames = 0
+            for y, cb, cr in _batched(iter(reader), self.batch):
+                y2, cb2, cr2 = self.upscale_batch(y, cb, cr, sub_h, sub_w)
+                for i in range(y2.shape[0]):
+                    writer.write_frame(y2[i], cb2[i], cr2[i])
+                frames += y2.shape[0]
+        return frames
+
+
+def _batched(
+    frames: Iterable[Tuple[np.ndarray, np.ndarray, np.ndarray]], batch: int
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    ys, cbs, crs = [], [], []
+    for y, cb, cr in frames:
+        ys.append(y)
+        cbs.append(cb)
+        crs.append(cr)
+        if len(ys) == batch:
+            yield np.stack(ys), np.stack(cbs), np.stack(crs)
+            ys, cbs, crs = [], [], []
+    if ys:
+        yield np.stack(ys), np.stack(cbs), np.stack(crs)
+
+
+# ----------------------------------------------------------------------
+# FLOPs accounting (for MFU reporting in bench.py)
+
+def upscaler_flops_per_frame(config: UpscalerConfig, height: int, width: int) -> int:
+    """Matmul-equivalent FLOPs of one forward pass on one (H, W) frame.
+
+    Counts conv MACs x2 (the MXU work); elementwise adds/relus and the
+    colorspace math are bandwidth, not FLOPs, and are excluded per the
+    usual MFU convention.
+    """
+    f = config.features
+    pixels = height * width
+    stem = 2 * pixels * 5 * 5 * config.channels * f
+    body = (config.depth - 1) * 2 * pixels * 3 * 3 * f * f
+    head = 2 * pixels * 3 * 3 * f * (config.channels * config.scale**2)
+    return stem + body + head
+
+
+# bf16 peak TFLOP/s per JAX device, by device_kind substring (dense, no
+# sparsity).  Public numbers from cloud.google.com/tpu/docs: v2/v3 are
+# per-core (JAX exposes cores as devices there), v4+ per chip.
+_TPU_PEAKS = [
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 61.5),
+    ("v2", 22.5),
+]
+
+
+def device_peak_tflops(device_kind: str) -> Optional[float]:
+    kind = device_kind.lower()
+    for tag, peak in _TPU_PEAKS:
+        if tag in kind:
+            return peak
+    return None

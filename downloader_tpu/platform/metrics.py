@@ -80,6 +80,11 @@ class Metrics:
             ["queue"],
             registry=self.registry,
         )
+        self.frames_upscaled = Counter(
+            f"{ns}_frames_upscaled_total",
+            "Video frames run through the upscale stage's TPU model",
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",

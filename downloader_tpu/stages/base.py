@@ -70,6 +70,9 @@ _REGISTRY: Dict[str, str] = {
     "download": "downloader_tpu.stages.download",
     "process": "downloader_tpu.stages.process",
     "upload": "downloader_tpu.stages.upload",
+    # built-in but not in the default STAGES order: config-gated via
+    # ``instance.upscale.enabled`` (see app.py / stages/upscale.py)
+    "upscale": "downloader_tpu.stages.upscale",
 }
 
 

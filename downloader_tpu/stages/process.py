@@ -68,7 +68,8 @@ def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
     return bool(_SEASON_RE.search(name))
 
 
-def find_media_files(root: str, media: schemas.Media, logger) -> List[str]:
+def find_media_files(root: str, media: schemas.Media, logger,
+                     exts=MEDIA_EXTS) -> List[str]:
     """Depth-first walk honoring the filter; returns kept file paths.
 
     (reference ``findMediaFiles``, lib/process.js:29-99 — klaw walk with a
@@ -93,7 +94,7 @@ def find_media_files(root: str, media: schemas.Media, logger) -> List[str]:
                     logger.warn(f"skipping directory '{rel}'")
             else:
                 ext = os.path.splitext(entry.name)[1]
-                if ext in MEDIA_EXTS:
+                if ext in exts:
                     logger.info(f"including file '{rel}'")
                     files.append(entry.path)
                 else:
@@ -106,6 +107,13 @@ def find_media_files(root: str, media: schemas.Media, logger) -> List[str]:
 async def stage_factory(ctx: StageContext) -> StageFn:
     logger = ctx.logger
 
+    # config-gated divergence: with the upscale stage enabled, raw .y4m
+    # streams (what a decode front-end emits) count as media too.  The
+    # parity default stays the reference's exact whitelist.
+    from .upscale import upscale_enabled
+
+    exts = MEDIA_EXTS | {".y4m"} if upscale_enabled(ctx.config) else MEDIA_EXTS
+
     async def process(job: Job):
         last = job.last_stage
         download_path = last["path"] if isinstance(last, dict) else last.path
@@ -113,7 +121,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         with ctx.tracer.span("stage.process", path=download_path):
             found = await asyncio.to_thread(
-                find_media_files, download_path, job.media, logger
+                find_media_files, download_path, job.media, logger, exts
             )
 
         if len(found) == 0:

@@ -1,0 +1,157 @@
+"""Upscale stage: run staged media frames through the TPU super-resolution
+model between ``process`` and ``upload``.
+
+The reference pipeline has no compute stage — its downstream "converter"
+service does the media transform (/root/reference/lib/main.js:157-167
+just hands the job over).  This stage is the config-gated, in-pipeline
+version of that converter workload: decoded frames go through the
+:class:`~downloader_tpu.compute.pipeline.FrameUpscaler` (bf16 convs on
+the MXU, batch sharded over the device mesh) and the upscaled stream
+replaces the original in the upload set.
+
+Gating and scope:
+
+- Enabled only when ``config.instance.upscale.enabled`` is true; the
+  default pipeline stays byte-for-byte reference-parity
+  (download -> process -> upload).
+- Only raw Y4M streams are transformed (sniffed by content magic, not
+  extension — see :func:`~downloader_tpu.compute.video.sniff_y4m`).
+  Compressed containers pass through untouched: decoding them needs a
+  codec stack (ffmpeg) that a production deployment would run as a
+  decode front-end piping y4m into this stage.
+- The engine (params + compiled functions + device mesh) is memoized in
+  ``ctx.resources`` so every job in the process shares one compilation
+  cache and one copy of the params in HBM.
+
+Stage contract: consumes ``{files, downloadPath}`` from process
+(lib/process.js:117-120 shape), returns the same shape with upscaled
+paths substituted, so ``upload`` runs unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+
+from .base import Job, StageContext, StageFn
+
+_ENGINE_KEY = "upscale.engine"
+_ENGINE_LOCK = threading.Lock()  # _get_engine runs in worker threads
+
+
+def _engine_config(config):
+    """Read ``instance.upscale.*`` with safe defaults."""
+    instance = config.get("instance") if hasattr(config, "get") else None
+    upscale = instance.get("upscale") if instance is not None else None
+
+    def opt(key, default):
+        if upscale is None:
+            return default
+        value = upscale.get(key, default)
+        return default if value is None else value
+
+    return {
+        "scale": int(opt("scale", 2)),
+        "features": int(opt("features", 128)),
+        "depth": int(opt("depth", 4)),
+        "batch": int(opt("batch", 8)),
+        "checkpoint": opt("checkpoint", None),
+        "use_mesh": bool(opt("use_mesh", True)),
+    }
+
+
+def upscale_enabled(config) -> bool:
+    """True when ``instance.upscale.enabled`` is set (app.py gating)."""
+    try:
+        instance = config.get("instance")
+        upscale = instance.get("upscale") if instance is not None else None
+        return bool(upscale.get("enabled", False)) if upscale is not None else False
+    except AttributeError:
+        return False
+
+
+def _get_engine(ctx: StageContext):
+    """Build (once per process) the shared FrameUpscaler."""
+    with _ENGINE_LOCK:  # concurrent jobs must share one engine/params copy
+        engine = ctx.resources.get(_ENGINE_KEY)
+        if engine is None:
+            from ..compute.models.upscaler import UpscalerConfig
+            from ..compute.pipeline import FrameUpscaler
+
+            opts = _engine_config(ctx.config)
+            engine = FrameUpscaler(
+                config=UpscalerConfig(
+                    scale=opts["scale"],
+                    features=opts["features"],
+                    depth=opts["depth"],
+                ),
+                batch=opts["batch"],
+                checkpoint_dir=opts["checkpoint"],
+                use_mesh=opts["use_mesh"],
+            )
+            ctx.resources[_ENGINE_KEY] = engine
+    return engine
+
+
+async def stage_factory(ctx: StageContext) -> StageFn:
+    logger = ctx.logger
+
+    async def upscale(job: Job):
+        from ..compute.video import sniff_y4m
+
+        last = job.last_stage
+        files = last["files"] if isinstance(last, dict) else last.files
+        download_path = (
+            last["downloadPath"] if isinstance(last, dict) else last.downloadPath
+        )
+
+        out_files = []
+        with ctx.tracer.span("stage.upscale", files=len(files)):
+            for path in files:
+                header = sniff_y4m(path)
+                if header is None:
+                    logger.info(
+                        "passing through non-y4m media", path=os.path.basename(path)
+                    )
+                    out_files.append(path)
+                    continue
+                # engine construction does JAX backend init + model init —
+                # seconds even when healthy, and a wedged device tunnel
+                # hangs PJRT init — so it must not block the event loop
+                # any more than the per-file device work below does
+                engine = await asyncio.to_thread(_get_engine, ctx)
+                stem, ext = os.path.splitext(path)
+                dst = f"{stem}.{engine.config.scale}x{ext}"
+                logger.info(
+                    "upscaling",
+                    path=os.path.basename(path),
+                    size=f"{header.width}x{header.height}",
+                    scale=engine.config.scale,
+                )
+                try:
+                    # the device work holds the GIL only between dispatches;
+                    # running in a thread keeps heartbeats/telemetry flowing
+                    frames = await asyncio.to_thread(
+                        engine.upscale_y4m, path, dst
+                    )
+                except BaseException:
+                    # a partial .y4m output would be picked up as media by
+                    # the redelivered job's process walk — remove it
+                    try:
+                        os.unlink(dst)
+                    except OSError:
+                        pass
+                    raise
+                logger.info(
+                    "upscaled", path=os.path.basename(dst), frames=frames
+                )
+                if ctx.metrics is not None and hasattr(
+                    ctx.metrics, "frames_upscaled"
+                ):
+                    ctx.metrics.frames_upscaled.inc(frames)
+                out_files.append(dst)
+
+        return {"files": out_files, "downloadPath": download_path}
+
+    return upscale

@@ -1,0 +1,351 @@
+"""Upscale stage: colorspace math, Y4M IO, the device engine, the stage
+contract, and the full pipeline with the stage enabled on the virtual
+8-device CPU mesh (conftest forces JAX_PLATFORMS=cpu x8)."""
+
+import asyncio
+import base64
+import io
+import os
+
+import numpy as np
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.compute.video import (
+    Y4MError,
+    Y4MHeader,
+    Y4MReader,
+    Y4MWriter,
+    parse_header,
+    sniff_y4m,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def make_y4m(width, height, frames, colorspace="420jpeg", fps=(30, 1)) -> bytes:
+    """Deterministic y4m stream: per-frame gradient planes."""
+    hdr = Y4MHeader(
+        width=width, height=height, fps_num=fps[0], fps_den=fps[1],
+        colorspace=colorspace,
+    )
+    ch, cw = hdr.chroma_shape
+    buf = io.BytesIO()
+    writer = Y4MWriter(buf, hdr)
+    for i in range(frames):
+        y = ((np.arange(height * width).reshape(height, width) + i * 7) % 256)
+        u = np.full((ch, cw), (64 + i) % 256)
+        v = np.full((ch, cw), (192 - i) % 256)
+        writer.write_frame(
+            y.astype(np.uint8), u.astype(np.uint8), v.astype(np.uint8)
+        )
+    return buf.getvalue()
+
+
+# ----------------------------------------------------------------- colorspace
+
+def test_colorspace_roundtrip():
+    from downloader_tpu.compute.ops.colorspace import rgb_to_ycbcr, ycbcr_to_rgb
+
+    rng = np.random.default_rng(0)
+    rgb = rng.integers(0, 256, size=(2, 8, 8, 3)).astype(np.float32)
+    y, cb, cr = rgb_to_ycbcr(rgb)
+    back = np.asarray(ycbcr_to_rgb(y, cb, cr))
+    assert np.max(np.abs(back - rgb)) < 1e-2
+
+
+def test_chroma_up_down_roundtrip():
+    from downloader_tpu.compute.ops.colorspace import (
+        downsample_chroma,
+        upsample_chroma,
+    )
+
+    rng = np.random.default_rng(1)
+    small = rng.uniform(0, 255, size=(1, 4, 6)).astype(np.float32)
+    up = np.asarray(upsample_chroma(small, 2, 2))
+    assert up.shape == (1, 8, 12)
+    # nearest-neighbor then box mean is exact
+    down = np.asarray(downsample_chroma(up, 2, 2))
+    assert np.allclose(down, small, atol=1e-4)
+
+
+# ------------------------------------------------------------------- y4m io
+
+@pytest.mark.parametrize("colorspace", ["420jpeg", "420", "422", "444"])
+def test_y4m_roundtrip(colorspace):
+    data = make_y4m(16, 12, frames=3, colorspace=colorspace)
+    reader = Y4MReader(io.BytesIO(data))
+    assert reader.header.width == 16
+    assert reader.header.height == 12
+    assert reader.header.fps_num == 30
+    assert reader.header.colorspace == colorspace
+    frames = list(reader)
+    assert len(frames) == 3
+    ch, cw = reader.header.chroma_shape
+    for y, u, v in frames:
+        assert y.shape == (12, 16)
+        assert u.shape == (ch, cw)
+    # re-encode must be byte-identical
+    buf = io.BytesIO()
+    writer = Y4MWriter(buf, reader.header)
+    for y, u, v in frames:
+        writer.write_frame(y, u, v)
+    assert buf.getvalue() == data
+
+
+def test_y4m_header_errors():
+    with pytest.raises(Y4MError):
+        parse_header(b"NOTY4M W2 H2\n")
+    with pytest.raises(Y4MError):
+        parse_header(b"YUV4MPEG2 F25:1\n")  # missing W/H
+    with pytest.raises(Y4MError):
+        parse_header(b"YUV4MPEG2 W4 H4 C411\n")  # unsupported sampling
+    with pytest.raises(Y4MError):
+        parse_header(b"YUV4MPEG2 W5 H4 C420jpeg\n")  # odd width for 420
+
+
+def test_y4m_truncated_frame():
+    data = make_y4m(8, 8, frames=2)
+    reader = Y4MReader(io.BytesIO(data[:-10]))
+    with pytest.raises(Y4MError, match="truncated"):
+        list(reader)
+
+
+def test_y4m_bad_frame_marker():
+    hdr = Y4MHeader(width=4, height=4).encode()
+    reader = Y4MReader(io.BytesIO(hdr + b"JUNK\n" + b"\0" * 24))
+    with pytest.raises(Y4MError, match="FRAME"):
+        list(reader)
+
+
+def test_sniff_y4m(tmp_path):
+    good = tmp_path / "a.mkv"  # magic matters, extension doesn't
+    good.write_bytes(make_y4m(8, 8, frames=1))
+    bad = tmp_path / "b.mkv"
+    bad.write_bytes(os.urandom(256))
+    header = sniff_y4m(str(good))
+    assert header is not None and header.width == 8
+    assert sniff_y4m(str(bad)) is None
+    assert sniff_y4m(str(tmp_path / "missing.mkv")) is None
+
+
+# ------------------------------------------------------------------- engine
+
+def _tiny_engine(batch=4):
+    from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    return FrameUpscaler(
+        config=UpscalerConfig(features=8, depth=2), batch=batch
+    )
+
+
+def test_frame_upscaler_doubles_dimensions(tmp_path):
+    src = tmp_path / "clip.y4m"
+    # 5 frames with batch 4 exercises the zero-padded final batch
+    src.write_bytes(make_y4m(16, 12, frames=5))
+    dst = tmp_path / "clip.2x.y4m"
+
+    engine = _tiny_engine(batch=4)
+    n = engine.upscale_y4m(str(src), str(dst))
+    assert n == 5
+
+    reader = Y4MReader(open(dst, "rb"))
+    assert reader.header.width == 32
+    assert reader.header.height == 24
+    assert reader.header.fps_num == 30  # frame rate carried through
+    assert reader.header.colorspace == "420jpeg"
+    frames = list(reader)
+    assert len(frames) == 5
+    assert frames[0][0].dtype == np.uint8
+
+
+def test_frame_upscaler_shards_over_mesh(tmp_path):
+    import jax
+
+    engine = _tiny_engine(batch=4)
+    # conftest forces an 8-device CPU topology; the engine must adopt it
+    # and round the batch up to a multiple of the data axis
+    assert engine.n_devices == len(jax.devices()) == 8
+    assert engine.batch % engine.n_devices == 0
+
+
+def test_flops_model_and_peaks():
+    from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.pipeline import (
+        device_peak_tflops,
+        upscaler_flops_per_frame,
+    )
+
+    cfg = UpscalerConfig(features=128, depth=4, scale=2)
+    flops = upscaler_flops_per_frame(cfg, 720, 1280)
+    # stem + 3 residual body convs + subpixel head at 720p is ~0.86 TFLOP
+    assert 8e11 < flops < 9e11
+    assert device_peak_tflops("TPU v5e") == 197.0
+    assert device_peak_tflops("TPU v5 lite") == 197.0
+    assert device_peak_tflops("cpu") is None
+
+
+# -------------------------------------------------------------------- stage
+
+def _upscale_config(tmp_path, enabled=True):
+    from downloader_tpu.platform.config import ConfigNode
+
+    return ConfigNode({
+        "instance": {
+            "download_path": str(tmp_path / "dl"),
+            "upscale": {
+                "enabled": enabled, "features": 8, "depth": 2, "batch": 4,
+            },
+        },
+    })
+
+
+async def test_stage_transforms_y4m_and_passes_through(tmp_path):
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    raw = tmp_path / "movie.mkv"
+    raw.write_bytes(os.urandom(1024))
+    clip = tmp_path / "clip.y4m"
+    clip.write_bytes(make_y4m(16, 12, frames=3))
+
+    ctx = StageContext(
+        config=_upscale_config(tmp_path),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    media = schemas.Media(id="j1", type=schemas.MediaType.Value("MOVIE"))
+
+    job = Job(media=media, last_stage={
+        "files": [str(raw), str(clip)], "downloadPath": str(tmp_path),
+    })
+    result = await table["upscale"](job)
+
+    assert result["downloadPath"] == str(tmp_path)
+    assert result["files"][0] == str(raw)  # binary passes through untouched
+    upscaled = result["files"][1]
+    assert upscaled.endswith("clip.2x.y4m")
+    header = sniff_y4m(upscaled)
+    assert header.width == 32 and header.height == 24
+
+    # engine is memoized across jobs in the shared resources
+    engine = ctx.resources["upscale.engine"]
+    await table["upscale"](job)
+    assert ctx.resources["upscale.engine"] is engine
+
+
+async def test_stage_removes_partial_output_on_decode_error(tmp_path):
+    """A y4m with an intact header but truncated payload must fail the
+    stage WITHOUT leaving a partial .2x output that a redelivered job's
+    process walk would pick up as media."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    clip = tmp_path / "clip.y4m"
+    clip.write_bytes(make_y4m(16, 12, frames=3)[:-10])
+
+    ctx = StageContext(
+        config=_upscale_config(tmp_path),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="j2", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(clip)], "downloadPath": str(tmp_path)},
+    )
+    with pytest.raises(Y4MError, match="truncated"):
+        await table["upscale"](job)
+    assert not (tmp_path / "clip.2x.y4m").exists()
+
+
+def test_writer_rejects_bad_cr_plane():
+    hdr = Y4MHeader(width=8, height=8)
+    writer = Y4MWriter(io.BytesIO(), hdr)
+    y = np.zeros((8, 8), np.uint8)
+    good = np.zeros((4, 4), np.uint8)
+    with pytest.raises(Y4MError, match="planes"):
+        writer.write_frame(y, good, np.zeros((8, 8), np.uint8))
+
+
+def test_upscale_enabled_gating(tmp_path):
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.stages.upscale import upscale_enabled
+
+    assert upscale_enabled(_upscale_config(tmp_path))
+    assert not upscale_enabled(_upscale_config(tmp_path, enabled=False))
+    assert not upscale_enabled(ConfigNode({"instance": {}}))
+    assert not upscale_enabled(ConfigNode({}))
+
+
+def test_build_service_inserts_stage(tmp_path):
+    from downloader_tpu.app import build_service
+
+    orchestrator, _m, _t = build_service(_upscale_config(tmp_path))
+    assert orchestrator.stage_names == ["download", "process", "upscale", "upload"]
+
+    from downloader_tpu.platform.config import ConfigNode
+
+    plain, _m2, _t2 = build_service(
+        ConfigNode({"instance": {"download_path": str(tmp_path / "d2")}})
+    )
+    assert plain.stage_names == ["download", "process", "upload"]
+
+
+# -------------------------------------------------- full pipeline, on mesh
+
+async def test_pipeline_end_to_end_with_upscale(tmp_path):
+    """http download of a .y4m -> process (whitelist extended by the gate)
+    -> upscale on the 8-device mesh -> upload; staged object is the
+    upscaled stream."""
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.store import InMemoryObjectStore
+
+    from helpers import start_media_server
+
+    clip = make_y4m(16, 12, frames=5)
+    media_srv, base = await start_media_server(clip, path="/clip.y4m")
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = Orchestrator(
+        config=_upscale_config(tmp_path),
+        mq=MemoryQueue(broker),
+        store=store,
+        logger=NullLogger(),
+        stages=["download", "process", "upscale", "upload"],
+    )
+    await orchestrator.start()
+    try:
+        msg = schemas.Download(
+            media=schemas.Media(
+                id="up-1",
+                creator_id="card-1",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=f"{base}/clip.y4m",
+            )
+        )
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
+
+        converts = broker.published(schemas.CONVERT_QUEUE)
+        assert len(converts) == 1
+
+        name = "up-1/original/" + base64.b64encode(b"clip.2x.y4m").decode()
+        staged = await store.get_object("triton-staging", name)
+        reader = Y4MReader(io.BytesIO(staged))
+        assert reader.header.width == 32 and reader.header.height == 24
+        assert len(list(reader)) == 5
+        await store.get_object("triton-staging", "up-1/original/done")
+
+        engine = orchestrator.stage_resources["upscale.engine"]
+        assert engine.n_devices == 8  # ran sharded over the virtual mesh
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await media_srv.cleanup()
