@@ -34,7 +34,7 @@ def build_service(config=None, broker=None, store=None):
     """
     config = config or load_config("converter")
     logger = get_logger("downloader")
-    tracer = init_tracer("downloader", logger)
+    tracer = init_tracer("downloader", logger, config)
     metrics = prom.new("downloader")
 
     # Queue backend per config: a real AMQP connection pair (one for jobs,
@@ -96,6 +96,8 @@ async def run(config=None) -> None:
     await stop.wait()
     await orchestrator.shutdown()
     await runner.cleanup()
+    # flush any spans still queued for the OTLP collector
+    await asyncio.to_thread(orchestrator.tracer.close)
     logger.info("shutdown complete")
 
 

@@ -1,4 +1,4 @@
-"""HTTP surface: ``GET /health`` + ``GET /metrics``.
+"""HTTP surface: ``GET /health`` + ``/livez`` + ``/readyz`` + ``/metrics``.
 
 ``/health`` has behavioral parity with /root/reference/lib/main.js:174-194,
 including the reference's deliberate inverted semantics: a worker with zero
@@ -6,7 +6,15 @@ active jobs answers 500 ``Not Running Jobs`` (it is expected to always be
 busy); otherwise 200 with ``{metadata: {success, host}, data: {active}}``.
 Because the orchestrator here actually removes finished jobs (the reference's
 ``slice`` bug made ``activeJobs`` grow forever, lib/main.js:169), the
-endpoint is now truthful.
+endpoint is now truthful — which makes it operationally wrong as a k8s
+probe: an idle-but-healthy worker would be restarted.  So:
+
+- ``/livez`` — 200 whenever the process can answer (liveness probe).
+- ``/readyz`` — 200 while the orchestrator is connected and consuming,
+  503 before start / after shutdown begins (readiness probe).
+- ``health.sane: true`` in config flips ``/health`` itself to sane
+  semantics (200 when idle, with the same payload shape); the default
+  stays reference parity.
 
 ``/metrics`` exposes the Prometheus registry (reference ``Prom.expose()``,
 lib/main.js:44).
@@ -23,6 +31,7 @@ from typing import Optional
 from aiohttp import web
 
 from .orchestrator import Orchestrator
+from .platform.config import cfg_get
 from .platform.metrics import Metrics
 
 DEFAULT_PORT = 3401
@@ -30,23 +39,39 @@ DEFAULT_PORT = 3401
 
 def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> web.Application:
     app = web.Application()
+    sane = bool(
+        cfg_get(getattr(orchestrator, "config", None), "health.sane", False)
+    )
+
+    def _payload(active: int) -> dict:
+        return {
+            "metadata": {"success": True, "host": socket.gethostname()},
+            "data": {"active": active},
+        }
 
     async def health(_request: web.Request) -> web.Response:
         active = len(orchestrator.active_jobs)
-        if active == 0:
+        if active == 0 and not sane:
             return web.json_response({"message": "Not Running Jobs"}, status=500)
-        return web.json_response(
-            {
-                "metadata": {"success": True, "host": socket.gethostname()},
-                "data": {"active": active},
-            }
-        )
+        return web.json_response(_payload(active))
+
+    async def livez(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def readyz(_request: web.Request) -> web.Response:
+        if orchestrator.consuming:
+            return web.json_response(
+                {"status": "ready", "active": len(orchestrator.active_jobs)}
+            )
+        return web.json_response({"status": "not consuming"}, status=503)
 
     async def prom(_request: web.Request) -> web.Response:
         body = metrics.render() if metrics is not None else b""
         return web.Response(body=body, content_type="text/plain")
 
     app.router.add_get("/health", health)
+    app.router.add_get("/livez", livez)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", prom)
     return app
 

@@ -61,7 +61,7 @@ class Orchestrator:
         tracer: Optional[Tracer] = None,
         logger: Optional[Logger] = None,
         stages: Optional[List[str]] = None,
-        prefetch: int = 1,
+        prefetch: int = 2,
         poison_threshold: int = 5,
     ):
         self.config = config
@@ -72,6 +72,14 @@ class Orchestrator:
         self.tracer = tracer or NullTracer()
         self.logger = logger or get_logger("orchestrator")
         self.stage_names = stages or list(STAGES)
+        # Default 2 resolves BASELINE.md's ``new AMQP(addr, 1, 2, prom)``
+        # question (lib/main.js:46): triton-core's AMQP signature is
+        # (host, connections, prefetch, prom) — one connection (we likewise
+        # hold one job connection; telemetry rides its own, app.py), and a
+        # consumer prefetch of 2: up to two deliveries in flight, processed
+        # CONCURRENTLY (both backends dispatch one handler task per
+        # delivery), matching the reference's async consumer behavior under
+        # the same qos.  See PARITY.md "AMQP constructor constants".
         self.prefetch = prefetch
 
         # (reference EmitterTable / activeJobs, lib/main.js:26,34)
@@ -92,6 +100,10 @@ class Orchestrator:
         self.poison_threshold = poison_threshold
         self._failure_counts: Dict[str, int] = {}
 
+        # readiness: True between a successful start() and shutdown()
+        # (surfaced by /readyz, health.py)
+        self.consuming = False
+
     # ------------------------------------------------------------------
     async def start(self) -> None:
         """Connect and begin consuming (reference lib/main.js:47,172)."""
@@ -111,6 +123,7 @@ class Orchestrator:
         await self.mq.listen(
             schemas.DOWNLOAD_QUEUE, self.processor, prefetch=self.prefetch
         )
+        self.consuming = True
         self.logger.info("successfully connected to queue")
 
     async def shutdown(self, grace_seconds: float = 30.0) -> None:
@@ -120,6 +133,7 @@ class Orchestrator:
         are active (lib/main.js:197-204); here we stop pulling new work
         first, then actually drain the in-flight jobs.
         """
+        self.consuming = False
         await self.mq.stop_consuming()
         try:
             async with asyncio.timeout(grace_seconds):
@@ -228,7 +242,11 @@ class Orchestrator:
                             self.metrics.stage_seconds.labels(stage=name).observe(
                                 time.monotonic() - started
                             )
-                    emitter.emit("progress", 0)
+                    # NOTE: the reference emits ``emitter.emit('progress', 0)``
+                    # here (lib/main.js:139) but no listener exists in either
+                    # codebase, and forwarding a hardcoded 0 to telemetry
+                    # would reset real stage progress — deliberately dropped
+                    # (PARITY.md "Reference bugs fixed").
             except Exception as err:
                 logger.error("failed to invoke stage", error=str(err))
 

@@ -106,6 +106,22 @@ def load_config(service: str = "converter", path: Optional[str] = None) -> Confi
     return ConfigNode(_deep_merge(DEFAULTS, data))
 
 
+def cfg_get(config, path: str, default: Any = None) -> Any:
+    """Safe nested lookup: ``cfg_get(config, "health.sane", False)``.
+
+    Tolerates a None/dict-less config, missing intermediate sections, and
+    explicit None values (which fall back to ``default``).  The one place
+    config-gated features resolve their keys, instead of each hand-rolling
+    the try/except ladder.
+    """
+    node = config
+    for key in path.split("."):
+        if node is None or not hasattr(node, "get"):
+            return default
+        node = node.get(key)
+    return default if node is None else node
+
+
 def dyn(name: str, config: Optional[ConfigNode] = None) -> str:
     """Service-discovery: resolve a service name to an address.
 

@@ -5,8 +5,14 @@ imports the opentracing symbols (/root/reference/lib/main.js:20) but never
 creates a span — SURVEY.md §5 flags tracing as "plumbed-but-unused" and the
 build plan (§7 step 7) says to wire it for real.  This module is a small
 OpenTracing-style tracer: nested spans with tags and timings, kept in an
-in-memory buffer and optionally exported as JSON lines for offline analysis
-(no Jaeger agent required).
+in-memory buffer, optionally exported as JSON lines for offline analysis,
+and — the production path — shipped to any OpenTelemetry collector over
+OTLP/HTTP JSON (:class:`OtlpExporter`; Jaeger ingests OTLP natively since
+1.35, so this supersedes the reference's jaeger-thrift wire).
+
+Configuration: ``tracing.otlp_endpoint`` in the service YAML or
+``$OTLP_ENDPOINT`` (e.g. ``http://localhost:4318``).  Spans are batched in
+a background thread; a down collector never blocks or fails the pipeline.
 """
 
 from __future__ import annotations
@@ -15,8 +21,11 @@ import contextlib
 import contextvars
 import json
 import os
+import queue
 import threading
 import time
+import urllib.error
+import urllib.request
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -35,7 +44,8 @@ class Span:
                  parent: Optional["Span"] = None, **tags: Any):
         self.tracer = tracer
         self.name = name
-        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
+        # W3C/OTLP sizes: 16-byte trace id, 8-byte span id (hex)
+        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex
         self.span_id = uuid.uuid4().hex[:16]
         self.parent_id = parent.span_id if parent else None
         self.start = time.time()
@@ -71,14 +81,129 @@ class Span:
         }
 
 
+def _otlp_attr(key: str, value: Any) -> dict:
+    """One OTLP KeyValue; non-primitive values stringify."""
+    if isinstance(value, bool):
+        body: dict = {"boolValue": value}
+    elif isinstance(value, int):
+        body = {"intValue": str(value)}
+    elif isinstance(value, float):
+        body = {"doubleValue": value}
+    else:
+        body = {"stringValue": str(value)}
+    return {"key": key, "value": body}
+
+
+def span_to_otlp(span: "Span") -> dict:
+    """One finished span in OTLP/JSON (opentelemetry-proto mapping)."""
+    out = {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(span.start * 1e9)),
+        "endTimeUnixNano": str(int((span.end or span.start) * 1e9)),
+        "attributes": [_otlp_attr(k, v) for k, v in span.tags.items()],
+    }
+    if span.parent_id:
+        out["parentSpanId"] = span.parent_id
+    if span.error:
+        out["status"] = {"code": 2, "message": span.error}  # STATUS_CODE_ERROR
+    return out
+
+
+class OtlpExporter:
+    """Ships finished spans to an OTLP/HTTP collector in the background.
+
+    Batches up to ``max_batch`` spans every ``interval`` seconds and POSTs
+    them to ``<endpoint>/v1/traces`` as OTLP JSON.  Export failures are
+    counted and dropped — tracing must never block or fail the pipeline.
+    """
+
+    def __init__(self, endpoint: str, service: str,
+                 interval: float = 2.0, max_batch: int = 512,
+                 max_queue: int = 8192, timeout: float = 5.0):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service = service
+        self.interval = interval
+        self.max_batch = max_batch
+        self.timeout = timeout
+        self.dropped = 0
+        self.exported = 0
+        self.errors = 0
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(max_queue)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, span: "Span") -> None:
+        try:
+            self._queue.put_nowait(span_to_otlp(span))
+        except queue.Full:
+            self.dropped += 1
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed.is_set() or not self._queue.empty():
+            batch: List[dict] = []
+            deadline = time.monotonic() + self.interval
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if self._closed.is_set():
+                    remaining = 0.0
+                try:
+                    item = self._queue.get(timeout=max(remaining, 0.01))
+                except queue.Empty:
+                    break
+                if item is None:
+                    break
+                batch.append(item)
+            if batch:
+                self._post(batch)
+
+    def _post(self, batch: List[dict]) -> None:
+        payload = json.dumps({
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": [_otlp_attr("service.name", self.service)],
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "downloader_tpu"},
+                    "spans": batch,
+                }],
+            }]
+        }).encode("utf-8")
+        request = urllib.request.Request(
+            self.url, data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=self.timeout):
+                self.exported += len(batch)
+        except (urllib.error.URLError, OSError, ValueError):
+            self.errors += 1
+            self.dropped += len(batch)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Flush remaining spans and stop the exporter thread."""
+        self._closed.set()
+        self._queue.put(None)  # wake the worker
+        self._thread.join(timeout)
+
+
 class Tracer:
     """Span factory + buffer.  ``export_path`` (or ``$TRACE_EXPORT``) appends
-    each finished span as one JSON line."""
+    each finished span as one JSON line; ``exporter`` (an
+    :class:`OtlpExporter`) ships spans to a collector."""
 
     def __init__(self, service: str, export_path: Optional[str] = None,
-                 max_buffer: int = 10_000):
+                 max_buffer: int = 10_000,
+                 exporter: Optional[OtlpExporter] = None):
         self.service = service
         self.export_path = export_path or os.environ.get("TRACE_EXPORT")
+        self.exporter = exporter
         self.finished: List[Span] = []
         self._max_buffer = max_buffer
         self._lock = threading.Lock()
@@ -102,10 +227,17 @@ class Tracer:
             self.finished.append(span)
             if len(self.finished) > self._max_buffer:
                 del self.finished[: len(self.finished) - self._max_buffer]
+        if self.exporter is not None:
+            self.exporter.enqueue(span)
         if self.export_path:
             line = json.dumps({"service": self.service, **span.to_dict()})
             with self._lock, open(self.export_path, "a", encoding="utf-8") as fh:
                 fh.write(line + "\n")
+
+    def close(self) -> None:
+        """Flush the OTLP exporter, if any."""
+        if self.exporter is not None:
+            self.exporter.close()
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -122,9 +254,23 @@ class NullTracer(Tracer):
         pass
 
 
-def init_tracer(service: str, logger=None) -> Tracer:
-    """(reference ``Tracer('downloader', logger)``, index.js:15)"""
-    tracer = Tracer(service)
+def init_tracer(service: str, logger=None, config=None) -> Tracer:
+    """(reference ``Tracer('downloader', logger)``, index.js:15)
+
+    Resolution for the OTLP endpoint: ``$OTLP_ENDPOINT`` env, then the
+    ``tracing.otlp_endpoint`` config key.  Absent both, spans stay in the
+    in-process buffer (and the optional JSONL file) only.
+    """
+    from .config import cfg_get
+
+    endpoint = os.environ.get("OTLP_ENDPOINT") or cfg_get(
+        config, "tracing.otlp_endpoint"
+    )
+    exporter = OtlpExporter(endpoint, service) if endpoint else None
+    tracer = Tracer(service, exporter=exporter)
     if logger is not None:
-        logger.debug("tracer initialized", service=service)
+        logger.debug(
+            "tracer initialized", service=service,
+            otlp=endpoint or "disabled",
+        )
     return tracer

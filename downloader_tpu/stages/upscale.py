@@ -42,14 +42,10 @@ _ENGINE_LOCK = threading.Lock()  # _get_engine runs in worker threads
 
 def _engine_config(config):
     """Read ``instance.upscale.*`` with safe defaults."""
-    instance = config.get("instance") if hasattr(config, "get") else None
-    upscale = instance.get("upscale") if instance is not None else None
+    from ..platform.config import cfg_get
 
     def opt(key, default):
-        if upscale is None:
-            return default
-        value = upscale.get(key, default)
-        return default if value is None else value
+        return cfg_get(config, f"instance.upscale.{key}", default)
 
     return {
         "scale": int(opt("scale", 2)),
@@ -63,12 +59,9 @@ def _engine_config(config):
 
 def upscale_enabled(config) -> bool:
     """True when ``instance.upscale.enabled`` is set (app.py gating)."""
-    try:
-        instance = config.get("instance")
-        upscale = instance.get("upscale") if instance is not None else None
-        return bool(upscale.get("enabled", False)) if upscale is not None else False
-    except AttributeError:
-        return False
+    from ..platform.config import cfg_get
+
+    return bool(cfg_get(config, "instance.upscale.enabled", False))
 
 
 def _get_engine(ctx: StageContext):

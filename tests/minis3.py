@@ -9,7 +9,6 @@ signing is exercised for real.
 
 from __future__ import annotations
 
-import datetime
 import hashlib
 import hmac
 import urllib.parse

@@ -12,27 +12,42 @@ pytestmark = pytest.mark.anyio
 
 
 class FakeOrchestrator:
-    def __init__(self):
+    def __init__(self, config=None):
         self.active_jobs = []
+        self.consuming = False
+        self.config = config
 
 
 @pytest.fixture
-async def client():
-    orchestrator = FakeOrchestrator()
-    metrics = prom.new("healthtest")
-    app = build_app(orchestrator, metrics)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
-
+async def make_client():
+    """Factory: serve build_app for a FakeOrchestrator (optionally with a
+    config) and hand back (session, base_url, orchestrator, metrics)."""
     import aiohttp
 
-    session = aiohttp.ClientSession()
-    yield session, f"http://127.0.0.1:{port}", orchestrator, metrics
-    await session.close()
-    await runner.cleanup()
+    cleanups = []
+
+    async def _make(config=None):
+        orchestrator = FakeOrchestrator(config)
+        metrics = prom.new("healthtest")
+        app = build_app(orchestrator, metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        session = aiohttp.ClientSession()
+        cleanups.append((session, runner))
+        return session, f"http://127.0.0.1:{port}", orchestrator, metrics
+
+    yield _make
+    for session, runner in cleanups:
+        await session.close()
+        await runner.cleanup()
+
+
+@pytest.fixture
+async def client(make_client):
+    return await make_client()
 
 
 async def test_health_idle_is_500(client):
@@ -62,3 +77,60 @@ async def test_metrics_exposition(client):
         assert resp.status == 200
         text = await resp.text()
         assert "healthtest_jobs_consumed_total 1.0" in text
+
+
+async def test_livez_always_ok(client):
+    session, base, _orch, _m = client
+    async with session.get(f"{base}/livez") as resp:
+        assert resp.status == 200
+        assert (await resp.json()) == {"status": "ok"}
+
+
+async def test_readyz_tracks_consuming(client):
+    session, base, orchestrator, _m = client
+    async with session.get(f"{base}/readyz") as resp:
+        assert resp.status == 503  # not started yet
+    orchestrator.consuming = True
+    orchestrator.active_jobs.append({"jobId": "j1"})
+    async with session.get(f"{base}/readyz") as resp:
+        assert resp.status == 200
+        body = await resp.json()
+        assert body == {"status": "ready", "active": 1}
+    orchestrator.consuming = False  # shutdown began
+    async with session.get(f"{base}/readyz") as resp:
+        assert resp.status == 503
+
+
+async def test_sane_health_flag_flips_idle_to_200(make_client):
+    """health.sane: true makes /health a usable k8s probe; the inverted
+    reference semantics stay the default (lib/main.js:177-181)."""
+    from downloader_tpu.platform.config import ConfigNode
+
+    session, base, _orch, _m = await make_client(
+        ConfigNode({"health": {"sane": True}})
+    )
+    async with session.get(f"{base}/health") as resp:
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["data"]["active"] == 0
+
+
+async def test_orchestrator_consuming_lifecycle(tmp_path):
+    """The real orchestrator flips `consuming` across start/shutdown."""
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.store import InMemoryObjectStore
+
+    orchestrator = Orchestrator(
+        config=ConfigNode({"instance": {"download_path": str(tmp_path)}}),
+        mq=MemoryQueue(InMemoryBroker()),
+        store=InMemoryObjectStore(),
+        logger=NullLogger(),
+    )
+    assert not orchestrator.consuming
+    await orchestrator.start()
+    assert orchestrator.consuming
+    await orchestrator.shutdown(grace_seconds=1)
+    assert not orchestrator.consuming

@@ -1,10 +1,19 @@
 """Lint-as-test: static checks over the package, run as a test suite.
 
 Capability-equivalent to the reference's mocha-eslint suite
-(/root/reference/test/eslint.js, SURVEY.md §2 component 7), implemented with
-the stdlib ``ast`` module (no linter dependencies in the image): every
-module must parse, carry no unused imports, no bare ``except:``, no tabs,
-and no ``print()`` in library code (structured logging only).
+(/root/reference/test/eslint.js, SURVEY.md §2 component 7).  ruff/flake8
+are not in the image and installs are off-limits, so the checks are
+implemented with the stdlib ``ast`` module, covering the highest-value
+subset of the eslint-standard/ruff defect classes: parse errors, unused
+imports (F401), bare ``except:`` (E722), tabs, ``print()`` in library
+code, mutable default arguments (B006), f-strings without placeholders
+(F541), ``== None/True/False`` comparisons (E711/E712), ``is`` against
+literals (F632), ``raise NotImplemented`` (F901), same-scope function
+redefinition (F811), and fire-and-forget ``create_task`` calls whose
+task object is discarded (asyncio GC hazard, RUF006).
+
+Tests are linted too (parse/imports/except/tabs/defaults), matching the
+reference suite's ``test/**`` coverage.
 """
 
 import ast
@@ -14,6 +23,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "downloader_tpu")
+TESTS = os.path.join(REPO, "tests")
 
 
 def _module_files():
@@ -23,6 +33,9 @@ def _module_files():
         for filename in filenames:
             if filename.endswith(".py") and not filename.endswith("_pb2.py"):
                 out.append(os.path.join(dirpath, filename))
+    for filename in sorted(os.listdir(TESTS)):
+        if filename.endswith(".py"):
+            out.append(os.path.join(TESTS, filename))
     out.append(os.path.join(REPO, "bench.py"))
     out.append(os.path.join(REPO, "__graft_entry__.py"))
     return sorted(out)
@@ -83,7 +96,7 @@ def test_module_lints_clean(path):
         if name not in referenced
         and name not in explicit_exports
         and not name.startswith("_")
-        and f"# noqa" not in source.splitlines()[line - 1]
+        and "# noqa" not in source.splitlines()[line - 1]
     ]
     assert not unused, f"{path}: unused imports: {unused}"
 
@@ -91,8 +104,12 @@ def test_module_lints_clean(path):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             pytest.fail(f"{path}:{node.lineno}: bare 'except:'")
 
-    # library code logs, it doesn't print (bench/graft entry/cli are CLIs)
-    if not path.endswith(("bench.py", "__graft_entry__.py", "/cli.py")):
+    # library code logs, it doesn't print (bench/graft entry/cli are CLIs,
+    # tests may print)
+    in_tests = os.sep + "tests" + os.sep in path
+    if not in_tests and not path.endswith(
+        ("bench.py", "__graft_entry__.py", "/cli.py")
+    ):
         for node in ast.walk(tree):
             if (
                 isinstance(node, ast.Call)
@@ -100,3 +117,88 @@ def test_module_lints_clean(path):
                 and node.func.id == "print"
             ):
                 pytest.fail(f"{path}:{node.lineno}: print() in library code")
+
+    problems = []
+
+    def flag(node, message):
+        problems.append(f"{path}:{node.lineno}: {message}")
+
+    # format specs (f"{x:.2f}") are themselves JoinedStr nodes with no
+    # FormattedValue parts — not user-facing f-strings, don't F541 them
+    format_specs = {
+        id(node.format_spec)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
+    }
+
+    for node in ast.walk(tree):
+        # B006: mutable default arguments
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in [*node.args.defaults, *node.args.kw_defaults]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in {"list", "dict", "set"}
+                ):
+                    flag(node, f"mutable default argument in {node.name}()")
+
+        # F541: f-string without placeholders
+        if (
+            isinstance(node, ast.JoinedStr)
+            and id(node) not in format_specs
+            and not any(
+                isinstance(part, ast.FormattedValue) for part in node.values
+            )
+        ):
+            flag(node, "f-string without placeholders")
+
+        # E711/E712: equality comparison against None/True/False
+        if isinstance(node, ast.Compare):
+            for op, comparator in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(comparator, ast.Constant)
+                    and (comparator.value is None
+                         or comparator.value is True
+                         or comparator.value is False)
+                ):
+                    flag(node, "use is/is not for None/True/False")
+                # F632: identity comparison against a str/number literal
+                if isinstance(op, (ast.Is, ast.IsNot)) and (
+                    isinstance(comparator, ast.Constant)
+                    and isinstance(comparator.value, (str, int, float, bytes))
+                    and not isinstance(comparator.value, bool)
+                ):
+                    flag(node, "'is' comparison against a literal")
+
+        # F901: raise NotImplemented (the constant, not the error)
+        if isinstance(node, ast.Raise):
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name) and exc.id == "NotImplemented":
+                flag(node, "raise NotImplementedError, not NotImplemented")
+
+        # RUF006: create_task result discarded -> task can be GC'd mid-run
+        if (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "create_task"
+        ):
+            flag(node, "create_task() result discarded (task may be GC'd)")
+
+    # F811: function redefined in the same scope (decorated defs like
+    # @property setters / dispatch registrations are legitimate)
+    for scope in ast.walk(tree):
+        if not isinstance(scope, (ast.Module, ast.ClassDef,
+                                  ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        seen = {}
+        for stmt in getattr(scope, "body", []):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not stmt.decorator_list and stmt.name in seen:
+                    flag(stmt, f"redefinition of {stmt.name}() "
+                               f"(first at line {seen[stmt.name]})")
+                seen.setdefault(stmt.name, stmt.lineno)
+
+    assert not problems, "\n".join(problems)

@@ -5,7 +5,7 @@ import os
 
 import pytest
 
-from downloader_tpu.mq import InMemoryBroker
+
 from downloader_tpu.store import ObjectNotFound
 from downloader_tpu.store.s3 import S3ObjectStore
 

@@ -2,7 +2,6 @@
 contract, and the full pipeline with the stage enabled on the virtual
 8-device CPU mesh (conftest forces JAX_PLATFORMS=cpu x8)."""
 
-import asyncio
 import base64
 import io
 import os
