@@ -228,33 +228,40 @@ def bench_compute(timeout_s: float = 420.0):
 
 async def bench_torrent(mib: int = 64) -> dict:
     """Secondary: loopback swarm throughput (seeder -> leeching client,
-    real peer wire protocol, SHA-1 verification, disk on both ends)."""
+    real peer wire protocol, SHA-1 verification, disk on both ends) —
+    plaintext for r01 comparability, plus an MSE/RC4-encrypted run."""
     import tempfile
 
     from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
+    from downloader_tpu.torrent.tracker import Peer
 
-    with tempfile.TemporaryDirectory() as tmp:
-        src_dir = os.path.join(tmp, "seed", "payload")
-        os.makedirs(src_dir)
-        with open(os.path.join(src_dir, "media.mkv"), "wb") as fh:
-            fh.write(os.urandom(mib << 20))
-        meta = make_metainfo(os.path.join(tmp, "seed", "payload"),
-                             piece_length=1 << 20)
-        seeder = Seeder(meta, os.path.join(tmp, "seed"))
-        port = await seeder.start()
-        torrent_path = os.path.join(tmp, "t.torrent")
-        with open(torrent_path, "wb") as fh:
-            fh.write(meta.to_torrent_bytes())
-        from downloader_tpu.torrent.tracker import Peer
+    out = {}
+    for crypto, label, size in (
+        ("plaintext", "torrent_swarm_mbps", mib),
+        ("require", "torrent_swarm_encrypted_mbps", mib // 2),
+    ):
+        with tempfile.TemporaryDirectory() as tmp:
+            src_dir = os.path.join(tmp, "seed", "payload")
+            os.makedirs(src_dir)
+            with open(os.path.join(src_dir, "media.mkv"), "wb") as fh:
+                fh.write(os.urandom(size << 20))
+            meta = make_metainfo(os.path.join(tmp, "seed", "payload"),
+                                 piece_length=1 << 20)
+            seeder = Seeder(meta, os.path.join(tmp, "seed"))
+            port = await seeder.start()
+            torrent_path = os.path.join(tmp, "t.torrent")
+            with open(torrent_path, "wb") as fh:
+                fh.write(meta.to_torrent_bytes())
 
-        started = time.monotonic()
-        await TorrentClient().download(
-            torrent_path, os.path.join(tmp, "dl"),
-            peers=[Peer("127.0.0.1", port)], listen=False,
-        )
-        elapsed = time.monotonic() - started
-        await seeder.stop()
-    return {"torrent_swarm_mbps": round(mib * (1 << 20) / 1e6 / elapsed, 1)}
+            started = time.monotonic()
+            await TorrentClient(crypto=crypto).download(
+                torrent_path, os.path.join(tmp, "dl"),
+                peers=[Peer("127.0.0.1", port)], listen=False,
+            )
+            elapsed = time.monotonic() - started
+            await seeder.stop()
+        out[label] = round(size * (1 << 20) / 1e6 / elapsed, 1)
+    return out
 
 
 def _bench_torrent_safe() -> dict:

@@ -191,8 +191,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         # bittorrent-dht (lib/download.js:19).  Bootstrap routers come from
         # DHT_BOOTSTRAP=host:port,... or config.instance.dht_bootstrap;
         # unset means tracker-only discovery.
+        # MSE/PE mode for outgoing peer connections: TORRENT_CRYPTO env or
+        # config.instance.torrent_crypto — prefer (default) | require |
+        # plaintext.  Incoming (seed-while-leech) always auto-detects.
+        crypto = os.environ.get("TORRENT_CRYPTO") or getattr(
+            ctx.config.instance, "torrent_crypto", None
+        ) or "prefer"
         client = TorrentClient(logger=logger, dht=await _shared_dht(logger),
-                               rate_limiter=limiter)
+                               rate_limiter=limiter, crypto=crypto)
 
         # seed-while-leech: verified pieces are served back to the swarm
         # during the download; SEED_LINGER/config.instance.seed_linger keeps

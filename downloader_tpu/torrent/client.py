@@ -26,6 +26,7 @@ from typing import Awaitable, Callable, Dict, List, Optional, Set
 import aiohttp
 
 from ..utils.watchdog import MetadataTimeoutError, StallWatchdog
+from . import mse
 from . import tracker as tracker_mod
 from . import wire
 from .magnet import parse_magnet
@@ -33,6 +34,17 @@ from .metainfo import BLOCK_SIZE, Metainfo, parse_info_dict, parse_torrent_bytes
 from .storage import TorrentStorage
 
 ProgressCb = Callable[[float], Awaitable[None]]
+
+
+class _MSERejected(Exception):
+    """Internal marker: the MSE exchange itself failed (fallback-eligible).
+
+    Carries the underlying error so "require" mode and exhausted retries
+    re-raise the real cause."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
 
 CONNECT_TIMEOUT = 10.0
 # outstanding 16 KiB requests per peer: 64 = 1 MiB in flight, measured
@@ -172,13 +184,24 @@ class _Swarm:
 
 class TorrentClient:
     def __init__(self, logger=None, peer_id: Optional[bytes] = None,
-                 dht=None, rate_limiter=None):
+                 dht=None, rate_limiter=None, crypto: str = "prefer"):
         """``dht`` is an optional started :class:`~.dht.DHTNode`; when set,
         it is queried as an additional peer source next to trackers (the
         reference's webtorrent does the same via bittorrent-dht,
         /root/reference/lib/download.js:19,64).  ``rate_limiter`` is an
         optional token bucket (``await consume(n)``) charged for every
-        payload byte received from peers and webseeds."""
+        payload byte received from peers and webseeds.
+
+        ``crypto`` controls outgoing MSE/PE obfuscation (the reference's
+        webtorrent transport negotiates the same handshake,
+        lib/download.js:19): ``"prefer"`` (default) attempts the MSE
+        handshake and falls back to plaintext against peers that reject
+        it, ``"require"`` drops peers that won't encrypt, ``"plaintext"``
+        never initiates MSE.  Incoming connections (the seeder) always
+        auto-detect both."""
+        if crypto not in ("plaintext", "prefer", "require"):
+            raise ValueError(f"unknown crypto mode {crypto!r}")
+        self.crypto = crypto
         self.logger = logger
         self.rate_limiter = rate_limiter
         self.peer_id = peer_id or (
@@ -774,10 +797,52 @@ class TorrentClient:
     # -- peer plumbing ---------------------------------------------------
     async def _connect(self, peer_addr, info_hash: bytes,
                        listen_port: Optional[int] = None) -> wire.PeerWire:
+        # MSE/PE: "prefer" tries the encrypted handshake first and retries
+        # plaintext on a fresh connection if the peer rejects it (the
+        # handshake is unrecoverable mid-stream); "require" never falls
+        # back; "plaintext" never initiates.  Only a failure DURING the MSE
+        # exchange triggers the retry — a dead address (TCP connect
+        # failure) or an error after encryption is already up propagates
+        # immediately, so dead peers are not dialed twice and an
+        # encryption-capable peer is never silently downgraded.
+        attempts = {"plaintext": [False], "prefer": [True, False],
+                    "require": [True]}[self.crypto]
+        for use_mse in attempts:
+            last_attempt = use_mse is attempts[-1]
+            try:
+                return await self._connect_once(
+                    peer_addr, info_hash, listen_port, use_mse
+                )
+            except _MSERejected as rejected:
+                if last_attempt:
+                    raise rejected.cause
+                if self.logger is not None:
+                    self.logger.debug(
+                        "MSE handshake rejected; retrying plaintext",
+                        peer=str(peer_addr), error=str(rejected.cause),
+                    )
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    async def _connect_once(self, peer_addr, info_hash: bytes,
+                            listen_port: Optional[int],
+                            use_mse: bool) -> wire.PeerWire:
         async with asyncio.timeout(CONNECT_TIMEOUT):
             reader, writer = await asyncio.open_connection(
                 peer_addr.host, peer_addr.port
             )
+        if use_mse:
+            try:
+                reader, writer, _method = await mse.initiate(
+                    reader, writer, info_hash,
+                    allow_plaintext=self.crypto != "require",
+                )
+            except (mse.MSEError, EOFError, ConnectionError,
+                    TimeoutError) as err:
+                writer.close()
+                raise _MSERejected(err) from err
+            except BaseException:
+                writer.close()
+                raise
         peer = wire.PeerWire(reader, writer)
         try:
             await peer.send_handshake(info_hash, self.peer_id)

@@ -20,7 +20,7 @@ import os
 import struct
 from typing import Dict, Optional, Set, Tuple
 
-from . import wire
+from . import mse, wire
 from .metainfo import Metainfo
 from .storage import TorrentStorage
 
@@ -100,12 +100,39 @@ class Seeder:
         except (ConnectionError, OSError, wire.WireError):
             pass  # dying connection: its serve loop will clean up
 
+    async def _maybe_decrypt(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter):
+        """Sniff the first bytes: plaintext BT handshake passes through
+        (with the consumed prefix replayed), anything else must complete
+        the MSE accept handshake."""
+        first = b""
+        verdict = None
+        async with asyncio.timeout(mse.HANDSHAKE_TIMEOUT):
+            while verdict is None:
+                first += await reader.readexactly(1)
+                verdict = mse.looks_like_plaintext_bt(first)
+        if verdict:
+            return mse.MSEReader(reader, None, plain_prefix=first), writer
+        enc_reader, enc_writer, _method = await mse.accept(
+            reader, writer, self.meta.info_hash, first_bytes=first
+        )
+        return enc_reader, enc_writer
+
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        # MSE/PE auto-detect (mse.py): a plaintext BitTorrent handshake
+        # starts \x13"BitTorrent protocol"; anything else is treated as an
+        # incoming MSE exchange.  Both kinds of peer are served.
+        try:
+            reader, writer = await self._maybe_decrypt(reader, writer)
+        except (mse.MSEError, ConnectionError, OSError,
+                asyncio.IncompleteReadError, TimeoutError):
+            writer.close()
+            return
         peer = wire.PeerWire(reader, writer)
         try:
             handshake = await peer.recv_handshake()

@@ -1,0 +1,246 @@
+"""MSE/PE (Message Stream Encryption) — RC4 vectors, the DH handshake on
+real sockets, crypto negotiation, the seeder's protocol sniffing, and
+encrypted end-to-end downloads (VERDICT r1 missing-item 5)."""
+
+import asyncio
+import os
+
+import pytest
+
+from downloader_tpu.torrent import mse, wire
+from downloader_tpu.torrent.mse import (
+    CRYPTO_RC4,
+    MSEError,
+    _RC4Python,
+    _make_rc4,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------- RC4 core
+
+def test_rc4_known_vector():
+    # the classic ARC4 test vector: key "Key", plaintext "Plaintext"
+    expected = bytes.fromhex("bbf316e8d940af0ad3")
+    assert _RC4Python(b"Key").crypt(b"Plaintext") == expected
+    assert _make_rc4(b"Key").crypt(b"Plaintext") == expected  # openssl path
+
+
+def test_rc4_stream_is_stateful():
+    a = _make_rc4(b"k" * 20)
+    b = _make_rc4(b"k" * 20)
+    msg = os.urandom(4096)
+    # decrypting in different chunkings must agree
+    enc = a.crypt(msg[:100]) + a.crypt(msg[100:])
+    assert b.crypt(enc) == msg
+
+
+def test_python_and_openssl_agree():
+    key = os.urandom(20)
+    data = os.urandom(1 << 12)
+    assert _RC4Python(key).crypt(data) == _make_rc4(key).crypt(data)
+
+
+# ------------------------------------------------------------ handshake
+
+class _Pair:
+    """Real loopback (reader, writer) x2 via an ephemeral server.
+
+    NB: close the writers BEFORE the server — Python 3.12's
+    ``Server.wait_closed()`` waits for the server-side transports, so the
+    reverse order deadlocks.
+    """
+
+    async def __aenter__(self):
+        accepted = asyncio.get_running_loop().create_future()
+
+        async def on_connect(reader, writer):
+            accepted.set_result((reader, writer))
+
+        self.server = await asyncio.start_server(on_connect, "127.0.0.1", 0)
+        port = self.server.sockets[0].getsockname()[1]
+        self.c_reader, self.c_writer = await asyncio.open_connection(
+            "127.0.0.1", port
+        )
+        self.s_reader, self.s_writer = await accepted
+        return self
+
+    async def __aexit__(self, *exc):
+        for writer in (self.c_writer, self.s_writer):
+            writer.close()
+        self.server.close()
+        await self.server.wait_closed()
+
+
+async def _handshake(pair, info_hash, acceptor_hash=None,
+                     allow_plaintext=True):
+    init_task = asyncio.create_task(
+        mse.initiate(pair.c_reader, pair.c_writer, info_hash,
+                     allow_plaintext=allow_plaintext)
+    )
+    accept_task = asyncio.create_task(
+        mse.accept(pair.s_reader, pair.s_writer, acceptor_hash or info_hash)
+    )
+    a = await asyncio.wait_for(init_task, 30)
+    b = await asyncio.wait_for(accept_task, 30)
+    return a, b
+
+
+async def test_mse_handshake_selects_rc4_and_carries_data():
+    info_hash = os.urandom(20)
+    async with _Pair() as pair:
+        (ar, aw, a_sel), (br, bw, b_sel) = await _handshake(pair, info_hash)
+        assert a_sel == b_sel == CRYPTO_RC4
+
+        # bidirectional payload through the negotiated ciphers, odd chunks
+        msg = os.urandom(100_000)
+        aw.write(msg[:1])
+        aw.write(msg[1:77])
+        aw.write(msg[77:])
+        await aw.drain()
+        assert await br.readexactly(len(msg)) == msg
+
+        reply = os.urandom(5000)
+        bw.write(reply)
+        await bw.drain()
+        assert await ar.readexactly(len(reply)) == reply
+
+
+async def test_mse_wire_protocol_runs_on_top():
+    """PeerWire's BT handshake + messages work unchanged over MSE."""
+    info_hash = os.urandom(20)
+    async with _Pair() as pair:
+        (ar, aw, _), (br, bw, _) = await _handshake(pair, info_hash)
+        a_peer = wire.PeerWire(ar, aw)
+        b_peer = wire.PeerWire(br, bw)
+
+        await a_peer.send_handshake(info_hash, b"A" * 20)
+        got = await b_peer.recv_handshake()
+        assert got.info_hash == info_hash and got.peer_id == b"A" * 20
+
+        await b_peer.send_piece(3, 0, b"x" * 1024)
+        msg_id, payload = await a_peer.recv_message()
+        assert msg_id == wire.MSG_PIECE and payload[8:] == b"x" * 1024
+
+
+async def test_mse_skey_mismatch_rejected():
+    """An acceptor that doesn't know the torrent must drop the peer
+    (the SKEY proof is how MSE scopes a connection to a swarm)."""
+    async with _Pair() as pair:
+        init_task = asyncio.create_task(
+            mse.initiate(pair.c_reader, pair.c_writer, os.urandom(20))
+        )
+        with pytest.raises(MSEError, match="proof mismatch"):
+            await asyncio.wait_for(
+                mse.accept(pair.s_reader, pair.s_writer, os.urandom(20)), 30
+            )
+        init_task.cancel()
+        try:
+            await init_task
+        except (asyncio.CancelledError, MSEError, ConnectionError):
+            pass
+
+
+async def test_mse_garbage_rejected_quickly():
+    async with _Pair() as pair:
+        pair.c_writer.write(os.urandom(1200))  # past the padding window
+        await pair.c_writer.drain()
+        pair.c_writer.write_eof()
+        with pytest.raises(MSEError):
+            await asyncio.wait_for(
+                mse.accept(pair.s_reader, pair.s_writer, os.urandom(20)), 30
+            )
+
+
+def test_plaintext_sniffing():
+    probe = bytes([19]) + b"BitTorrent protocol"
+    assert mse.looks_like_plaintext_bt(probe) is True
+    assert mse.looks_like_plaintext_bt(probe[:1]) is None  # need more
+    assert mse.looks_like_plaintext_bt(probe[:10]) is None
+    assert mse.looks_like_plaintext_bt(b"\x7f" + os.urandom(4)) is False
+    assert mse.looks_like_plaintext_bt(bytes([19]) + b"NotBitTorrent!!"
+                                       ) is False
+
+
+# ----------------------------------------------------- end-to-end swarm
+
+def _make_payload(tmp_path, mib=2):
+    from downloader_tpu.torrent import make_metainfo
+
+    src = tmp_path / "seed" / "payload"
+    src.mkdir(parents=True)
+    body = os.urandom(mib << 20)
+    (src / "media.mkv").write_bytes(body)
+    meta = make_metainfo(str(src), piece_length=1 << 18)
+    torrent = tmp_path / "t.torrent"
+    torrent.write_bytes(meta.to_torrent_bytes())
+    return meta, str(torrent), body
+
+
+@pytest.mark.parametrize("crypto", ["require", "prefer", "plaintext"])
+async def test_encrypted_download_end_to_end(tmp_path, crypto):
+    """The client downloads from the in-repo seeder in every crypto mode —
+    the seeder auto-detects MSE vs plaintext per connection."""
+    from downloader_tpu.torrent import Seeder, TorrentClient
+    from downloader_tpu.torrent.tracker import Peer
+
+    meta, torrent, body = _make_payload(tmp_path)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    port = await seeder.start()
+    try:
+        client = TorrentClient(crypto=crypto)
+        await asyncio.wait_for(
+            client.download(
+                torrent, str(tmp_path / "dl"),
+                peers=[Peer("127.0.0.1", port)], listen=False,
+            ),
+            120,
+        )
+        got = (tmp_path / "dl" / "payload" / "media.mkv").read_bytes()
+        assert got == body
+    finally:
+        await seeder.stop()
+
+
+async def test_prefer_falls_back_to_plaintext_only_peer(tmp_path):
+    """A peer that drops non-BT bytes (no MSE support) must still be
+    reachable in 'prefer' mode via the plaintext retry."""
+    from downloader_tpu.torrent import TorrentClient
+    from downloader_tpu.torrent.tracker import Peer
+
+    info_hash = os.urandom(20)
+    attempts = {"total": 0}
+
+    async def plaintext_only(reader, writer):
+        attempts["total"] += 1
+        try:
+            first = await reader.readexactly(1)
+            if first != bytes([19]):  # not a BT handshake: slam the door
+                return
+            rest = await reader.readexactly(67)
+            assert rest[:19] == b"BitTorrent protocol"
+            peer = wire.PeerWire(reader, writer)
+            await peer.send_handshake(info_hash, b"S" * 20)
+            await reader.read(1)  # hold open until the client hangs up
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            # close before returning: Server.wait_closed() (3.12) waits
+            # for server-side transports
+            writer.close()
+
+    server = await asyncio.start_server(plaintext_only, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        client = TorrentClient(crypto="prefer")
+        peer = await client._connect(Peer("127.0.0.1", port), info_hash)
+        await peer.close()
+        assert attempts["total"] == 2  # MSE try, then plaintext success
+
+        strict = TorrentClient(crypto="require")
+        with pytest.raises((MSEError, EOFError, ConnectionError)):
+            await strict._connect(Peer("127.0.0.1", port), info_hash)
+    finally:
+        server.close()
+        await server.wait_closed()

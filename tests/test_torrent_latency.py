@@ -1,0 +1,170 @@
+"""Off-loopback swarm behavior: request pipelining under injected latency
+and block re-queueing when a peer dies mid-transfer (VERDICT r1 item 9 —
+PIPELINE_DEPTH/endgame were tuned on zero-RTT loopback only)."""
+
+import asyncio
+import os
+
+import pytest
+
+from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
+from downloader_tpu.torrent.tracker import Peer
+
+pytestmark = pytest.mark.anyio
+
+
+class DelayProxy:
+    """TCP relay in front of the seeder adding per-chunk delay (simulated
+    RTT/bandwidth) and optionally killing the connection after N payload
+    bytes (peer churn)."""
+
+    def __init__(self, target_port: int, delay: float = 0.0,
+                 kill_after: int = 0):
+        self.target_port = target_port
+        self.delay = delay
+        self.kill_after = kill_after
+        self.bytes_relayed = 0
+        self._server = None
+        self._tasks = set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_connect, "127.0.0.1", 0
+        )
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        for task in list(self._tasks):
+            task.cancel()
+        for task in list(self._tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _on_connect(self, c_reader, c_writer):
+        task = asyncio.current_task()
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        try:
+            s_reader, s_writer = await asyncio.open_connection(
+                "127.0.0.1", self.target_port
+            )
+        except OSError:
+            c_writer.close()
+            return
+        writers = (c_writer, s_writer)
+
+        async def pump(reader, writer, count_down: bool):
+            try:
+                while True:
+                    chunk = await reader.read(1 << 16)
+                    if not chunk:
+                        break
+                    if self.delay:
+                        await asyncio.sleep(self.delay)
+                    if count_down:
+                        self.bytes_relayed += len(chunk)
+                        if self.kill_after and self.bytes_relayed >= self.kill_after:
+                            break  # simulated peer death mid-stream
+                    writer.write(chunk)
+                    await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                for w in writers:
+                    w.close()
+
+        await asyncio.gather(
+            pump(c_reader, s_writer, False),
+            pump(s_reader, c_writer, True),
+        )
+
+
+def _payload(tmp_path, mib):
+    src = tmp_path / "seed" / "payload"
+    src.mkdir(parents=True)
+    body = os.urandom(mib << 20)
+    (src / "media.mkv").write_bytes(body)
+    meta = make_metainfo(str(src), piece_length=1 << 18)
+    torrent = tmp_path / "t.torrent"
+    torrent.write_bytes(meta.to_torrent_bytes())
+    return meta, str(torrent), body
+
+
+async def test_download_completes_under_latency(tmp_path):
+    """15 ms per 64 KiB chunk ≈ a WAN-ish peer: the pipelined request pump
+    must keep the pipe busy and endgame must close the final blocks."""
+    meta, torrent, body = _payload(tmp_path, mib=4)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    seed_port = await seeder.start()
+    proxy = DelayProxy(seed_port, delay=0.015)
+    proxy_port = await proxy.start()
+    try:
+        await asyncio.wait_for(
+            TorrentClient().download(
+                torrent, str(tmp_path / "dl"),
+                peers=[Peer("127.0.0.1", proxy_port)], listen=False,
+            ),
+            180,
+        )
+        got = (tmp_path / "dl" / "payload" / "media.mkv").read_bytes()
+        assert got == body
+        assert proxy.bytes_relayed >= len(body)  # payload really crossed it
+    finally:
+        await proxy.stop()
+        await seeder.stop()
+
+
+async def test_peer_death_mid_download_requeues_blocks(tmp_path):
+    """A peer dying after ~1 MiB must not strand its in-flight blocks:
+    the surviving peer picks them up and the download still completes."""
+    meta, torrent, body = _payload(tmp_path, mib=4)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    seed_port = await seeder.start()
+    dying = DelayProxy(seed_port, delay=0.002, kill_after=1 << 20)
+    dying_port = await dying.start()
+    try:
+        await asyncio.wait_for(
+            TorrentClient().download(
+                torrent, str(tmp_path / "dl"),
+                peers=[
+                    Peer("127.0.0.1", dying_port),   # dies mid-transfer
+                    Peer("127.0.0.1", seed_port),    # healthy
+                ],
+                listen=False,
+            ),
+            180,
+        )
+        got = (tmp_path / "dl" / "payload" / "media.mkv").read_bytes()
+        assert got == body
+        # the dying proxy actually served (then dropped) traffic
+        assert 0 < dying.bytes_relayed
+    finally:
+        await dying.stop()
+        await seeder.stop()
+
+
+async def test_all_peers_dead_fails_cleanly(tmp_path):
+    """Churn to zero peers must surface a clean error, not a hang."""
+    from downloader_tpu.torrent.client import TorrentError
+
+    meta, torrent, _body = _payload(tmp_path, mib=2)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    seed_port = await seeder.start()
+    dying = DelayProxy(seed_port, delay=0.001, kill_after=256 << 10)
+    dying_port = await dying.start()
+    try:
+        with pytest.raises(TorrentError):
+            await asyncio.wait_for(
+                TorrentClient().download(
+                    torrent, str(tmp_path / "dl"),
+                    peers=[Peer("127.0.0.1", dying_port)], listen=False,
+                ),
+                120,
+            )
+    finally:
+        await dying.stop()
+        await seeder.stop()
