@@ -1,10 +1,17 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench proto lint run docker
+.PHONY: test bench proto lint run docker integration
 
+# hermetic gate: never touches localhost services, even when something
+# happens to be listening on 5672/9000
 test:
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m "not integration"
+
+# opt-in: real RabbitMQ + MinIO (docker compose up -d --wait first);
+# the tests auto-skip when the services are unreachable
+integration:
+	python -m pytest tests/ -m integration -v
 
 lint:
 	python -m pytest tests/test_lint.py -q
