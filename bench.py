@@ -236,9 +236,10 @@ async def bench_torrent(mib: int = 64) -> dict:
     from downloader_tpu.torrent.tracker import Peer
 
     out = {}
-    for crypto, label, size in (
-        ("plaintext", "torrent_swarm_mbps", mib),
-        ("require", "torrent_swarm_encrypted_mbps", mib // 2),
+    for crypto, transport, label, size in (
+        ("plaintext", "tcp", "torrent_swarm_mbps", mib),
+        ("require", "tcp", "torrent_swarm_encrypted_mbps", mib // 2),
+        ("plaintext", "utp", "torrent_swarm_utp_mbps", mib // 4),
     ):
         with tempfile.TemporaryDirectory() as tmp:
             src_dir = os.path.join(tmp, "seed", "payload")
@@ -254,7 +255,7 @@ async def bench_torrent(mib: int = 64) -> dict:
                 fh.write(meta.to_torrent_bytes())
 
             started = time.monotonic()
-            await TorrentClient(crypto=crypto).download(
+            await TorrentClient(crypto=crypto, transport=transport).download(
                 torrent_path, os.path.join(tmp, "dl"),
                 peers=[Peer("127.0.0.1", port)], listen=False,
             )

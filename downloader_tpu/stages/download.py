@@ -197,8 +197,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         crypto = os.environ.get("TORRENT_CRYPTO") or getattr(
             ctx.config.instance, "torrent_crypto", None
         ) or "prefer"
+        # Transport for outgoing dials: TORRENT_TRANSPORT env or
+        # config.instance.torrent_transport — auto (default: TCP with a
+        # uTP/BEP 29 fallback, webtorrent parity) | tcp | utp.
+        transport = os.environ.get("TORRENT_TRANSPORT") or getattr(
+            ctx.config.instance, "torrent_transport", None
+        ) or "auto"
         client = TorrentClient(logger=logger, dht=await _shared_dht(logger),
-                               rate_limiter=limiter, crypto=crypto)
+                               rate_limiter=limiter, crypto=crypto,
+                               transport=transport)
 
         # seed-while-leech: verified pieces are served back to the swarm
         # during the download; SEED_LINGER/config.instance.seed_linger keeps

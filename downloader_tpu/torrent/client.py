@@ -28,6 +28,7 @@ import aiohttp
 from ..utils.watchdog import MetadataTimeoutError, StallWatchdog
 from . import mse
 from . import tracker as tracker_mod
+from . import utp
 from . import wire
 from .magnet import parse_magnet
 from .metainfo import BLOCK_SIZE, Metainfo, parse_info_dict, parse_torrent_bytes
@@ -184,7 +185,8 @@ class _Swarm:
 
 class TorrentClient:
     def __init__(self, logger=None, peer_id: Optional[bytes] = None,
-                 dht=None, rate_limiter=None, crypto: str = "prefer"):
+                 dht=None, rate_limiter=None, crypto: str = "prefer",
+                 transport: str = "auto"):
         """``dht`` is an optional started :class:`~.dht.DHTNode`; when set,
         it is queried as an additional peer source next to trackers (the
         reference's webtorrent does the same via bittorrent-dht,
@@ -198,10 +200,19 @@ class TorrentClient:
         handshake and falls back to plaintext against peers that reject
         it, ``"require"`` drops peers that won't encrypt, ``"plaintext"``
         never initiates MSE.  Incoming connections (the seeder) always
-        auto-detect both."""
+        auto-detect both.
+
+        ``transport`` picks the outgoing dial: ``"auto"`` (default,
+        webtorrent parity — it dials TCP and uTP, lib/download.js:19)
+        tries TCP and falls back to uTP (BEP 29) on the same port;
+        ``"tcp"`` / ``"utp"`` pin one transport.  Incoming connections
+        accept both regardless (the seeder listens on TCP and UDP)."""
         if crypto not in ("plaintext", "prefer", "require"):
             raise ValueError(f"unknown crypto mode {crypto!r}")
+        if transport not in ("tcp", "utp", "auto"):
+            raise ValueError(f"unknown transport mode {transport!r}")
         self.crypto = crypto
+        self.transport = transport
         self.logger = logger
         self.rate_limiter = rate_limiter
         self.peer_id = peer_id or (
@@ -823,13 +834,33 @@ class TorrentClient:
                     )
         raise AssertionError("unreachable")  # pragma: no cover
 
+    async def _open_stream(self, peer_addr):
+        """Dial the peer per the transport policy.  ``auto`` gives TCP
+        the first 60% of the budget, then falls back to uTP on the same
+        port — a NAT'd or TCP-filtered peer is usually still reachable
+        over UDP (the reference's webtorrent dials both in parallel;
+        sequential-with-fallback avoids double-connecting the common
+        case)."""
+        if self.transport == "tcp":
+            async with asyncio.timeout(CONNECT_TIMEOUT):
+                return await asyncio.open_connection(
+                    peer_addr.host, peer_addr.port)
+        if self.transport == "utp":
+            return await utp.open_utp_connection(
+                peer_addr.host, peer_addr.port, timeout=CONNECT_TIMEOUT)
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT * 0.6):
+                return await asyncio.open_connection(
+                    peer_addr.host, peer_addr.port)
+        except (OSError, TimeoutError):
+            return await utp.open_utp_connection(
+                peer_addr.host, peer_addr.port,
+                timeout=CONNECT_TIMEOUT * 0.4)
+
     async def _connect_once(self, peer_addr, info_hash: bytes,
                             listen_port: Optional[int],
                             use_mse: bool) -> wire.PeerWire:
-        async with asyncio.timeout(CONNECT_TIMEOUT):
-            reader, writer = await asyncio.open_connection(
-                peer_addr.host, peer_addr.port
-            )
+        reader, writer = await self._open_stream(peer_addr)
         if use_mse:
             try:
                 reader, writer, _method = await mse.initiate(

@@ -47,6 +47,7 @@ class Seeder:
         self.have = have  # live reference; None = everything
         self.peer_id = peer_id or (b"-DT0001-" + os.urandom(6).hex().encode())
         self._server: Optional[asyncio.base_events.Server] = None
+        self._utp = None  # UtpEndpoint once started (uTP accept path)
         self.port: Optional[int] = None
         self.connections: int = 0
         self.bytes_served: int = 0
@@ -61,12 +62,28 @@ class Seeder:
     def _have_indices(self):
         return range(self.meta.num_pieces) if self.have is None else self.have
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    utp: bool = True) -> int:
         self._server = await asyncio.start_server(self._on_connect, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if utp:
+            # uTP listener on the SAME port number over UDP (BEP 29
+            # convention — webtorrent serves both transports on one port,
+            # /root/reference/lib/download.js:19).  The accept path is
+            # shared, so uTP peers get MSE sniffing, ut_pex, the lot.
+            from .utp import UtpEndpoint
+
+            try:
+                self._utp = await UtpEndpoint.create(
+                    host, self.port, accept_cb=self._on_connect)
+            except OSError:
+                self._utp = None  # UDP port taken: TCP-only is still fine
         return self.port
 
     async def stop(self) -> None:
+        if self._utp is not None:
+            self._utp.close()
+            self._utp = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

@@ -16,9 +16,11 @@ at once — the parts the in-repo fakes can only approximate.
 
 import asyncio
 import base64
+import functools
 import os
 import socket
 import uuid
+from urllib.parse import urlsplit
 
 import pytest
 
@@ -38,31 +40,45 @@ S3_SECRET = os.environ.get("INTEGRATION_S3_SECRET_KEY", "minioadmin")
 REQUIRED = os.environ.get("INTEGRATION_REQUIRED", "") == "1"
 
 
+@functools.lru_cache(maxsize=None)
 def _reachable(url: str, default_port: int) -> bool:
-    hostport = url.split("://", 1)[-1].split("@")[-1].split("/")[0]
-    host, _, port = hostport.rpartition(":")
-    if not host:  # no colon: the whole string is the host
-        host, port = port, ""
+    # urlsplit handles userinfo, bracketed IPv6, and missing ports; a
+    # scheme-less override still parses via the // prefix
+    parts = urlsplit(url if "://" in url else "//" + url)
     try:
-        with socket.create_connection(
-            (host.strip("[]"), int(port or default_port)), timeout=1.0
-        ):
+        host, port = parts.hostname, parts.port or default_port
+    except ValueError:
+        return False  # malformed port in an override URL
+    if not host:
+        return False
+    try:
+        with socket.create_connection((host, port), timeout=1.0):
             return True
-    except (OSError, ValueError):
-        # unreachable OR malformed override URL — either way the tests
-        # skip (or fail loudly under INTEGRATION_REQUIRED) instead of
-        # breaking collection of the whole suite
+    except OSError:
+        # unreachable — the tests skip (or fail loudly under
+        # INTEGRATION_REQUIRED) instead of breaking the suite
         return False
 
 
-requires_rabbitmq = pytest.mark.skipif(
-    not REQUIRED and not _reachable(AMQP_URL, 5672),
-    reason="no RabbitMQ at INTEGRATION_AMQP_URL (docker compose up -d)",
-)
-requires_minio = pytest.mark.skipif(
-    not REQUIRED and not _reachable(S3_URL, 9000),
-    reason="no MinIO at INTEGRATION_S3_URL (docker compose up -d)",
-)
+# Lazy probes via fixtures — NOT module-level skipif: skipif evaluates at
+# collection time, which would dial the service ports during every
+# hermetic run even though the integration marker is deselected.  A
+# fixture only runs when an integration test is actually selected, and
+# lru_cache bounds it to one probe per service per process.
+@pytest.fixture
+def rabbitmq_available():
+    if not REQUIRED and not _reachable(AMQP_URL, 5672):
+        pytest.skip("no RabbitMQ at INTEGRATION_AMQP_URL (docker compose up -d)")
+
+
+@pytest.fixture
+def minio_available():
+    if not REQUIRED and not _reachable(S3_URL, 9000):
+        pytest.skip("no MinIO at INTEGRATION_S3_URL (docker compose up -d)")
+
+
+requires_rabbitmq = pytest.mark.usefixtures("rabbitmq_available")
+requires_minio = pytest.mark.usefixtures("minio_available")
 
 
 @requires_rabbitmq
