@@ -24,8 +24,20 @@ def _safe_parts(name: str) -> list:
 
 
 class FilesystemObjectStore(ObjectStore):
-    def __init__(self, root: str):
+    """``link_puts`` (default True) lets :meth:`fput_object` ingest a
+    same-filesystem source by hardlink instead of a byte copy — O(1)
+    instead of O(size), which roughly halves end-to-end staging time (the
+    upload stage was the pipeline's most expensive hop).  The contract:
+    a source handed to ``fput_object`` is a staging artifact the caller
+    stops mutating after the call (the upload stage deletes its download
+    directory right afterwards, reference lib/upload.js:60-64).  Objects
+    themselves are always replaced atomically, never edited in place, so
+    linking never aliases store-side writes.  Cross-device sources (or
+    filesystems without hardlinks) transparently fall back to a copy."""
+
+    def __init__(self, root: str, link_puts: bool = True):
         self.root = os.path.abspath(root)
+        self.link_puts = link_puts
         os.makedirs(self.root, exist_ok=True)
 
     def _bucket_path(self, bucket: str) -> str:
@@ -61,7 +73,9 @@ class FilesystemObjectStore(ObjectStore):
 
     async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
         dst = self._object_path(bucket, name)
-        await asyncio.to_thread(_copy_file_atomic, file_path, dst)
+        await asyncio.to_thread(
+            _ingest_file_atomic, file_path, dst, self.link_puts
+        )
 
     async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
         bucket_path = self._bucket_path(bucket)
@@ -108,8 +122,20 @@ def _write_file_atomic(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
-def _copy_file_atomic(src: str, dst: str) -> None:
+def _ingest_file_atomic(src: str, dst: str, link_ok: bool) -> None:
     os.makedirs(os.path.dirname(dst), exist_ok=True)
-    tmp = dst + ".tmp"
-    shutil.copyfile(src, tmp)
+    tmp = f"{dst}.tmp.{os.getpid()}"
+    try:
+        os.unlink(tmp)  # leftover from a crashed run would fail os.link
+    except FileNotFoundError:
+        pass
+    if link_ok:
+        try:
+            os.link(src, tmp)
+        except OSError:
+            # cross-device (EXDEV), no-hardlink fs (EPERM), link cap
+            # (EMLINK): fall through to the byte copy
+            shutil.copyfile(src, tmp)
+    else:
+        shutil.copyfile(src, tmp)
     os.replace(tmp, dst)

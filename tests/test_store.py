@@ -84,3 +84,57 @@ async def test_fs_rejects_traversal(tmp_path):
     await store.make_bucket("b")
     with pytest.raises(ValueError):
         await store.put_object("b", "../escape", b"x")
+
+
+# -- filesystem backend: hardlink ingest fast path ----------------------
+
+
+async def test_fput_hardlinks_same_filesystem(tmp_path):
+    """Same-fs fput ingests by hardlink (O(1), the staging hot path)."""
+    import os
+
+    fs = FilesystemObjectStore(str(tmp_path / "objects"))
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"y" * 4096)
+    await fs.make_bucket("b")
+    await fs.fput_object("b", "linked", str(src))
+    obj = tmp_path / "objects" / "b" / "linked"
+    assert obj.read_bytes() == b"y" * 4096
+    assert os.stat(obj).st_ino == os.stat(src).st_ino
+    # deleting the source must not disturb the stored object
+    src.unlink()
+    assert obj.read_bytes() == b"y" * 4096
+
+
+async def test_fput_falls_back_to_copy_when_link_fails(tmp_path, monkeypatch):
+    """Cross-device sources (EXDEV) transparently byte-copy."""
+    import errno
+    import os
+
+    from downloader_tpu.store import fs as fs_mod
+
+    def no_link(_src, _dst):
+        raise OSError(errno.EXDEV, "cross-device link")
+
+    monkeypatch.setattr(fs_mod.os, "link", no_link)
+    fs = FilesystemObjectStore(str(tmp_path / "objects"))
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"z" * 4096)
+    await fs.make_bucket("b")
+    await fs.fput_object("b", "copied", str(src))
+    obj = tmp_path / "objects" / "b" / "copied"
+    assert obj.read_bytes() == b"z" * 4096
+    assert os.stat(obj).st_ino != os.stat(src).st_ino
+
+
+async def test_fput_link_puts_disabled(tmp_path):
+    import os
+
+    fs = FilesystemObjectStore(str(tmp_path / "objects"), link_puts=False)
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"w" * 1024)
+    await fs.make_bucket("b")
+    await fs.fput_object("b", "obj", str(src))
+    obj = tmp_path / "objects" / "b" / "obj"
+    assert obj.read_bytes() == b"w" * 1024
+    assert os.stat(obj).st_ino != os.stat(src).st_ino
