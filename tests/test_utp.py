@@ -170,6 +170,40 @@ async def test_close_delivers_eof():
         server.close()
 
 
+async def test_transfer_over_ipv6():
+    """Trackers/PEX hand out IPv6 peers (BEP 7); the uTP dial must work
+    there too.  The 4-tuple IPv6 addr normalizes to (host, port) for the
+    connection registry."""
+
+    async def handler(reader, writer):
+        (n,) = struct.unpack(">I", await reader.readexactly(4))
+        digest = hashlib.sha1()
+        left = n
+        while left:
+            chunk = await reader.read(min(left, 1 << 16))
+            digest.update(chunk)
+            left -= len(chunk)
+        writer.write(digest.digest())
+        await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+
+    server = await UtpEndpoint.create("::1", 0, accept_cb=handler)
+    try:
+        assert server.local_addr[0] == "::1"
+        reader, writer = await open_utp_connection(*server.local_addr)
+        payload = os.urandom(256 << 10)
+        writer.write(struct.pack(">I", len(payload)) + payload)
+        await writer.drain()
+        async with asyncio.timeout(20):
+            reply = await reader.readexactly(20)
+        assert reply == hashlib.sha1(payload).digest()
+        writer.close()
+        await writer.wait_closed()
+    finally:
+        server.close()
+
+
 async def test_connect_refused_is_fast():
     """Dialing a dead UDP port must fail via ICMP, not a long timeout."""
     probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
