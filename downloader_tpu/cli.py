@@ -13,6 +13,14 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli scrape media.torrent
     python -m downloader_tpu.cli status [--url http://host:3401]
     python -m downloader_tpu.cli watch [--id my-movie]
+    python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
+    python -m downloader_tpu.cli train --data media/ --steps 500 \
+        --checkpoint-dir ckpt/
+
+``upscale``/``train`` drive the TPU compute surface directly (the same
+code the config-gated ``upscale`` pipeline stage runs): batch-upscale a
+Y4M file, or fit the upscaler on Y4M media self-supervised (HR crops
+vs box-downsampled LR inputs) with orbax checkpoints the stage loads.
 
 ``submit``/``watch`` talk to the queue backend named in config (AMQP in
 production; they refuse the in-memory backend, which cannot reach a
@@ -100,6 +108,35 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="only show events for this media id")
     watch.add_argument("--count", type=int, default=0,
                        help="exit after N events (0 = run until ^C)")
+
+    upscale = sub.add_parser(
+        "upscale", help="upscale a Y4M file through the TPU model"
+    )
+    upscale.add_argument("src", help="input .y4m path")
+    upscale.add_argument("dst", help="output .y4m path (2x dimensions)")
+    upscale.add_argument("--checkpoint-dir", default=None,
+                         help="orbax checkpoint dir with trained params "
+                              "(default: random init)")
+    upscale.add_argument("--batch", type=int, default=8,
+                         help="frames per device dispatch")
+
+    train = sub.add_parser(
+        "train", help="fit the upscaler on Y4M media (self-supervised SR)"
+    )
+    train.add_argument("--data", required=True,
+                       help=".y4m file or directory of .y4m files")
+    train.add_argument("--steps", type=int, default=200)
+    train.add_argument("--batch", type=int, default=8)
+    train.add_argument("--crop", type=int, default=64,
+                       help="high-res crop edge (LR input is crop/scale)")
+    train.add_argument("--lr", type=float, default=1e-3,
+                       help="adam learning rate")
+    train.add_argument("--checkpoint-dir", default=None,
+                       help="orbax dir to save to / resume from")
+    train.add_argument("--save-every", type=int, default=100)
+    train.add_argument("--model-axis", type=int, default=1,
+                       help="tensor-parallel axis size on multi-device")
+    train.add_argument("--seed", type=int, default=0)
 
     return parser
 
@@ -336,6 +373,47 @@ async def _scrape(args) -> int:
     return 0 if failures < len(meta.trackers) else 1
 
 
+def _upscale(args) -> int:
+    try:
+        from .compute.pipeline import FrameUpscaler
+    except ImportError:
+        print("upscale needs the [compute] extra (jax/flax)", file=sys.stderr)
+        return 2
+    upscaler = FrameUpscaler(
+        batch=args.batch, checkpoint_dir=args.checkpoint_dir
+    )
+    frames = upscaler.upscale_y4m(args.src, args.dst)
+    print(f"upscaled {frames} frames -> {args.dst}")
+    return 0
+
+
+def _train(args) -> int:
+    try:
+        from .compute.trainer import TrainerSettings, discover_media, train
+    except ImportError:
+        print("train needs the [compute] extra (jax/flax/optax)",
+              file=sys.stderr)
+        return 2
+    paths = discover_media(args.data)
+    settings = TrainerSettings(
+        steps=args.steps,
+        batch=args.batch,
+        crop=args.crop,
+        learning_rate=args.lr,
+        checkpoint_dir=args.checkpoint_dir,
+        save_every=args.save_every,
+        model_axis=args.model_axis,
+        seed=args.seed,
+    )
+    summary = train(paths, settings, log=print)
+    print(
+        f"trained to step {summary['final_step']} "
+        f"(loss {summary['final_loss']:.6f}, batch {summary['batch']}, "
+        f"devices {summary['devices']})"
+    )
+    return 0
+
+
 def _magnet(args) -> int:
     from .torrent.magnet import make_magnet
     from .torrent.metainfo import parse_torrent_bytes
@@ -360,6 +438,10 @@ def main(argv=None) -> int:
         return asyncio.run(_status(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
+    if args.command == "upscale":
+        return _upscale(args)
+    if args.command == "train":
+        return _train(args)
     raise AssertionError("unreachable")
 
 
