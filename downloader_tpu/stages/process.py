@@ -27,6 +27,12 @@ from .base import Job, StageContext, StageFn
 # (reference lib/process.js:15-20)
 MEDIA_EXTS = {".mp4", ".mkv", ".mov", ".webm"}
 
+# The torrent client's fast-resume sidecar (torrent/resume.py RESUME_NAME
+# — equality pinned by a test) lives at the download root.  It is the
+# framework's own artifact, not downloaded content, so the filter must
+# not let it defeat the sole-top-level-directory rule below.
+_RESUME_SIDECAR = ".dt-resume"
+
 # (reference lib/process.js:59-66) — substring matches, like JS regex.test
 _SKIP_PATH_RE = re.compile(r"/extras|/commentary", re.IGNORECASE)
 _SEASON_RE = re.compile(r"s\d+|season", re.IGNORECASE)
@@ -46,7 +52,7 @@ def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
     # preserved as-is for parity.
     try:
         if os.path.exists(os.path.join(root, name)):
-            entries = os.listdir(root)
+            entries = [e for e in os.listdir(root) if e != _RESUME_SIDECAR]
             if len(entries) == 1 and entries[0] == name:
                 logger.info(
                     "directory allowed: only top level directory", path=dir_path

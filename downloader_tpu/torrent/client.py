@@ -27,6 +27,7 @@ import aiohttp
 
 from ..utils.watchdog import MetadataTimeoutError, StallWatchdog
 from . import mse
+from . import resume as resume_mod
 from . import tracker as tracker_mod
 from . import utp
 from . import wire
@@ -280,6 +281,11 @@ class TorrentClient:
 
         if swarm.complete:
             self._log("all pieces already on disk")
+            # a hash-scan proved the data: record it so the NEXT restart
+            # is stat-only
+            await asyncio.to_thread(
+                resume_mod.save_resume, storage.root, meta, set(swarm.done)
+            )
             if stats_out is not None:
                 stats_out.update(self._swarm_stats(swarm, None))
             if on_progress is not None:
@@ -324,6 +330,14 @@ class TorrentClient:
                     await server.stop()
             if stats_out is not None:
                 stats_out.update(self._swarm_stats(swarm, server))
+            # all writers are stopped (the drive's finally gathered them),
+            # so file mtimes are final: record the verified bitfield for
+            # fast resume — on success AND on orderly failure (a stalled
+            # job the queue redelivers resumes instantly instead of
+            # re-hashing everything it already fetched)
+            await asyncio.to_thread(
+                resume_mod.save_resume, storage.root, meta, set(swarm.done)
+            )
 
         if on_progress is not None:
             await on_progress(1.0)
@@ -777,23 +791,35 @@ class TorrentClient:
     async def _resume_from_disk(self, storage: TorrentStorage, swarm: _Swarm) -> None:
         meta = swarm.meta
 
+        # fast path: the ``.dt-resume`` sidecar (resume.py) names pieces
+        # verified before the last orderly exit whose files' size+mtime
+        # fingerprints still match — those skip the hash entirely, so a
+        # restart of a big torrent costs stat calls, not a full re-read
+        trusted = await asyncio.to_thread(
+            resume_mod.load_resume, storage.root, meta
+        ) or set()
+
         def _scan() -> list:
             # runs in a worker thread: hashing a multi-GB torrent must not
             # block the event loop
             intact = []
             for index in range(meta.num_pieces):
+                if index in trusted:
+                    continue
                 data = storage.read_piece(index)
                 if hashlib.sha1(data).digest() == meta.piece_hashes[index]:
                     intact.append(index)
             return intact
 
-        for index in await asyncio.to_thread(_scan):
+        hashed = await asyncio.to_thread(_scan)
+        for index in list(trusted) + hashed:
             swarm.pending.discard(index)
             swarm.done.add(index)
             swarm.bytes_done += meta.piece_size(index)
             swarm.bytes_resumed += meta.piece_size(index)
         if swarm.done:
-            self._log("resumed pieces from disk", count=len(swarm.done))
+            self._log("resumed pieces from disk", count=len(swarm.done),
+                      fast_resume=len(trusted), rehashed=len(hashed))
 
     # -- progress -------------------------------------------------------
     async def _report_progress(self, swarm: _Swarm, watchdog: StallWatchdog,

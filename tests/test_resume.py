@@ -1,0 +1,167 @@
+"""Fast-resume sidecar: fingerprint validation and the no-rehash restart.
+
+The reference restarted every job from zero (SURVEY §5); the rebuild
+already re-hashed on-disk pieces, and the sidecar makes that restart
+stat-only when nothing changed — while any size/mtime drift falls back
+to hashing the affected pieces."""
+
+import asyncio
+import hashlib
+import os
+
+import pytest
+
+from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
+from downloader_tpu.torrent import resume as resume_mod
+from downloader_tpu.torrent.storage import TorrentStorage
+from downloader_tpu.torrent.tracker import Peer
+
+pytestmark = pytest.mark.anyio
+
+
+def _payload_dir(tmp_path, mib=2, files=("media.mkv",)):
+    src = tmp_path / "seed" / "payload"
+    src.mkdir(parents=True)
+    for name in files:
+        (src / name).write_bytes(os.urandom(mib << 20))
+    meta = make_metainfo(str(src), piece_length=1 << 18)
+    torrent = tmp_path / "t.torrent"
+    torrent.write_bytes(meta.to_torrent_bytes())
+    return meta, str(torrent)
+
+
+def test_sidecar_name_pinned_across_modules():
+    """process.py excludes the sidecar from the sole-top-level-dir rule
+    by name; the duplicated constant must track resume.py's."""
+    from downloader_tpu.stages.process import _RESUME_SIDECAR
+
+    assert _RESUME_SIDECAR == resume_mod.RESUME_NAME
+
+
+def test_sidecar_does_not_defeat_sole_top_level_dir_rule(tmp_path):
+    """A TV-mode download whose only content is one non-season directory
+    must still traverse it when the sidecar sits next to it."""
+    from downloader_tpu import schemas
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.process import find_media_files
+
+    root = tmp_path / "dl"
+    (root / "Some Show").mkdir(parents=True)
+    (root / "Some Show" / "ep1.mkv").write_bytes(b"x")
+    (root / resume_mod.RESUME_NAME).write_text("{}")
+    media = schemas.Media(id="x", type=schemas.MediaType.Value("TV"))
+    found = find_media_files(str(root), media, NullLogger())
+    assert [os.path.basename(p) for p in found] == ["ep1.mkv"]
+
+
+def test_save_load_roundtrip(tmp_path):
+    meta, _ = _payload_dir(tmp_path)
+    root = str(tmp_path / "seed")
+    done = {0, 3, meta.num_pieces - 1}
+    resume_mod.save_resume(root, meta, done)
+    assert resume_mod.load_resume(root, meta) == done
+
+
+def test_wrong_infohash_rejected(tmp_path):
+    meta, _ = _payload_dir(tmp_path)
+    other_dir = tmp_path / "other"
+    root = str(tmp_path / "seed")
+    resume_mod.save_resume(root, meta, {0})
+    other_src = other_dir / "payload"
+    other_src.mkdir(parents=True)
+    (other_src / "media.mkv").write_bytes(os.urandom(1 << 18))
+    other = make_metainfo(str(other_src), piece_length=1 << 18)
+    assert resume_mod.load_resume(root, other) is None
+
+
+def test_corrupt_record_rejected(tmp_path):
+    meta, _ = _payload_dir(tmp_path)
+    root = str(tmp_path / "seed")
+    (tmp_path / "seed" / resume_mod.RESUME_NAME).write_text("{not json")
+    assert resume_mod.load_resume(root, meta) is None
+
+
+def test_tampered_file_drops_its_pieces(tmp_path):
+    meta, _ = _payload_dir(tmp_path, mib=1, files=("a.mkv", "b.mkv"))
+    root = str(tmp_path / "seed")
+    all_pieces = set(range(meta.num_pieces))
+    resume_mod.save_resume(root, meta, all_pieces)
+
+    # touch ONE file: only pieces overlapping it lose trust
+    storage = TorrentStorage(meta, root)
+    victim = meta.files[0]
+    path = storage.file_path(victim.path)
+    with open(path, "r+b") as fh:
+        fh.write(b"XX")
+    os.utime(path, ns=(1, 1))  # force a different mtime_ns
+
+    trusted = resume_mod.load_resume(root, meta)
+    lo, hi = victim.offset, victim.offset + victim.length
+    for index in range(meta.num_pieces):
+        start = index * meta.piece_length
+        end = start + meta.piece_size(index)
+        overlaps_victim = start < hi and end > lo
+        assert (index in trusted) == (not overlaps_victim)
+
+
+async def test_restart_is_stat_only(tmp_path, monkeypatch):
+    """After a completed download, a second run over the same directory
+    resumes every piece WITHOUT reading a single one back."""
+    meta, torrent = _payload_dir(tmp_path)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    port = await seeder.start()
+    dl = str(tmp_path / "dl")
+    try:
+        async with asyncio.timeout(60):
+            await TorrentClient().download(
+                torrent, dl, peers=[Peer("127.0.0.1", port)], listen=False)
+    finally:
+        await seeder.stop()
+    assert os.path.exists(os.path.join(dl, resume_mod.RESUME_NAME))
+
+    reads = []
+    orig = TorrentStorage.read_piece
+    monkeypatch.setattr(
+        TorrentStorage, "read_piece",
+        lambda self, index: reads.append(index) or orig(self, index),
+    )
+    stats = {}
+    async with asyncio.timeout(60):
+        await TorrentClient().download(
+            torrent, dl, peers=[], listen=False, stats_out=stats)
+    assert reads == []
+    assert stats["bytes_resumed"] == meta.total_length
+
+
+async def test_restart_rehashes_after_tamper(tmp_path):
+    """Corrupting staged bytes after the sidecar was written must be
+    caught: the resume path re-hashes the drifted file and re-downloads
+    the bad pieces."""
+    meta, torrent = _payload_dir(tmp_path)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    port = await seeder.start()
+    dl = str(tmp_path / "dl")
+    try:
+        async with asyncio.timeout(60):
+            await TorrentClient().download(
+                torrent, dl, peers=[Peer("127.0.0.1", port)], listen=False)
+
+        victim = os.path.join(dl, "payload", "media.mkv")
+        with open(victim, "r+b") as fh:
+            fh.seek(0)
+            fh.write(b"\x00" * 64)
+
+        stats = {}
+        async with asyncio.timeout(60):
+            await TorrentClient().download(
+                torrent, dl, peers=[Peer("127.0.0.1", port)],
+                listen=False, stats_out=stats)
+    finally:
+        await seeder.stop()
+    # the corrupted piece was refetched; the rest resumed
+    assert stats["bytes_from_peers"] >= meta.piece_length
+    assert stats["bytes_resumed"] < meta.total_length
+    data = open(victim, "rb").read()
+    expected = open(os.path.join(str(tmp_path / "seed"), "payload",
+                                 "media.mkv"), "rb").read()
+    assert hashlib.sha1(data).digest() == hashlib.sha1(expected).digest()
