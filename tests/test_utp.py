@@ -239,6 +239,46 @@ async def test_unknown_connection_gets_reset():
         server.close()
 
 
+def test_seq_compare_wraps():
+    from downloader_tpu.torrent.utp import _seq_lt, _seq_lte
+
+    assert _seq_lte(5, 5) and not _seq_lt(5, 5)
+    assert _seq_lt(65535, 0)          # wrap: 65535 < 0
+    assert _seq_lt(65530, 5)
+    assert not _seq_lt(5, 65530)
+    assert _seq_lte(0, 32766) and not _seq_lte(0, 40000)
+
+
+async def test_transfer_across_seq_wrap(monkeypatch):
+    """A server->client stream starting near 65535 must cross the 16-bit
+    wrap without stalling or reordering (the acceptor's initial seq is
+    random, so real connections hit this)."""
+    from downloader_tpu.torrent import utp as utp_mod
+
+    monkeypatch.setattr(utp_mod.random, "randrange", lambda _n: 0xFFF8)
+    payload = os.urandom(600 << 10)  # ~440 packets: far past the wrap
+
+    async def handler(reader, writer):
+        await reader.readexactly(4)
+        writer.write(payload)
+        await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        reader, writer = await open_utp_connection(*server.local_addr)
+        writer.write(b"go!!")
+        await writer.drain()
+        async with asyncio.timeout(30):
+            got = await reader.readexactly(len(payload))
+        assert hashlib.sha1(got).digest() == hashlib.sha1(payload).digest()
+        writer.close()
+        await writer.wait_closed()
+    finally:
+        server.close()
+
+
 # -- the torrent stack over uTP ----------------------------------------
 
 
