@@ -255,7 +255,10 @@ async def test_transfer_across_seq_wrap(monkeypatch):
     random, so real connections hit this)."""
     from downloader_tpu.torrent import utp as utp_mod
 
-    monkeypatch.setattr(utp_mod.random, "randrange", lambda _n: 0xFFF8)
+    # NB: utp_mod.random is the stdlib module — this pins every randrange
+    # in the process for the test's duration (incl. connect()'s conn-id,
+    # which is harmless here); *a keeps any arity working
+    monkeypatch.setattr(utp_mod.random, "randrange", lambda *a: 0xFFF8)
     payload = os.urandom(600 << 10)  # ~440 packets: far past the wrap
 
     async def handler(reader, writer):
