@@ -134,10 +134,3 @@ def load_resume(root: str, meta: Metainfo) -> Optional[Set[int]]:
         if touched_ok:
             trusted.add(index)
     return trusted
-
-
-def clear_resume(root: str) -> None:
-    try:
-        os.unlink(_resume_path(root))
-    except OSError:
-        pass

@@ -200,6 +200,9 @@ class UtpConnection:
         # processing is O(newly acked), not O(window) — at a 4 MB window
         # an O(window) scan per ack is the throughput ceiling
         self._order: deque = deque()
+        # loss-marked packets awaiting retransmission: _flush drains this
+        # instead of scanning the whole inflight dict per datagram
+        self._resend: deque = deque()
         self._flight_bytes = 0
         self._send_buf = bytearray()
         self._send_lo = asyncio.Event()
@@ -271,7 +274,9 @@ class UtpConnection:
         self._rto = min(self._rto * 2, 16.0)
         self._cwnd = MIN_CWND
         for pkt in self._inflight.values():
-            pkt.need_resend = True
+            if not pkt.need_resend:
+                pkt.need_resend = True
+                self._resend.append(pkt)
         self._transmit(oldest)
 
     # -- connect (initiator side) --------------------------------------
@@ -432,7 +437,7 @@ class UtpConnection:
             for seq, pkt in self._inflight.items():
                 if _seq_lt(seq, highest_sacked) and not pkt.need_resend:
                     pkt.need_resend = True
-                    self._transmit(pkt)
+                    self._transmit(pkt)  # clears the flag; no queue entry
         return acked
 
     def _update_rtt(self, sample: float) -> None:
@@ -482,8 +487,10 @@ class UtpConnection:
         bytes, so retransmitting them never grows the window)."""
         if not self._connected.is_set() or self._closed:
             return
-        for pkt in list(self._inflight.values()):
-            if pkt.need_resend:
+        while self._resend:
+            pkt = self._resend.popleft()
+            # stale entries: acked away since marking, or already resent
+            if pkt.need_resend and pkt.seq in self._inflight:
                 self._transmit(pkt)
         window = min(self._cwnd, self._peer_wnd)
         while self._send_buf and self._flight_bytes < window:
@@ -662,6 +669,16 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             (_t, conn_id, _ts, _td, _wnd, seq, _ack, _sack,
              _payload) = decode_packet(data)
         except PacketError:
+            return
+        # SYN retransmit (our ST_STATE was lost or slow): the live
+        # acceptor is registered under conn_id+1 — packets from the
+        # initiator carry that id, but retransmitted SYNs still carry the
+        # original.  Re-ack through the existing connection instead of
+        # clobbering it with a fresh one (whose new random seq would
+        # desynchronize the peer that handshook against the first).
+        existing = self._conns.get((addr, (conn_id + 1) & 0xFFFF))
+        if existing is not None:
+            existing._send_ack()
             return
         conn = UtpConnection(
             self, addr,

@@ -239,6 +239,39 @@ async def test_unknown_connection_gets_reset():
         server.close()
 
 
+async def test_syn_retransmit_reacks_existing_connection():
+    """A retransmitted SYN (lost/slow ST_STATE) must re-ack through the
+    live acceptor connection, not clobber it with a fresh one whose new
+    random seq would desynchronize the initiator."""
+
+    async def handler(reader, _writer):
+        await reader.read(1)
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setblocking(False)
+    loop = asyncio.get_running_loop()
+    try:
+        syn = encode_packet(ST_SYN, 777, 0, 0, 1 << 20, 1, 0)
+        sock.sendto(syn, server.local_addr)
+        async with asyncio.timeout(5):
+            first = decode_packet(await loop.sock_recv(sock, 64))
+        assert first[0] == ST_STATE
+        assert len(server._conns) == 1
+        conn = next(iter(server._conns.values()))
+
+        sock.sendto(syn, server.local_addr)  # retransmit
+        async with asyncio.timeout(5):
+            second = decode_packet(await loop.sock_recv(sock, 64))
+        assert second[0] == ST_STATE
+        assert second[5] == first[5]  # same seq_nr: same connection
+        assert len(server._conns) == 1
+        assert next(iter(server._conns.values())) is conn
+    finally:
+        sock.close()
+        server.close()
+
+
 def test_seq_compare_wraps():
     from downloader_tpu.torrent.utp import _seq_lt, _seq_lte
 
