@@ -137,6 +137,12 @@ def _build_parser() -> argparse.ArgumentParser:
     train.add_argument("--model-axis", type=int, default=1,
                        help="tensor-parallel axis size on multi-device")
     train.add_argument("--seed", type=int, default=0)
+    train.add_argument("--scale", type=int, default=2,
+                       help="upscale factor (match instance.upscale.scale)")
+    train.add_argument("--features", type=int, default=128,
+                       help="conv width (match instance.upscale.features)")
+    train.add_argument("--depth", type=int, default=4,
+                       help="conv layers (match instance.upscale.depth)")
 
     return parser
 
@@ -404,6 +410,9 @@ def _train(args) -> int:
         save_every=args.save_every,
         model_axis=args.model_axis,
         seed=args.seed,
+        scale=args.scale,
+        features=args.features,
+        depth=args.depth,
     )
     summary = train(paths, settings, log=print)
     print(

@@ -51,6 +51,11 @@ class TrainerSettings:
     log_every: int = 20
     seed: int = 0
     model_axis: int = 1
+    # model geometry — must match the ``instance.upscale.*`` config of
+    # the stage that will load the checkpoint
+    scale: int = 2
+    features: int = 128
+    depth: int = 4
 
 
 def _frame_to_rgb(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
@@ -128,7 +133,11 @@ def train(paths: Sequence[str], settings: TrainerSettings = TrainerSettings(),
     from .train import make_train_step
 
     emit = log or (lambda _line: None)
-    config = UpscalerConfig()
+    config = UpscalerConfig(
+        scale=settings.scale,
+        features=settings.features,
+        depth=settings.depth,
+    )
     scale = config.scale
     if settings.crop % scale:
         raise ValueError(f"crop {settings.crop} not divisible by scale {scale}")

@@ -118,6 +118,28 @@ def test_trained_checkpoint_loads_into_upscaler(media_dir, tmp_path):
     assert y2.shape == (1, 32, 32)
 
 
+def test_custom_geometry_checkpoint_matches_stage_config(media_dir, tmp_path):
+    """A model trained with non-default geometry loads into a
+    FrameUpscaler built with the matching instance.upscale.* values."""
+    from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    ckpt = tmp_path / "ckpt"
+    train(
+        discover_media(str(media_dir)),
+        TrainerSettings(steps=2, batch=2, crop=32,
+                        checkpoint_dir=str(ckpt), features=64, depth=2),
+    )
+    upscaler = FrameUpscaler(
+        config=UpscalerConfig(features=64, depth=2),
+        batch=2, checkpoint_dir=str(ckpt), use_mesh=False,
+    )
+    y = np.zeros((1, 16, 16), np.uint8)
+    c = np.zeros((1, 8, 8), np.uint8)
+    y2, _cb2, _cr2 = upscaler.upscale_batch(y, c, c, 2, 2)
+    assert y2.shape == (1, 32, 32)
+
+
 def test_cli_train_and_upscale(media_dir, tmp_path, capsys):
     from downloader_tpu.cli import main
 
