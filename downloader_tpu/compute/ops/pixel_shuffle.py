@@ -1,13 +1,16 @@
 """Sub-pixel shuffle (depth-to-space) op.
 
 The upscaler's only non-conv op: rearrange (B, H, W, C*r*r) into
-(B, H*r, W*r, C).  The default path is pure ``jnp`` reshape/transpose —
-these lower to free layout changes that XLA fuses into the surrounding
-convs, which is exactly what you want on TPU (no hand kernel can beat a
-fused no-op).  A Pallas TPU kernel is provided as well for the fused
-shuffle+clip postprocess variant used at inference (where the output is
-quantized back to uint8 display range), since that elementwise tail is
-worth fusing manually when it follows the final conv.
+(B, H*r, W*r, C).  The default path is pure ``jnp`` reshape/transpose.
+Measured cost on a real v5e (720p, batch 8, bf16): ~6 ms — NOT free;
+Mosaic must relayout the sub-lane-width channel dims (12 -> 3) across
+lanes and sublanes.  Alternatives raced on hardware (BASELINE.md
+"Compute-harness v3"): a stack-then-reshape formulation ties it, a
+strided-scatter loses 60x, and an in-Pallas rank-4 transpose fails to
+compile (MosaicError) — so the XLA transpose stands as the best known
+implementation at ~7% of the forward.  A Pallas TPU kernel is provided
+for the quantize tail used at inference (clip/round/f32->u8), which IS
+worth fusing manually after the final conv.
 """
 
 from __future__ import annotations
