@@ -197,7 +197,10 @@ def train(paths: Sequence[str], settings: TrainerSettings = TrainerSettings(),
         if step % settings.log_every == 0 or step == start_step + 1:
             last_loss = float(loss)
             rate = (step - start_step) / (time.monotonic() - started)
-            emit(f"step {step} loss {last_loss:.6f} ({rate:.1f} steps/s)")
+            # signals live in [0,1], so PSNR = -10 log10(MSE) directly
+            psnr = -10.0 * np.log10(max(last_loss, 1e-12))
+            emit(f"step {step} loss {last_loss:.6f} "
+                 f"psnr {psnr:.2f}dB ({rate:.1f} steps/s)")
         if settings.checkpoint_dir and step % settings.save_every == 0:
             save_state(settings.checkpoint_dir, step, params, opt_state)
             emit(f"checkpoint saved at step {step}")
@@ -210,6 +213,7 @@ def train(paths: Sequence[str], settings: TrainerSettings = TrainerSettings(),
     return {
         "final_step": step,
         "final_loss": last_loss,
+        "final_psnr_db": -10.0 * float(np.log10(max(last_loss, 1e-12))),
         "batch": batch,
         "devices": n_devices,
         "mesh": dict(plan.mesh.shape) if plan is not None else None,

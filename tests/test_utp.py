@@ -57,6 +57,35 @@ def test_packet_rejects_garbage():
         decode_packet(b"\xff" * 20)  # bad version nibble
 
 
+def test_packet_decode_fuzz():
+    """decode_packet must never raise anything but PacketError on
+    arbitrary bytes (same discipline as the bencode fuzz): it parses
+    untrusted datagrams straight off the wire."""
+    import random as stdlib_random
+
+    from downloader_tpu.torrent.utp import PacketError
+
+    rng = stdlib_random.Random(0xDEC0DE)
+    for _ in range(2000):
+        size = rng.randrange(0, 64)
+        blob = bytes(rng.randrange(256) for _ in range(size))
+        try:
+            decode_packet(blob)
+        except PacketError:
+            pass
+    # mutated valid packets: flip bytes in a well-formed SACK packet
+    base = bytearray(encode_packet(
+        ST_STATE, 7, 1, 2, 3, 4, 5, sack=bytes(8), payload=b"xyz"))
+    for _ in range(2000):
+        blob = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            blob[rng.randrange(len(blob))] = rng.randrange(256)
+        try:
+            decode_packet(bytes(blob))
+        except PacketError:
+            pass
+
+
 # -- stream transfer ---------------------------------------------------
 
 
@@ -386,6 +415,33 @@ async def test_auto_falls_back_to_utp(tmp_path):
         utp_only.close()
         await seeder.stop()
     assert (tmp_path / "dl" / "payload" / "media.mkv").stat().st_size == 1 << 20
+
+
+async def test_mixed_transport_swarm(tmp_path):
+    """One client in auto mode drains a swarm of one TCP-only and one
+    uTP-only peer concurrently — the per-peer fallback composes with the
+    worker pool."""
+    meta, torrent = _make_swarm(tmp_path, mib=2)
+    tcp_seeder = Seeder(meta, str(tmp_path / "seed"))
+    tcp_port = await tcp_seeder.start(utp=False)
+
+    utp_seeder = Seeder(meta, str(tmp_path / "seed"))
+    utp_only = await UtpEndpoint.create(
+        "127.0.0.1", 0, accept_cb=utp_seeder._on_connect)
+    try:
+        async with asyncio.timeout(60):
+            await TorrentClient(transport="auto").download(
+                torrent, str(tmp_path / "dl"),
+                peers=[Peer("127.0.0.1", tcp_port),
+                       Peer(*utp_only.local_addr)],
+                listen=False,
+            )
+    finally:
+        utp_only.close()
+        await tcp_seeder.stop()
+        await utp_seeder.stop()
+    assert ((tmp_path / "dl" / "payload" / "media.mkv").stat().st_size
+            == 2 << 20)
 
 
 async def test_seeder_serves_tcp_and_utp_concurrently(tmp_path):
