@@ -419,7 +419,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # re-chunking copy on the hot path (this stage is the service's
             # bandwidth bottleneck)
             async with aiohttp.ClientSession(
-                read_bufsize=_CHUNK, auto_decompress=False
+                read_bufsize=_CHUNK, auto_decompress=False,
+                trust_env=True,  # honor HTTP(S)_PROXY/NO_PROXY like the
+                # reference's request lib (lib/download.js:159)
             ) as session:
                 if os.path.exists(output):
                     # a previous attempt finished the download but the job

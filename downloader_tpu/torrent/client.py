@@ -531,7 +531,7 @@ class TorrentClient:
             return meta, peers
 
         if uri.startswith(("http://", "https://")):
-            async with aiohttp.ClientSession() as session:
+            async with aiohttp.ClientSession(trust_env=True) as session:
                 async with session.get(uri) as resp:
                     resp.raise_for_status()
                     data = await resp.read()
@@ -749,7 +749,7 @@ class TorrentClient:
         meta = swarm.meta
         have = set(range(meta.num_pieces))
         failures = 0
-        async with aiohttp.ClientSession() as session:
+        async with aiohttp.ClientSession(trust_env=True) as session:
             while not swarm.complete:
                 piece = swarm.claim(have)
                 if piece is None:

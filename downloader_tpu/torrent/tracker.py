@@ -94,7 +94,7 @@ async def announce_http(
     url = f"{tracker_url}{sep}{query}"
 
     owned = session is None
-    session = session or aiohttp.ClientSession()
+    session = session or aiohttp.ClientSession(trust_env=True)
     try:
         # pre-encoded: the percent-encoded binary info_hash must reach the
         # wire untouched (yarl would otherwise re-quote it)
@@ -164,7 +164,7 @@ async def scrape_http(tracker_url: str, info_hash: bytes) -> ScrapeStats:
     )
     url = _scrape_url(tracker_url)
     sep = "&" if "?" in url else "?"
-    async with aiohttp.ClientSession() as session:
+    async with aiohttp.ClientSession(trust_env=True) as session:
         async with session.get(
             yarl.URL(f"{url}{sep}{query}", encoded=True)
         ) as resp:

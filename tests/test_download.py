@@ -100,6 +100,24 @@ async def test_http_error_status_raises(tmp_path, broker, http_server):
         await stage(make_job("HTTP", f"{base}/media/missing.mkv"))
 
 
+async def test_http_honors_proxy_env(tmp_path, broker, http_server,
+                                     monkeypatch):
+    """The reference's request lib routes through HTTP_PROXY et al by
+    default; the aiohttp sessions run trust_env=True for parity.  A
+    dead proxy proves the env is consulted (the fetch fails instead of
+    going direct)."""
+    base, _ = http_server
+    monkeypatch.setenv("http_proxy", "http://127.0.0.1:9")  # discard port
+    stage = await make_stage(tmp_path, broker)
+    with pytest.raises(Exception):
+        await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    # and NO_PROXY punches through, standard env semantics
+    monkeypatch.setenv("no_proxy", "127.0.0.1")
+    stage = await make_stage(tmp_path, broker)
+    result = await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert result == {"path": str(tmp_path / "downloads" / "job-1")}
+
+
 async def test_file_urls_gated_by_env(tmp_path, broker, monkeypatch):
     src = tmp_path / "local.mkv"
     src.write_bytes(b"local-bytes")
