@@ -265,6 +265,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             m.bytes_downloaded.labels(protocol="torrent-webseed").inc(
                 stats["bytes_from_webseeds"]
             )
+            # bytes NOT refetched thanks to on-disk pieces + the
+            # fast-resume sidecar: resume effectiveness at a glance
+            m.bytes_downloaded.labels(protocol="torrent-resumed").inc(
+                stats["bytes_resumed"]
+            )
             m.torrent_hash_failures.inc(stats["hash_failures"])
             m.torrent_bytes_served.inc(stats["bytes_served"])
         if stats:
