@@ -22,6 +22,8 @@ Behavioral parity with /root/reference/lib/download.js:
 
 from __future__ import annotations
 
+import asyncio
+import json
 import os
 import posixpath
 import re
@@ -43,6 +45,24 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 PROGRESS_INTERVAL_SECONDS = 30.0
 
 _CHUNK = 1 << 20  # 1 MiB read chunks for streaming HTTP
+
+# Segmented HTTP: entities smaller than this aren't worth the extra
+# connections (segment setup costs more than the parallelism returns)
+SEG_MIN_SIZE = 8 << 20
+# state checkpoint cadence while segments stream (crash-resume fidelity)
+SEG_STATE_INTERVAL = 2.0
+
+
+class _EntityChangedDuringSegments(Exception):
+    """A segment's If-Range missed: the origin entity changed mid-flight."""
+
+
+def _is_encoded(headers) -> bool:
+    """True when the response body is Content-Encoding-compressed — byte
+    ranges and on-disk offsets are only meaningful against identity."""
+    return headers.get(
+        "Content-Encoding", ""
+    ).strip().lower() not in ("", "identity")
 
 
 def choose_validator(headers) -> "str | None":
@@ -117,6 +137,21 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     from ..utils.ratelimit import bucket_from_config
 
     limiter = bucket_from_config(ctx.config, "download_rate_limit")
+
+    # Parallel ranged HTTP: HTTP_SEGMENTS / instance.http_segments
+    # connections per download (default 1 = the reference's single
+    # stream).  Misconfiguration fails loudly, like the rate limit.
+    raw_segments = os.environ.get("HTTP_SEGMENTS") or getattr(
+        ctx.config.instance, "http_segments", 1
+    )
+    try:
+        seg_count = int(raw_segments)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"http_segments must be an integer, got {raw_segments!r}"
+        ) from None
+    if seg_count < 1 or seg_count > 64:
+        raise ValueError(f"http_segments must be in [1, 64], got {seg_count}")
 
     # One long-lived DHT node shared by every torrent job the orchestrator
     # runs (webtorrent likewise keeps a single bundled DHT instance for the
@@ -393,6 +428,175 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         total += len(tail)
             return total
 
+        # -- segmented (parallel ranged) fast path -------------------------
+        seg_partial = output + ".partial-seg"
+        seg_state_path = seg_partial + ".state"
+
+        def _discard_segmented() -> None:
+            # state FIRST: a crash between the removes must never leave a
+            # live checkpoint pointing at a missing/zero-filled data file
+            for path in (seg_state_path, seg_partial):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+        async def _fetch_segmented(session) -> "int | None":
+            """Download with ``seg_count`` concurrent ranged connections.
+
+            Returns fetched bytes on success, or None when the entity
+            isn't segmentable (no range support, no strong validator,
+            encoded body, or too small) — the caller then runs the
+            sequential path.  Every segment request carries If-Range, so
+            a mid-flight entity change surfaces as a 200 and aborts the
+            whole attempt instead of stitching two versions.
+
+            Progress survives crashes: segment positions checkpoint to a
+            ``.partial-seg.state`` sidecar every few seconds, and a
+            redelivered job resumes each segment from its recorded
+            position when the validator still matches.
+            """
+            probe_headers = {**base_headers, "Range": "bytes=0-0"}
+            async with session.get(
+                resource_url, headers=probe_headers
+            ) as probe:
+                if probe.status != 206:
+                    return None  # no byte-range support
+                crange = _content_range(probe)
+                if crange is None:
+                    return None
+                total_len = crange[2]
+                validator = choose_validator(probe.headers)
+                if (not validator or _is_encoded(probe.headers)
+                        or total_len < SEG_MIN_SIZE):
+                    return None
+                await probe.read()
+
+            # segments are [start, pos, end): pos = next absolute byte
+            segments = None
+            try:
+                with open(seg_state_path) as fh:
+                    state = json.load(fh)
+                # the checkpoint is only as good as the data file it
+                # describes: wrong/missing size means the positions are
+                # lies (e.g. the big file was deleted to free disk)
+                if (state.get("validator") == validator
+                        and state.get("total") == total_len
+                        and os.path.getsize(seg_partial) == total_len):
+                    segments = [
+                        [int(s[0]), int(s[1]), int(s[2])]
+                        for s in state["segments"]
+                    ]
+                    resumed = sum(s[1] - s[0] for s in segments)
+                    if resumed:
+                        logger.info(
+                            "http: resuming segmented download",
+                            bytes_resumed=resumed, total=total_len,
+                        )
+            except (OSError, ValueError, KeyError, TypeError, IndexError):
+                pass
+            if segments is None:
+                span = -(-total_len // seg_count)
+                segments = [
+                    [lo, lo, min(lo + span, total_len)]
+                    for lo in range(0, total_len, span)
+                ]
+            logger.info(
+                "http: segmented download", segments=len(segments),
+                total=total_len,
+            )
+
+            def _save_state() -> None:
+                tmp = seg_state_path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump({
+                        "validator": validator,
+                        "total": total_len,
+                        "segments": segments,
+                    }, fh)
+                os.replace(tmp, seg_state_path)
+
+            with open(seg_partial, "ab") as fh:
+                fh.truncate(total_len)
+            _save_state()
+            fd = os.open(seg_partial, os.O_WRONLY)
+
+            async def _segment(seg) -> None:
+                while seg[1] < seg[2]:
+                    before = seg[1]
+                    headers = {
+                        **base_headers,
+                        "Range": f"bytes={seg[1]}-{seg[2] - 1}",
+                        "If-Range": validator,
+                    }
+                    async with session.get(
+                        resource_url, headers=headers
+                    ) as resp:
+                        if resp.status == 200:
+                            raise _EntityChangedDuringSegments()
+                        if resp.status != 206:
+                            resp.raise_for_status()
+                            raise RuntimeError(
+                                f"segmented: unexpected {resp.status}"
+                            )
+                        crange = _content_range(resp)
+                        if crange is None or crange[0] != seg[1]:
+                            raise RuntimeError(
+                                "segmented: mis-ranged 206 "
+                                f"{resp.headers.get('Content-Range')!r}"
+                            )
+                        async for raw in resp.content.iter_any():
+                            if limiter is not None:
+                                await limiter.consume(len(raw))
+                            fetched[0] += len(raw)
+                            watchdog.feed(fetched[0])
+                            # never write past our segment: a peer
+                            # segment owns the bytes after seg[2]
+                            data = raw[:seg[2] - seg[1]]
+                            os.pwrite(fd, data, seg[1])
+                            seg[1] += len(data)
+                            if len(data) < len(raw):
+                                break  # server over-delivered; done
+                    if seg[1] == before:
+                        # a capped/empty 206 must still advance, else
+                        # this loops forever against a broken origin
+                        raise RuntimeError(
+                            f"segmented: no progress at {seg[1]}"
+                        )
+
+            async def _checkpoint() -> None:
+                while True:
+                    await asyncio.sleep(SEG_STATE_INTERVAL)
+                    _save_state()
+
+            saver = asyncio.create_task(_checkpoint())
+            tasks = [asyncio.create_task(_segment(s)) for s in segments]
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                # gather does NOT cancel siblings when one raises: every
+                # task must be settled BEFORE the fd closes, or an orphan
+                # segment pwrites into a closed (and soon reused) fd —
+                # which would corrupt the sequential fallback's file
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                # likewise settle the saver so it can't resurrect the
+                # state file after the success path removes it
+                saver.cancel()
+                await asyncio.gather(saver, return_exceptions=True)
+                try:
+                    _save_state()
+                except OSError:
+                    pass
+                os.close(fd)
+            os.replace(seg_partial, output)
+            try:
+                os.remove(seg_state_path)
+            except OSError:
+                pass
+            return fetched[0]
+
         async def _existing_output_ok(session) -> bool:
             """Validate a pre-existing completed file against the origin.
 
@@ -408,9 +612,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 ) as resp:
                     if resp.status != 200:
                         return True
-                    if resp.headers.get(
-                        "Content-Encoding", ""
-                    ).strip().lower() not in ("", "identity"):
+                    if _is_encoded(resp.headers):
                         return True
                     length = resp.headers.get("Content-Length")
                     if length is None:
@@ -441,6 +643,20 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         file=output,
                     )
                     os.remove(output)
+                # segmented fast path: only when configured, and never
+                # while a sequential .partial is mid-resume (finish what
+                # the cheaper path started)
+                if seg_count > 1 and not os.path.exists(partial):
+                    try:
+                        got = await _fetch_segmented(session)
+                    except _EntityChangedDuringSegments:
+                        logger.warn(
+                            "http: entity changed mid-segments, restarting"
+                        )
+                        _discard_segmented()
+                        got = None
+                    if got is not None:
+                        return got
                 # a server may legally satisfy an open-ended range with a
                 # capped 206 (fewer bytes than the remainder), so resuming
                 # loops until the entity is complete; every round must
@@ -463,14 +679,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         resource_url, headers=headers
                     ) as resp:
                         crange = _content_range(resp)
-                        encoded = resp.headers.get(
-                            "Content-Encoding", ""
-                        ).strip().lower() not in ("", "identity")
                         if (
                             resp.status == 206
                             and crange is not None
                             and crange[0] == offset
-                            and not encoded
+                            and not _is_encoded(resp.headers)
                         ):
                             start, end, total_len = crange
                             logger.info(

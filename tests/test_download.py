@@ -211,7 +211,9 @@ async def range_server():
             # If-Range miss -> entity changed -> full 200 (RFC 7233 §3.2)
             if request.headers.get("If-Range") not in (None, ETAG):
                 return web.Response(body=payload, headers={"ETag": ETAG})
-            start = int(rng.removeprefix("bytes=").split("-")[0])
+            start_s, _, end_s = rng.removeprefix("bytes=").partition("-")
+            start = int(start_s)
+            end = min(int(end_s), len(payload) - 1) if end_s else len(payload) - 1
             if start >= len(payload):
                 return web.Response(
                     status=416,
@@ -219,10 +221,10 @@ async def range_server():
                 )
             return web.Response(
                 status=206,
-                body=payload[start:],
+                body=payload[start:end + 1],
                 headers={
                     "ETag": ETAG,
-                    "Content-Range": f"bytes {start}-{len(payload)-1}/{len(payload)}",
+                    "Content-Range": f"bytes {start}-{end}/{len(payload)}",
                 },
             )
         return web.Response(body=payload, headers={"ETag": ETAG})
@@ -498,3 +500,174 @@ async def test_unsupported_protocol_raises(tmp_path, broker):
     job.media.source = 17  # not a known SourceType
     with pytest.raises(ValueError):
         await stage(job)
+
+
+# -- segmented (parallel ranged) HTTP downloads -------------------------
+
+
+@pytest.fixture
+def small_segments(monkeypatch):
+    """Shrink the segmentation threshold so the 1 MiB fixture qualifies,
+    and enable 4 segments via the env knob."""
+    from downloader_tpu.stages import download as download_module
+
+    monkeypatch.setattr(download_module, "SEG_MIN_SIZE", 1 << 16)
+    monkeypatch.setenv("HTTP_SEGMENTS", "4")
+
+
+async def test_http_segmented_download(tmp_path, broker, range_server,
+                                       small_segments):
+    base, payload, requests = range_server
+    stage = await make_stage(tmp_path, broker)
+    result = await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    target = tmp_path / "downloads" / "job-1" / "file.mkv"
+    assert result == {"path": str(tmp_path / "downloads" / "job-1")}
+    assert target.read_bytes() == payload
+    # probe + one bounded range per segment, each carrying If-Range
+    assert requests[0] == ("bytes=0-0", None)
+    span = -(-len(payload) // 4)
+    expected = {
+        (f"bytes={lo}-{min(lo + span, len(payload)) - 1}", ETAG)
+        for lo in range(0, len(payload), span)
+    }
+    assert set(requests[1:]) == expected
+    # no stray working files
+    assert sorted(p.name for p in target.parent.iterdir()) == ["file.mkv"]
+
+
+async def test_http_segmented_resume_skips_done_bytes(
+        tmp_path, broker, range_server, small_segments):
+    """A crashed segmented download resumes each segment from its
+    checkpointed position instead of refetching."""
+    import json as json_mod
+
+    base, payload, requests = range_server
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    total = len(payload)
+    span = -(-total // 4)
+    segments = [[lo, lo, min(lo + span, total)]
+                for lo in range(0, total, span)]
+    # first two segments already complete, third half done
+    segments[0][1] = segments[0][2]
+    segments[1][1] = segments[1][2]
+    segments[2][1] = segments[2][0] + span // 2
+    seg_partial = target_dir / "file.mkv.partial-seg"
+    body = bytearray(total)
+    for start, pos, _end in segments:
+        body[start:pos] = payload[start:pos]
+    seg_partial.write_bytes(bytes(body))
+    (target_dir / "file.mkv.partial-seg.state").write_text(json_mod.dumps({
+        "validator": ETAG, "total": total, "segments": segments,
+    }))
+
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+    assert (target_dir / "file.mkv").read_bytes() == payload
+    ranges = [r for r, _ in requests[1:]]
+    # completed segments were not refetched
+    assert f"bytes={segments[0][0]}-{segments[0][2] - 1}" not in ranges
+    assert f"bytes={segments[2][1]}-{segments[2][2] - 1}" in ranges
+
+
+async def test_http_segmented_stale_state_restarts_clean(
+        tmp_path, broker, range_server, small_segments):
+    """A state file from a different entity (validator mismatch) is
+    ignored: all segments refetch from their starts."""
+    import json as json_mod
+
+    base, payload, _requests = range_server
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    total = len(payload)
+    (target_dir / "file.mkv.partial-seg").write_bytes(b"\0" * total)
+    (target_dir / "file.mkv.partial-seg.state").write_text(json_mod.dumps({
+        "validator": '"old-etag"', "total": total,
+        "segments": [[0, total, total]],
+    }))
+
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert (target_dir / "file.mkv").read_bytes() == payload
+
+
+async def test_http_segmented_orphan_state_without_data_refetches(
+        tmp_path, broker, range_server, small_segments):
+    """A checkpoint whose data file is missing (crash between discards,
+    operator freed disk) must NOT be honored — 'resuming' over a fresh
+    zero-filled file would promote zero runs as media bytes."""
+    import json as json_mod
+
+    base, payload, _requests = range_server
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    total = len(payload)
+    # state claims everything is done, but there is NO .partial-seg file
+    (target_dir / "file.mkv.partial-seg.state").write_text(json_mod.dumps({
+        "validator": ETAG, "total": total,
+        "segments": [[0, total, total]],
+    }))
+
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert (target_dir / "file.mkv").read_bytes() == payload
+
+
+async def test_http_segmented_falls_back_without_ranges(
+        tmp_path, broker, http_server, small_segments):
+    """A server with no byte-range support gets the sequential path."""
+    base, payload = http_server
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    target = tmp_path / "downloads" / "job-1" / "file.mkv"
+    assert target.read_bytes() == payload
+
+
+async def test_http_segmented_entity_change_midflight(
+        tmp_path, broker, small_segments):
+    """The origin swaps the entity between the probe and the segment
+    requests: every If-Range misses (200), the attempt aborts, and the
+    sequential restart stages the NEW entity consistently."""
+    old = bytes(range(256)) * 1024
+    new = bytes(reversed(range(256))) * 1024
+    state = {"served_probe": False}
+
+    async def serve(request):
+        rng = request.headers.get("Range")
+        if rng == "bytes=0-0" and not state["served_probe"]:
+            state["served_probe"] = True
+            return web.Response(
+                status=206, body=old[:1],
+                headers={"ETag": '"gen-1"',
+                         "Content-Range": f"bytes 0-0/{len(old)}"})
+        # generation 2: any conditional range misses
+        if rng and request.headers.get("If-Range") == '"gen-2"':
+            start = int(rng.removeprefix("bytes=").split("-")[0])
+            return web.Response(
+                status=206, body=new[start:],
+                headers={"ETag": '"gen-2"',
+                         "Content-Range":
+                         f"bytes {start}-{len(new)-1}/{len(new)}"})
+        return web.Response(body=new, headers={"ETag": '"gen-2"'})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        stage = await make_stage(tmp_path, broker)
+        await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    finally:
+        await runner.cleanup()
+    target = tmp_path / "downloads" / "job-1" / "file.mkv"
+    assert target.read_bytes() == new
+    assert sorted(p.name for p in target.parent.iterdir()) == ["file.mkv"]
+
+
+async def test_http_segments_config_validation(tmp_path, broker,
+                                               monkeypatch):
+    monkeypatch.setenv("HTTP_SEGMENTS", "nope")
+    with pytest.raises(ValueError, match="http_segments"):
+        await make_stage(tmp_path, broker)
+    monkeypatch.setenv("HTTP_SEGMENTS", "0")
+    with pytest.raises(ValueError, match="http_segments"):
+        await make_stage(tmp_path, broker)
