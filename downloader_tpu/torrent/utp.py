@@ -69,6 +69,12 @@ MIN_RTO = 0.5
 MAX_RETRANSMITS = 6  # ~0.5+1+2+4+8+16 s of backoff before giving up
 FIN_LINGER = 3.0
 
+# acceptor-side state bounds: a SYN flood must not mint unbounded
+# connection objects/timers, and a silent peer must not pin its slot
+# forever (healthy BitTorrent connections carry 60 s keep-alives)
+MAX_ACCEPTED_CONNS = 256
+IDLE_TIMEOUT = 300.0
+
 # out-of-order packets held while waiting for a retransmit; beyond this a
 # hostile or badly reordered stream is dropped on the floor (the sender
 # retransmits — correctness is unaffected, memory stays bounded)
@@ -221,6 +227,7 @@ class UtpConnection:
         self._last_ack_seen = -1
 
         self._ack_scheduled = False
+        self._last_recv = time.monotonic()
         self._closing = False  # FIN queued/sent
         self._closed = False  # fully torn down
         self._fin_seq: Optional[int] = None
@@ -258,9 +265,12 @@ class UtpConnection:
             pass
 
     def _check_timeouts(self) -> None:
+        now = time.monotonic()
+        if now - self._last_recv > IDLE_TIMEOUT:
+            self.abort(ConnectionResetError("uTP idle timeout"))
+            return
         if not self._inflight:
             return
-        now = time.monotonic()
         oldest = min(self._inflight.values(), key=lambda p: p.sent_at)
         if now - oldest.sent_at < self._rto:
             return
@@ -320,6 +330,7 @@ class UtpConnection:
             return
         if self._closed:
             return
+        self._last_recv = time.monotonic()
         self._reply_micro = (_now_us() - ts) & 0xFFFFFFFF
         self._peer_wnd = wnd
 
@@ -680,6 +691,8 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         if existing is not None:
             existing._send_ack()
             return
+        if len(self._conns) >= MAX_ACCEPTED_CONNS:
+            return  # flood bound: drop the SYN, no state minted
         conn = UtpConnection(
             self, addr,
             recv_id=(conn_id + 1) & 0xFFFF, send_id=conn_id,

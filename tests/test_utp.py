@@ -301,6 +301,60 @@ async def test_syn_retransmit_reacks_existing_connection():
         server.close()
 
 
+async def test_syn_flood_is_bounded(monkeypatch):
+    """An attacker spraying SYNs with distinct conn-ids must not mint
+    unbounded connection state on the acceptor."""
+    from downloader_tpu.torrent import utp as utp_mod
+
+    monkeypatch.setattr(utp_mod, "MAX_ACCEPTED_CONNS", 16)
+
+    async def handler(reader, _writer):
+        await reader.read(1)
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for conn_id in range(200):
+            sock.sendto(encode_packet(ST_SYN, conn_id, 0, 0, 0, 1, 0),
+                        server.local_addr)
+        await asyncio.sleep(0.2)
+        assert len(server._conns) <= 16
+        # and a real connection still works once load drops (the cap
+        # bounds state, it doesn't break the endpoint)
+        for conn in list(server._conns.values()):
+            conn.abort()
+        reader, writer = await open_utp_connection(*server.local_addr)
+        writer.write(b"!")
+        await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+    finally:
+        sock.close()
+        server.close()
+
+
+async def test_idle_connection_reaped(monkeypatch):
+    """A connected peer that goes silent is aborted after IDLE_TIMEOUT
+    (healthy BT connections keep-alive every 60 s)."""
+    from downloader_tpu.torrent import utp as utp_mod
+
+    monkeypatch.setattr(utp_mod, "IDLE_TIMEOUT", 0.2)
+
+    async def handler(reader, _writer):
+        await reader.read(1)
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        _reader, writer = await open_utp_connection(*server.local_addr)
+        assert len(server._conns) == 1
+        async with asyncio.timeout(5):
+            while server._conns:
+                await asyncio.sleep(0.05)
+        writer.close()
+    finally:
+        server.close()
+
+
 def test_seq_compare_wraps():
     from downloader_tpu.torrent.utp import _seq_lt, _seq_lte
 
