@@ -878,7 +878,12 @@ class TorrentClient:
             async with asyncio.timeout(CONNECT_TIMEOUT * 0.6):
                 return await asyncio.open_connection(
                     peer_addr.host, peer_addr.port)
-        except (OSError, TimeoutError):
+        except (OSError, TimeoutError) as err:
+            if self.logger is not None:
+                self.logger.debug(
+                    "tcp dial failed; falling back to uTP",
+                    peer=str(peer_addr), error=str(err),
+                )
             return await utp.open_utp_connection(
                 peer_addr.host, peer_addr.port,
                 timeout=CONNECT_TIMEOUT * 0.4)
