@@ -365,6 +365,12 @@ class UtpConnection:
             self._send_ack()
 
     def _handle_data(self, ptype: int, seq: int, payload: bytes) -> None:
+        # hard backstop behind the advertised window: a sender that
+        # ignores flow control must not balloon the reader buffer (the
+        # dropped packet goes unacked, so a compliant-after-all sender
+        # just retransmits once the consumer catches up)
+        if len(self.reader._buffer) > 4 * RECV_WINDOW:  # noqa: SLF001
+            return
         nxt = (self._ack + 1) & 0xFFFF
         if _seq_lt(seq, nxt):
             return  # duplicate
