@@ -65,6 +65,20 @@ def _is_encoded(headers) -> bool:
     ).strip().lower() not in ("", "identity")
 
 
+def _ensure_disk_space(dirpath: str, needed: int) -> None:
+    """Fail fast with a clear error instead of ENOSPC mid-transfer."""
+    import shutil
+
+    if needed <= 0:
+        return
+    free = shutil.disk_usage(dirpath).free
+    if needed > free:
+        raise OSError(
+            f"insufficient disk space: download needs {needed} more "
+            f"bytes, volume has {free} free"
+        )
+
+
 def choose_validator(headers) -> "str | None":
     """Pick the entity validator to store beside a partial download.
 
@@ -471,6 +485,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         or total_len < SEG_MIN_SIZE):
                     return None
                 await probe.read()
+            _ensure_disk_space(download_path, total_len)
 
             # segments are [start, pos, end): pos = next absolute byte
             segments = None
@@ -710,6 +725,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             # support: body is the full entity, restart on
                             # this response
                             _discard_partial()
+                            try:
+                                expected = int(
+                                    resp.headers.get("Content-Length", 0)
+                                )
+                            except ValueError:
+                                expected = 0
+                            _ensure_disk_space(download_path, expected)
                             _write_validator(resp)
                             await _stream_body(resp, "wb")
                             _promote()
@@ -731,6 +753,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     resource_url, headers=base_headers
                 ) as resp:
                     resp.raise_for_status()
+                    try:
+                        expected = int(resp.headers.get("Content-Length", 0))
+                    except ValueError:
+                        expected = 0
+                    _ensure_disk_space(download_path, expected)
                     _write_validator(resp)
                     await _stream_body(resp, "wb")
                     _promote()

@@ -20,7 +20,7 @@ transports, which doubles as the hermetic swarm for tests.
 """
 
 from .bencode import bdecode, bencode
-from .client import TorrentClient
+from .client import TorrentClient, TorrentError
 from .dht import DHTNode
 from .magnet import MagnetLink, parse_magnet
 from .metainfo import Metainfo, make_metainfo
@@ -30,6 +30,7 @@ __all__ = [
     "bdecode",
     "bencode",
     "TorrentClient",
+    "TorrentError",
     "DHTNode",
     "MagnetLink",
     "parse_magnet",

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 import random
 import struct
 from collections import Counter, deque
@@ -275,6 +276,7 @@ class TorrentClient:
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
 
         storage = TorrentStorage(meta, download_path)
+        await asyncio.to_thread(self._preflight_disk, storage)
         await asyncio.to_thread(storage.preallocate)
         swarm = _Swarm(meta)
         await self._resume_from_disk(storage, swarm)
@@ -342,6 +344,30 @@ class TorrentClient:
         if on_progress is not None:
             await on_progress(1.0)
         return meta
+
+    @staticmethod
+    def _preflight_disk(storage: TorrentStorage) -> None:
+        """Fail fast with a clear error when the volume can't hold the
+        torrent — losing a multi-GB transfer to ENOSPC at piece N is the
+        worst way to find out.  Bytes already on disk count as credit
+        (resume), and preallocation is sparse so this is the only check.
+        """
+        import shutil as _shutil
+
+        have = 0
+        for entry in storage.meta.files:
+            try:
+                have += os.path.getsize(storage.file_path(entry.path))
+            except OSError:
+                pass
+        needed = storage.meta.total_length - have
+        os.makedirs(storage.root, exist_ok=True)
+        free = _shutil.disk_usage(storage.root).free
+        if needed > free:
+            raise TorrentError(
+                f"insufficient disk space: torrent needs {needed} more "
+                f"bytes, volume has {free} free"
+            )
 
     @staticmethod
     def _swarm_stats(swarm: _Swarm, server) -> dict:

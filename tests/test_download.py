@@ -671,3 +671,54 @@ async def test_http_segments_config_validation(tmp_path, broker,
     monkeypatch.setenv("HTTP_SEGMENTS", "0")
     with pytest.raises(ValueError, match="http_segments"):
         await make_stage(tmp_path, broker)
+
+
+# -- disk-space preflight ----------------------------------------------
+
+
+async def test_http_insufficient_disk_fails_fast(tmp_path, broker,
+                                                 http_server, monkeypatch):
+    """A volume that can't hold the advertised Content-Length errors
+    before streaming, not at ENOSPC mid-write."""
+    import collections
+    import shutil
+
+    base, _payload = http_server
+    fake = collections.namedtuple("usage", "total used free")(100, 90, 10)
+    monkeypatch.setattr(shutil, "disk_usage", lambda _p: fake)
+    stage = await make_stage(tmp_path, broker)
+    with pytest.raises(OSError, match="insufficient disk space"):
+        await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+
+
+async def test_torrent_insufficient_disk_fails_fast(tmp_path, monkeypatch):
+    import collections
+    import shutil
+
+    from downloader_tpu.torrent import (
+        Seeder,
+        TorrentClient,
+        TorrentError,
+        make_metainfo,
+    )
+    from downloader_tpu.torrent.tracker import Peer
+
+    src = tmp_path / "seed" / "payload"
+    src.mkdir(parents=True)
+    (src / "big.mkv").write_bytes(os.urandom(1 << 20))
+    meta = make_metainfo(str(src), piece_length=1 << 18)
+    seeder = Seeder(meta, str(tmp_path / "seed"))
+    port = await seeder.start()
+    torrent = tmp_path / "t.torrent"
+    torrent.write_bytes(meta.to_torrent_bytes())
+
+    fake = collections.namedtuple("usage", "total used free")(100, 90, 10)
+    monkeypatch.setattr(shutil, "disk_usage", lambda _p: fake)
+    try:
+        with pytest.raises(TorrentError, match="insufficient disk space"):
+            await TorrentClient().download(
+                str(torrent), str(tmp_path / "dl"),
+                peers=[Peer("127.0.0.1", port)], listen=False,
+            )
+    finally:
+        await seeder.stop()
