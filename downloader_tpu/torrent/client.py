@@ -920,10 +920,16 @@ class TorrentClient:
         reader, writer = await self._open_stream(peer_addr)
         if use_mse:
             try:
-                reader, writer, _method = await mse.initiate(
-                    reader, writer, info_hash,
-                    allow_plaintext=self.crypto != "require",
-                )
+                # bound the whole exchange with the connect budget: a peer
+                # that reads our DH bytes but never answers (e.g. a
+                # plaintext-only implementation waiting for more
+                # "handshake") must not pin the dial for the full
+                # mse.HANDSHAKE_TIMEOUT
+                async with asyncio.timeout(CONNECT_TIMEOUT):
+                    reader, writer, _method = await mse.initiate(
+                        reader, writer, info_hash,
+                        allow_plaintext=self.crypto != "require",
+                    )
             except (mse.MSEError, EOFError, ConnectionError,
                     TimeoutError) as err:
                 writer.close()

@@ -1040,9 +1040,17 @@ async def test_choke_cycle_rejects_do_not_strip_pieces(swarm, tmp_path):
         tf = tmp_path / "churn2.torrent"
         tf.write_bytes(swarm.meta.to_torrent_bytes())
         dest = str(tmp_path / "dl-churn")
-        got = await TorrentClient().download(
+        # listen=False: with a serve socket up, the client re-announces to
+        # the fixture's tracker and can discover the swarm's FULL seeder
+        # mid-download — splitting requests so the churner never reaches
+        # its 7th request (this was a ~4% suite flake)
+        # crypto=plaintext: the raw fixture can't speak MSE, and the
+        # prefer-mode first dial can deadlock against it for the whole
+        # handshake timeout (the fixture blocks mid-"handshake" on DH
+        # bytes) — this test is about choke semantics, not MSE
+        got = await TorrentClient(crypto="plaintext").download(
             str(tf), dest, peers=[Peer("127.0.0.1", port)],
-            stall_timeout=20,
+            stall_timeout=20, listen=False,
         )
         assert got.info_hash == swarm.meta.info_hash
         assert choke_cycles[0] >= 1, "fixture never actually churned"
