@@ -34,6 +34,7 @@ import zlib
 import aiohttp
 
 from .. import schemas
+from ..utils.disk import ensure_disk_space as _ensure_disk_space
 from ..utils.watchdog import STALL_TIMEOUT_SECONDS, StallWatchdog
 from .base import Job, StageContext, StageFn
 
@@ -65,18 +66,6 @@ def _is_encoded(headers) -> bool:
     ).strip().lower() not in ("", "identity")
 
 
-def _ensure_disk_space(dirpath: str, needed: int) -> None:
-    """Fail fast with a clear error instead of ENOSPC mid-transfer."""
-    import shutil
-
-    if needed <= 0:
-        return
-    free = shutil.disk_usage(dirpath).free
-    if needed > free:
-        raise OSError(
-            f"insufficient disk space: download needs {needed} more "
-            f"bytes, volume has {free} free"
-        )
 
 
 def choose_validator(headers) -> "str | None":
@@ -485,7 +474,6 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         or total_len < SEG_MIN_SIZE):
                     return None
                 await probe.read()
-            _ensure_disk_space(download_path, total_len)
 
             # segments are [start, pos, end): pos = next absolute byte
             segments = None
@@ -516,6 +504,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     [lo, lo, min(lo + span, total_len)]
                     for lo in range(0, total_len, span)
                 ]
+            # preflight AFTER the checkpoint: resumed bytes are credit,
+            # or a resumable 80%-done download would fail forever on a
+            # volume that can easily hold the remainder
+            _ensure_disk_space(
+                download_path,
+                total_len - sum(s[1] - s[0] for s in segments),
+            )
             logger.info(
                 "http: segmented download", segments=len(segments),
                 total=total_len,

@@ -349,25 +349,19 @@ class TorrentClient:
     def _preflight_disk(storage: TorrentStorage) -> None:
         """Fail fast with a clear error when the volume can't hold the
         torrent — losing a multi-GB transfer to ENOSPC at piece N is the
-        worst way to find out.  Bytes already on disk count as credit
-        (resume), and preallocation is sparse so this is the only check.
+        worst way to find out.  ALLOCATED bytes count as resume credit:
+        preallocation sparse-truncates files to full apparent size, so
+        ``st_size`` would claim a crashed first attempt already holds
+        everything and reduce this check to a no-op on every retry.
         """
-        import shutil as _shutil
+        from ..utils.disk import allocated_bytes, ensure_disk_space
 
-        have = 0
-        for entry in storage.meta.files:
-            try:
-                have += os.path.getsize(storage.file_path(entry.path))
-            except OSError:
-                pass
-        needed = storage.meta.total_length - have
+        have = sum(
+            allocated_bytes(storage.file_path(entry.path))
+            for entry in storage.meta.files
+        )
         os.makedirs(storage.root, exist_ok=True)
-        free = _shutil.disk_usage(storage.root).free
-        if needed > free:
-            raise TorrentError(
-                f"insufficient disk space: torrent needs {needed} more "
-                f"bytes, volume has {free} free"
-            )
+        ensure_disk_space(storage.root, storage.meta.total_length - have)
 
     @staticmethod
     def _swarm_stats(swarm: _Swarm, server) -> dict:

@@ -1,0 +1,44 @@
+"""Disk-space preflight shared by the download paths.
+
+Losing a multi-GB transfer to ENOSPC at the tail is the worst way to
+find out the volume is small — both the HTTP and torrent fetch paths
+check up front and fail with a clear, actionable error instead.  The
+``needed`` figure must already credit resumable bytes on disk (each
+caller knows its own resume accounting); for sparse preallocated files
+use :func:`allocated_bytes`, not ``st_size`` — a sparse truncate makes
+apparent size lie about what the volume actually holds.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class InsufficientDiskSpace(OSError):
+    """The target volume cannot hold the remaining transfer."""
+
+
+def allocated_bytes(path: str) -> int:
+    """Bytes actually backed by the volume (``st_blocks``), clamped to
+    apparent size — sparse preallocation inflates ``st_size`` without
+    consuming space, and filesystem metadata can inflate ``st_blocks``
+    past the data."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return 0
+    return min(st.st_blocks * 512, st.st_size)
+
+
+def ensure_disk_space(dirpath: str, needed: int) -> None:
+    """Raise :class:`InsufficientDiskSpace` unless ``dirpath``'s volume
+    has ``needed`` bytes free."""
+    if needed <= 0:
+        return
+    free = shutil.disk_usage(dirpath).free
+    if needed > free:
+        raise InsufficientDiskSpace(
+            f"insufficient disk space: download needs {needed} more "
+            f"bytes, volume has {free} free"
+        )

@@ -695,12 +695,7 @@ async def test_torrent_insufficient_disk_fails_fast(tmp_path, monkeypatch):
     import collections
     import shutil
 
-    from downloader_tpu.torrent import (
-        Seeder,
-        TorrentClient,
-        TorrentError,
-        make_metainfo,
-    )
+    from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
     from downloader_tpu.torrent.tracker import Peer
 
     src = tmp_path / "seed" / "payload"
@@ -715,10 +710,54 @@ async def test_torrent_insufficient_disk_fails_fast(tmp_path, monkeypatch):
     fake = collections.namedtuple("usage", "total used free")(100, 90, 10)
     monkeypatch.setattr(shutil, "disk_usage", lambda _p: fake)
     try:
-        with pytest.raises(TorrentError, match="insufficient disk space"):
+        with pytest.raises(OSError, match="insufficient disk space"):
             await TorrentClient().download(
                 str(torrent), str(tmp_path / "dl"),
                 peers=[Peer("127.0.0.1", port)], listen=False,
             )
     finally:
         await seeder.stop()
+
+
+async def test_segmented_resume_credits_done_bytes_in_preflight(
+        tmp_path, broker, range_server, small_segments, monkeypatch):
+    """An 80%-done segmented download on a nearly-full volume must still
+    resume: only the REMAINING bytes count against free space."""
+    import collections
+    import json as json_mod
+    import shutil
+
+    base, payload, _requests = range_server
+    target_dir = tmp_path / "downloads" / "job-1"
+    target_dir.mkdir(parents=True)
+    total = len(payload)
+    done = int(total * 0.8)
+    segments = [[0, done, total]]
+    body = bytearray(total)
+    body[:done] = payload[:done]
+    (target_dir / "file.mkv.partial-seg").write_bytes(bytes(body))
+    (target_dir / "file.mkv.partial-seg.state").write_text(json_mod.dumps({
+        "validator": ETAG, "total": total, "segments": segments,
+    }))
+
+    # free space holds the remainder but NOT the whole entity
+    fake = collections.namedtuple("usage", "total used free")(
+        total * 2, total, total - done + 4096)
+    monkeypatch.setattr(shutil, "disk_usage", lambda _p: fake)
+    stage = await make_stage(tmp_path, broker)
+    await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+    assert (target_dir / "file.mkv").read_bytes() == payload
+
+
+def test_allocated_bytes_sees_through_sparse_files(tmp_path):
+    """Sparse preallocation must not count as resume credit."""
+    from downloader_tpu.utils.disk import allocated_bytes
+
+    sparse = tmp_path / "sparse.bin"
+    with open(sparse, "wb") as fh:
+        fh.truncate(1 << 20)
+    dense = tmp_path / "dense.bin"
+    dense.write_bytes(b"x" * (1 << 20))
+    assert allocated_bytes(str(sparse)) < (1 << 16)
+    assert allocated_bytes(str(dense)) >= (1 << 20) - 4096
+    assert allocated_bytes(str(tmp_path / "missing")) == 0
