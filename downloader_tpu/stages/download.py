@@ -23,6 +23,7 @@ Behavioral parity with /root/reference/lib/download.js:
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import os
 import posixpath
@@ -516,19 +517,38 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 total=total_len,
             )
 
-            def _save_state() -> None:
+            def _write_state(blob: dict) -> None:
                 tmp = seg_state_path + ".tmp"
                 with open(tmp, "w") as fh:
-                    json.dump({
-                        "validator": validator,
-                        "total": total_len,
-                        "segments": segments,
-                    }, fh)
+                    json.dump(blob, fh)
                 os.replace(tmp, seg_state_path)
 
-            with open(seg_partial, "ab") as fh:
-                fh.truncate(total_len)
-            _save_state()
+            # one dedicated writer thread: pwrites and state checkpoints
+            # leave the event loop (a contended volume must not stall
+            # heartbeats/other jobs), stay ordered (single worker, so a
+            # cancelled checkpoint write can never interleave with the
+            # final one on the same tmp path), and can be drained to
+            # completion before the fd closes — a plain to_thread write
+            # cancelled mid-flight would keep running unsupervised
+            io_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            loop = asyncio.get_running_loop()
+
+            async def _save_state() -> None:
+                # snapshot on the loop thread (segment tasks mutate
+                # ``seg[1]`` between awaits), write in the worker
+                blob = {
+                    "validator": validator,
+                    "total": total_len,
+                    "segments": [list(s) for s in segments],
+                }
+                await loop.run_in_executor(io_pool, _write_state, blob)
+
+            def _truncate() -> None:
+                with open(seg_partial, "ab") as fh:
+                    fh.truncate(total_len)
+
+            await loop.run_in_executor(io_pool, _truncate)
+            await _save_state()
             fd = os.open(seg_partial, os.O_WRONLY)
 
             async def _segment(seg) -> None:
@@ -563,7 +583,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             # never write past our segment: a peer
                             # segment owns the bytes after seg[2]
                             data = raw[:seg[2] - seg[1]]
-                            os.pwrite(fd, data, seg[1])
+                            await loop.run_in_executor(
+                                io_pool, os.pwrite, fd, data, seg[1])
                             seg[1] += len(data)
                             if len(data) < len(raw):
                                 break  # server over-delivered; done
@@ -577,7 +598,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             async def _checkpoint() -> None:
                 while True:
                     await asyncio.sleep(SEG_STATE_INTERVAL)
-                    _save_state()
+                    await _save_state()
 
             saver = asyncio.create_task(_checkpoint())
             tasks = [asyncio.create_task(_segment(s)) for s in segments]
@@ -596,10 +617,19 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 saver.cancel()
                 await asyncio.gather(saver, return_exceptions=True)
                 try:
-                    _save_state()
+                    await _save_state()
                 except OSError:
                     pass
-                os.close(fd)
+                finally:
+                    # drain any write a cancelled task left running in
+                    # the pool BEFORE the fd closes.  Synchronous on
+                    # purpose: this must run even when this task itself
+                    # is being cancelled (another await here could be
+                    # interrupted again, leaking the fd and the thread);
+                    # the pending work is page-cache writes, so the
+                    # brief loop stall is confined to error teardown.
+                    io_pool.shutdown(wait=True)
+                    os.close(fd)
             os.replace(seg_partial, output)
             try:
                 os.remove(seg_state_path)

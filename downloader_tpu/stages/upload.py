@@ -112,11 +112,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if await _already_staged(store, name, file_path):
                     logger.info("already staged, skipping", file=file_path)
                 else:
-                    await store.fput_object(STAGING_BUCKET, name, file_path)
+                    # size BEFORE the put: consume=True permits the
+                    # backend to take the path destructively
+                    size = os.path.getsize(file_path)
+                    # consume=True: the staged file is deleted with the
+                    # whole download dir right after this stage
+                    # (reference lib/upload.js:60-64), so the store may
+                    # ingest it by hardlink instead of a byte copy
+                    await store.fput_object(
+                        STAGING_BUCKET, name, file_path, consume=True)
                     if ctx.metrics is not None:
-                        ctx.metrics.bytes_uploaded.inc(
-                            os.path.getsize(file_path)
-                        )
+                        ctx.metrics.bytes_uploaded.inc(size)
 
                 # upload occupies the 50-100% progress band
                 # (reference lib/upload.js:48)

@@ -61,8 +61,14 @@ class ObjectStore(abc.ABC):
         (reference lib/download.js:225)."""
 
     @abc.abstractmethod
-    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
-        """Upload a local file as an object (reference lib/upload.js:45)."""
+    async def fput_object(self, bucket: str, name: str, file_path: str,
+                          *, consume: bool = False) -> None:
+        """Upload a local file as an object (reference lib/upload.js:45).
+
+        ``consume=True`` is the caller's promise that it will neither
+        mutate nor rely on ``file_path`` after the call — backends may
+        then ingest destructively (e.g. by hardlink) instead of copying.
+        The default is the safe byte copy."""
 
     @abc.abstractmethod
     def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:

@@ -9,6 +9,7 @@ a MinIO server, and for tests that want to inspect staged bytes on disk.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import os
 import shutil
 from typing import AsyncIterator
@@ -24,20 +25,24 @@ def _safe_parts(name: str) -> list:
 
 
 class FilesystemObjectStore(ObjectStore):
-    """``link_puts`` (default True) lets :meth:`fput_object` ingest a
-    same-filesystem source by hardlink instead of a byte copy — O(1)
-    instead of O(size), which roughly halves end-to-end staging time (the
-    upload stage was the pipeline's most expensive hop).  The contract:
-    a source handed to ``fput_object`` is a staging artifact the caller
-    stops mutating after the call (the upload stage deletes its download
-    directory right afterwards, reference lib/upload.js:60-64).  Objects
-    themselves are always replaced atomically, never edited in place, so
-    linking never aliases store-side writes.  Cross-device sources (or
-    filesystems without hardlinks) transparently fall back to a copy."""
+    """:meth:`fput_object` can ingest a same-filesystem source by
+    hardlink instead of a byte copy — O(1) instead of O(size), which
+    roughly halves end-to-end staging time (the upload stage was the
+    pipeline's most expensive hop).  Linking requires BOTH the per-call
+    ``consume=True`` (the caller's promise it stops mutating the source,
+    e.g. the upload stage, which deletes its download directory right
+    afterwards — reference lib/upload.js:60-64) AND the store-level
+    ``link_puts`` switch (default True); a plain ``fput_object`` always
+    byte-copies, so callers that keep using the source cannot silently
+    alias the stored object.  Objects themselves are always replaced
+    atomically, never edited in place, so linking never aliases
+    store-side writes.  Cross-device sources (or filesystems without
+    hardlinks) transparently fall back to a copy."""
 
     def __init__(self, root: str, link_puts: bool = True):
         self.root = os.path.abspath(root)
         self.link_puts = link_puts
+        self._tmp_seq = itertools.count()
         os.makedirs(self.root, exist_ok=True)
 
     def _bucket_path(self, bucket: str) -> str:
@@ -71,10 +76,16 @@ class FilesystemObjectStore(ObjectStore):
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
         await asyncio.to_thread(shutil.copyfile, src, file_path)
 
-    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fput_object(self, bucket: str, name: str, file_path: str,
+                          *, consume: bool = False) -> None:
         dst = self._object_path(bucket, name)
         await asyncio.to_thread(
-            _ingest_file_atomic, file_path, dst, self.link_puts
+            _ingest_file_atomic, file_path, dst,
+            self.link_puts and consume,
+            # pid+counter: two concurrent puts of the same key in one
+            # process must not share a tmp name (unlink/link/replace
+            # would race and one put would die with FileNotFoundError)
+            f"{os.getpid()}.{next(self._tmp_seq)}",
         )
 
     async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
@@ -122,20 +133,26 @@ def _write_file_atomic(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
-def _ingest_file_atomic(src: str, dst: str, link_ok: bool) -> None:
+def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str) -> None:
     os.makedirs(os.path.dirname(dst), exist_ok=True)
-    tmp = f"{dst}.tmp.{os.getpid()}"
+    tmp = f"{dst}.tmp.{suffix}"
     try:
-        os.unlink(tmp)  # leftover from a crashed run would fail os.link
-    except FileNotFoundError:
-        pass
-    if link_ok:
-        try:
-            os.link(src, tmp)
-        except OSError:
-            # cross-device (EXDEV), no-hardlink fs (EPERM), link cap
-            # (EMLINK): fall through to the byte copy
+        if link_ok:
+            try:
+                os.link(src, tmp)
+            except OSError:
+                # cross-device (EXDEV), no-hardlink fs (EPERM), link cap
+                # (EMLINK): fall through to the byte copy
+                shutil.copyfile(src, tmp)
+        else:
             shutil.copyfile(src, tmp)
-    else:
-        shutil.copyfile(src, tmp)
-    os.replace(tmp, dst)
+        os.replace(tmp, dst)
+    except BaseException:
+        # tmp names are unique per call, so a failed put (ENOSPC, kill
+        # signal unwinding) must remove its own leftover — nothing will
+        # ever reuse the name, and list_objects would enumerate it
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

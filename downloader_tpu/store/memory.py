@@ -44,7 +44,8 @@ class InMemoryObjectStore(ObjectStore):
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
         await asyncio.to_thread(_write_file, file_path, data)
 
-    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fput_object(self, bucket: str, name: str, file_path: str,
+                          *, consume: bool = False) -> None:
         data = await asyncio.to_thread(_read_file, file_path)
         await self.put_object(bucket, name, data)
 

@@ -242,7 +242,8 @@ class S3ObjectStore(ObjectStore):
         finally:
             resp.release()
 
-    async def fput_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fput_object(self, bucket: str, name: str, file_path: str,
+                          *, consume: bool = False) -> None:
         """Upload a file from disk.
 
         Small files go up as one streaming PUT with an UNSIGNED-PAYLOAD

@@ -227,6 +227,9 @@ class UtpConnection:
         self._last_ack_seen = -1
 
         self._ack_scheduled = False
+        self._quenched_peer = False  # we advertised < one packet of room
+        self._wnd_update_at = 0.0
+        self._probe_at = 0.0
         self._last_recv = time.monotonic()
         self._closing = False  # FIN queued/sent
         self._closed = False  # fully torn down
@@ -269,6 +272,8 @@ class UtpConnection:
         if now - self._last_recv > IDLE_TIMEOUT:
             self.abort(ConnectionResetError("uTP idle timeout"))
             return
+        if self._connected.is_set():
+            self._check_zero_window(now)
         if not self._inflight:
             return
         oldest = min(self._inflight.values(), key=lambda p: p.sent_at)
@@ -288,6 +293,37 @@ class UtpConnection:
                 pkt.need_resend = True
                 self._resend.append(pkt)
         self._transmit(oldest)
+
+    def _check_zero_window(self, now: float) -> None:
+        """Break the mutual zero-window stall.
+
+        Acks are only ever sent in response to data, so once the receiver
+        advertises wnd=0 and the sender's flight drains, neither side has
+        a reason to transmit again — without this, the connection sits
+        dead until IDLE_TIMEOUT.  Two complementary escapes:
+
+        - receiver side: we quenched the sender (advertised < one packet)
+          but the consumer has since drained the buffer — send an
+          unsolicited ST_STATE carrying the reopened window.
+        - sender side: the peer advertises no room and nothing is in
+          flight — probe with ONE packet past the window (RTO-paced, like
+          a TCP window probe); the forced ack carries the peer's current
+          window even if the probe itself is dropped at the backstop.
+        """
+        if (self._quenched_peer
+                and self._recv_window() >= MAX_PAYLOAD
+                and now - self._wnd_update_at >= max(self._rto, MIN_RTO)):
+            # repeat RTO-paced until data flows again (_handle_data
+            # disarms the flag): the update is a bare UDP datagram, and
+            # a one-shot that gets dropped would re-create the very
+            # stall it exists to break
+            self._wnd_update_at = now
+            self._send_ack()
+        if (self._send_buf and not self._inflight
+                and self._peer_wnd < MAX_PAYLOAD
+                and now - self._probe_at >= max(self._rto, MIN_RTO)):
+            self._probe_at = now
+            self._send_next_chunk()
 
     # -- connect (initiator side) --------------------------------------
     def send_syn(self) -> None:
@@ -365,6 +401,9 @@ class UtpConnection:
             self._send_ack()
 
     def _handle_data(self, ptype: int, seq: int, payload: bytes) -> None:
+        # data arriving means the sender knows our window again; if the
+        # consumer stalls once more, _recv_window re-arms the flag
+        self._quenched_peer = False
         # hard backstop behind the advertised window: a sender that
         # ignores flow control must not balloon the reader buffer (the
         # dropped packet goes unacked, so a compliant-after-all sender
@@ -511,19 +550,23 @@ class UtpConnection:
                 self._transmit(pkt)
         window = min(self._cwnd, self._peer_wnd)
         while self._send_buf and self._flight_bytes < window:
-            chunk = bytes(self._send_buf[:MAX_PAYLOAD])
-            del self._send_buf[:len(chunk)]
-            pkt = _Inflight(self._seq, ST_DATA, chunk)
-            self._inflight[self._seq] = pkt
-            self._order.append(self._seq)
-            self._seq = (self._seq + 1) & 0xFFFF
-            self._flight_bytes += len(chunk)
-            self._transmit(pkt)
+            self._send_next_chunk()
         if self._send_buf_low():
             self._send_lo.set()
         if (self._closing and not self._send_buf
                 and self._fin_seq is None):
             self._send_fin()
+
+    def _send_next_chunk(self) -> None:
+        """Packetize and transmit one chunk off the send buffer."""
+        chunk = bytes(self._send_buf[:MAX_PAYLOAD])
+        del self._send_buf[:len(chunk)]
+        pkt = _Inflight(self._seq, ST_DATA, chunk)
+        self._inflight[self._seq] = pkt
+        self._order.append(self._seq)
+        self._seq = (self._seq + 1) & 0xFFFF
+        self._flight_bytes += len(chunk)
+        self._transmit(pkt)
 
     def _sack_mask(self) -> bytes:
         if not self._ooo:
@@ -547,7 +590,10 @@ class UtpConnection:
         # StreamReader buffers internally; advertise the remaining slack
         # so a stalled consumer eventually quenches the sender
         buffered = len(self.reader._buffer)  # noqa: SLF001 - stdlib attr
-        return max(RECV_WINDOW - buffered, 0)
+        wnd = max(RECV_WINDOW - buffered, 0)
+        if wnd < MAX_PAYLOAD:
+            self._quenched_peer = True
+        return wnd
 
     def _transmit(self, pkt: _Inflight) -> None:
         pkt.sent_at = time.monotonic()

@@ -28,7 +28,10 @@ def allocated_bytes(path: str) -> int:
         st = os.stat(path)
     except OSError:
         return 0
-    return min(st.st_blocks * 512, st.st_size)
+    blocks = getattr(st, "st_blocks", None)  # absent on e.g. Windows
+    if blocks is None:
+        return st.st_size
+    return min(blocks * 512, st.st_size)
 
 
 def ensure_disk_space(dirpath: str, needed: int) -> None:

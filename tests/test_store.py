@@ -90,20 +90,58 @@ async def test_fs_rejects_traversal(tmp_path):
 
 
 async def test_fput_hardlinks_same_filesystem(tmp_path):
-    """Same-fs fput ingests by hardlink (O(1), the staging hot path)."""
+    """Same-fs fput with consume=True ingests by hardlink (O(1), the
+    staging hot path)."""
     import os
 
     fs = FilesystemObjectStore(str(tmp_path / "objects"))
     src = tmp_path / "src.bin"
     src.write_bytes(b"y" * 4096)
     await fs.make_bucket("b")
-    await fs.fput_object("b", "linked", str(src))
+    await fs.fput_object("b", "linked", str(src), consume=True)
     obj = tmp_path / "objects" / "b" / "linked"
     assert obj.read_bytes() == b"y" * 4096
     assert os.stat(obj).st_ino == os.stat(src).st_ino
     # deleting the source must not disturb the stored object
     src.unlink()
     assert obj.read_bytes() == b"y" * 4096
+
+
+async def test_fput_without_consume_copies(tmp_path):
+    """The default fput byte-copies: a caller that keeps mutating the
+    source must not alias the stored object (advisor finding r2)."""
+    import os
+
+    fs = FilesystemObjectStore(str(tmp_path / "objects"))
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"v1" * 2048)
+    await fs.make_bucket("b")
+    await fs.fput_object("b", "obj", str(src))
+    obj = tmp_path / "objects" / "b" / "obj"
+    assert os.stat(obj).st_ino != os.stat(src).st_ino
+    src.write_bytes(b"v2" * 2048)  # mutate after put
+    assert obj.read_bytes() == b"v1" * 2048
+
+
+async def test_fput_concurrent_same_key(tmp_path):
+    """Concurrent puts of one key in one process must all succeed (the
+    per-call tmp suffix keeps the unlink/link/replace sequences from
+    racing on a shared pid-suffixed name)."""
+    import asyncio
+
+    fs = FilesystemObjectStore(str(tmp_path / "objects"))
+    await fs.make_bucket("b")
+    sources = []
+    for i in range(8):
+        src = tmp_path / f"src{i}.bin"
+        src.write_bytes(bytes([i]) * 4096)
+        sources.append(str(src))
+    await asyncio.gather(*(
+        fs.fput_object("b", "same-key", path, consume=True)
+        for path in sources
+    ))
+    data = await fs.get_object("b", "same-key")
+    assert len(data) == 4096 and data == data[:1] * 4096
 
 
 async def test_fput_falls_back_to_copy_when_link_fails(tmp_path, monkeypatch):
@@ -121,7 +159,7 @@ async def test_fput_falls_back_to_copy_when_link_fails(tmp_path, monkeypatch):
     src = tmp_path / "src.bin"
     src.write_bytes(b"z" * 4096)
     await fs.make_bucket("b")
-    await fs.fput_object("b", "copied", str(src))
+    await fs.fput_object("b", "copied", str(src), consume=True)
     obj = tmp_path / "objects" / "b" / "copied"
     assert obj.read_bytes() == b"z" * 4096
     assert os.stat(obj).st_ino != os.stat(src).st_ino
@@ -134,7 +172,7 @@ async def test_fput_link_puts_disabled(tmp_path):
     src = tmp_path / "src.bin"
     src.write_bytes(b"w" * 1024)
     await fs.make_bucket("b")
-    await fs.fput_object("b", "obj", str(src))
+    await fs.fput_object("b", "obj", str(src), consume=True)
     obj = tmp_path / "objects" / "b" / "obj"
     assert obj.read_bytes() == b"w" * 1024
     assert os.stat(obj).st_ino != os.stat(src).st_ino

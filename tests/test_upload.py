@@ -127,9 +127,9 @@ async def test_resume_skips_already_staged_files(store, broker, tmp_path):
     puts = []
     original_fput = store.fput_object
 
-    async def spying_fput(bucket, name, file_path):
+    async def spying_fput(bucket, name, file_path, *, consume=False):
         puts.append(name)
-        await original_fput(bucket, name, file_path)
+        await original_fput(bucket, name, file_path, consume=consume)
 
     store.fput_object = spying_fput
     await upload(job)
@@ -195,9 +195,9 @@ async def test_resume_never_skips_without_etag(store, broker, tmp_path):
     puts = []
     original_fput = store.fput_object
 
-    async def spying_fput(bucket, name, file_path):
+    async def spying_fput(bucket, name, file_path, *, consume=False):
         puts.append(name)
-        await original_fput(bucket, name, file_path)
+        await original_fput(bucket, name, file_path, consume=consume)
 
     store.fput_object = spying_fput
     await upload(job)

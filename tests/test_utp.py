@@ -199,6 +199,52 @@ async def test_close_delivers_eof():
         server.close()
 
 
+async def test_zero_window_recovery(monkeypatch):
+    """A slow consumer that fills the receive window must not deadlock.
+
+    Once the receiver advertises wnd=0 and the sender's flight drains,
+    acks (which are only sent in response to data) stop flowing in both
+    directions; without the zero-window probe / unsolicited window
+    update, the connection would sit dead until IDLE_TIMEOUT (300 s).
+    The test drives the connection into exactly that state, then lets
+    the consumer drain and requires completion orders of magnitude
+    faster than the idle timeout."""
+    from downloader_tpu.torrent import utp as utp_mod
+
+    monkeypatch.setattr(utp_mod, "RECV_WINDOW", 64 << 10)
+    release = asyncio.Event()
+    got = bytearray()
+    done = asyncio.Event()
+
+    async def handler(reader, writer):
+        await release.wait()
+        while True:
+            chunk = await reader.read(1 << 16)
+            if not chunk:
+                break
+            got.extend(chunk)
+        done.set()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        payload = os.urandom(512 << 10)
+        reader, writer = await open_utp_connection(*server.local_addr)
+        conn = writer._conn
+        writer.write(payload)
+        async with asyncio.timeout(30):
+            # the deadlock state: peer quenched us, nothing in flight,
+            # bytes still waiting to be sent
+            while not (conn._peer_wnd < utp_mod.MAX_PAYLOAD
+                       and not conn._inflight and conn._send_buf):
+                await asyncio.sleep(0.02)
+            release.set()
+            writer.close()
+            await done.wait()
+        assert bytes(got) == payload
+    finally:
+        server.close()
+
+
 async def test_transfer_over_ipv6():
     """Trackers/PEX hand out IPv6 peers (BEP 7); the uTP dial must work
     there too.  The 4-tuple IPv6 addr normalizes to (host, port) for the
