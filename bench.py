@@ -29,11 +29,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Harness version: bump when the measurement harness itself changes so
 # cross-round comparisons stay apples-to-apples (BASELINE.md).
-# v3: compute-bench feedback changed from strided-downsample to scalar
-# (the gather charged ~20 ms/step of harness work to the model at 720p);
-# the staging-pipeline harness is unchanged from v2, so MB/s numbers
-# remain comparable with r01/r02.
-HARNESS_VERSION = 3
+# v4 (r3):
+#  - compute bench times the upscale STAGE's exact computation (chroma
+#    upsample -> YCbCr->RGB -> model -> RGB->YCbCr -> quantize u8) with
+#    the step feedback summed THROUGH the nonlinear quantize.  v3 timed
+#    the bare model with a scalar-slice feedback, which lets XLA elide
+#    algebraically-transparent tails (slice-through-transpose removes
+#    the pixel shuffle; r3 measurements showed isolated ops "timed" at
+#    2x over chip peak) — v3 fps numbers are NOT comparable.
+#  - staging reports median + spread over reps (best-of-N alone cannot
+#    resolve wins inside the host's ±20% noise band) plus CPU-seconds
+#    per staged GB as a host-noise-immune secondary.
+#  - torrent transports all move the same payload size.
+HARNESS_VERSION = 4
 
 # Self-baseline (MB/s): the round-1 number measured with THIS harness
 # version (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -94,6 +102,7 @@ async def _one_rep(port: int) -> float:
 
 
 async def bench_pipeline():
+    import statistics
     import tempfile
 
     from aiohttp import web
@@ -118,77 +127,103 @@ async def bench_pipeline():
     await site.start()
     port = site._server.sockets[0].getsockname()[1]
 
+    elapsed = []
+    cpu = []
     try:
-        elapsed = min([await _one_rep(port) for _ in range(REPS)])
+        for _ in range(REPS):
+            cpu0 = time.process_time()
+            elapsed.append(await _one_rep(port))
+            cpu.append(time.process_time() - cpu0)
     finally:
         await runner.cleanup()
         os.unlink(path)
         os.rmdir(tmp)
 
     total_mb = JOBS * MIB_PER_JOB * (1 << 20) / 1e6
+    med = statistics.median(elapsed)
+    # CPU-seconds per staged GB: the host-noise-immune secondary — wall
+    # time on this shared VM swings ±20%, but cycles spent per byte do
+    # not depend on how much the neighbors are stealing
+    cpu_s_per_gb = statistics.median(cpu) / (total_mb / 1e3)
     return {
-        "mbps": total_mb / elapsed,
-        "jobs_per_min": JOBS / elapsed * 60,
-        "elapsed_s": elapsed,
+        "mbps": total_mb / med,
+        "mbps_best": total_mb / min(elapsed),
+        "mbps_spread": [round(total_mb / max(elapsed), 1),
+                        round(total_mb / min(elapsed), 1)],
+        "reps": REPS,
+        "cpu_s_per_gb": round(cpu_s_per_gb, 3),
+        "jobs_per_min": JOBS / med * 60,
+        "elapsed_s": med,
     }
 
 
 _COMPUTE_SNIPPET = """
 import json, time
+import numpy as np
 import jax
 import jax.numpy as jnp
-from downloader_tpu.compute.models.upscaler import UpscalerConfig, init_params
 from downloader_tpu.compute.pipeline import (
-    device_peak_tflops, upscaler_flops_per_frame,
+    FrameUpscaler, device_peak_tflops, upscaler_flops_per_frame,
 )
 
-config = UpscalerConfig()
-rng = jax.random.PRNGKey(0)
-model, params = init_params(rng, config, sample_shape=(1, 32, 32, 3))
+# Harness v4: time the upscale STAGE's exact computation — the jitted
+# (params, y, cb, cr) -> uint8 planes function the pipeline dispatches —
+# not the bare model.  The whole dependent chain runs ON DEVICE via
+# lax.scan (one dispatch instead of iters round-trips; over the tunneled
+# TPU each dispatch costs ~1 s of RPC latency, which is NOT chip
+# throughput).  The feedback between steps is a SUM of all three output
+# planes folded into the next input: a sum cannot be pushed through the
+# nonlinear quantize (clip/round), so nothing upstream can be elided —
+# v3's scalar-slice feedback let XLA remove algebraically-transparent
+# tails (slice-through-transpose deletes the pixel shuffle), and
+# isolated ops "measured" above chip peak.
+engine = FrameUpscaler(batch=8, use_mesh=False)
+params = engine.params
+rng = np.random.default_rng(0)
 
 
 def measure(batch, h, w, iters, reps=4):
-    # the whole dependent iteration chain runs ON DEVICE via lax.scan: one
-    # dispatch instead of iters round-trips (over a tunneled TPU each
-    # dispatch costs ~1s of RPC latency, which is NOT chip throughput).
-    # A SCALAR of each step's output feeds the next input, so steps stay
-    # sequentially dependent (no hoisting, no overlap) without charging
-    # harness work to the model: the old harness (v2) fed the strided
-    # downsample out[:, ::2, ::2, :] back in, and that gather alone cost
-    # ~20 ms/step at 720p — a fifth of the reported time was harness.
-    frames = jax.random.uniform(rng, (batch, h, w, 3), jnp.float32)
+    fn = engine._compiled(2, 2)  # 4:2:0, the stage's common path
+    y0 = jnp.asarray(rng.integers(0, 256, (batch, h, w), np.uint8))
+    cb0 = jnp.asarray(rng.integers(0, 256, (batch, h // 2, w // 2), np.uint8))
+    cr0 = jnp.asarray(rng.integers(0, 256, (batch, h // 2, w // 2), np.uint8))
 
-    def rollout(p, x0):
-        def step(x, _):
-            out = model.apply(p, x)
-            return x + out.ravel()[0].astype(x.dtype), ()
-        final, _ = jax.lax.scan(step, x0, None, length=iters)
-        # reduce to a scalar on device: fetching 4 bytes forces the full
-        # computation without timing a multi-MB transfer over the tunnel
-        # (block_until_ready is unreliable on the tunneled backend)
-        return jnp.sum(final)
+    def rollout(p, y, cb, cr):
+        def step(s, _):
+            y2, cb2, cr2 = fn(p, y + s, cb + s, cr + s)
+            total = (jnp.sum(y2, dtype=jnp.int32)
+                     + jnp.sum(cb2, dtype=jnp.int32)
+                     + jnp.sum(cr2, dtype=jnp.int32))
+            return total.astype(jnp.uint8), ()
+        final, _ = jax.lax.scan(step, jnp.uint8(0), None, length=iters)
+        # fetching one byte forces the chain (block_until_ready is
+        # unreliable on the tunneled backend)
+        return final
 
-    fn = jax.jit(rollout)
-    jax.device_get(fn(params, frames))  # compile + first run
+    run = jax.jit(rollout)
+    jax.device_get(run(params, y0, cb0, cr0))  # compile + first run
     best = None
     for _ in range(reps):
         start = time.monotonic()
-        jax.device_get(fn(params, frames))
+        jax.device_get(run(params, y0, cb0, cr0))
         dt = time.monotonic() - start
         best = dt if best is None else min(best, dt)
     return batch * iters / best
 
 
 out = {"backend": jax.default_backend()}
-# r01-shape (180p -> 360p, 16-frame batch); harness v3 numbers are higher
-# than v2 at equal model speed (see HARNESS_VERSION note)
 out["upscaler_fps_180p_to_360p"] = measure(16, 180, 320, 40)
+# batch 8 = the upscale stage's default; the combined-pipeline bench
+# runs at batch 8, so its overlap ratio needs this as the denominator
+out["upscaler_fps_180p_b8"] = measure(8, 180, 320, 40)
 
-# MFU at a realistic shape: 8 x 720p bf16 frames -> 1440p.  The flops
-# model counts conv MACs x2 (the MXU work) only; peak is the chip's
-# published dense-bf16 number, so mfu is the honest fraction-of-peak.
+# MFU at a realistic shape: 8 x 720p 4:2:0 frames -> 1440p.  The flops
+# model counts conv MACs x2 (the MXU work) only, while the measured time
+# includes the stage's colorspace/quantize overhead — so mfu is the
+# honest, conservative fraction-of-peak for the computation the service
+# actually runs.
 fps_720 = measure(8, 720, 1280, 15)
-flop_per_frame = upscaler_flops_per_frame(config, 720, 1280)
+flop_per_frame = upscaler_flops_per_frame(engine.config, 720, 1280)
 tflops = fps_720 * flop_per_frame / 1e12
 device_kind = jax.devices()[0].device_kind
 peak = device_peak_tflops(device_kind)
@@ -233,10 +268,128 @@ def bench_compute(timeout_s: float = 420.0):
         return {"error": f"compute bench bad output: {proc.stdout[:200]!r}"}
 
 
-async def bench_torrent(mib: int = 64) -> dict:
+_UPSCALE_PIPELINE_SNIPPET = """
+import asyncio, json, os, tempfile, time
+import numpy as np
+
+
+async def main():
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.app import build_service
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+    from downloader_tpu.compute.video import Y4MHeader, Y4MWriter
+    from downloader_tpu.mq import InMemoryBroker
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.store import FilesystemObjectStore
+
+    jobs = int(os.environ.get("BENCH_UPSCALE_JOBS", 2))
+    frames = int(os.environ.get("BENCH_UPSCALE_FRAMES", 256))
+    h, w = 180, 320
+    tmp = tempfile.mkdtemp()
+    src = os.path.join(tmp, "clip.y4m")
+    rng = np.random.default_rng(0)
+    with open(src, "wb") as fh:
+        writer = Y4MWriter(fh, Y4MHeader(width=w, height=h))
+        for _ in range(frames):
+            writer.write_frame(
+                rng.integers(0, 256, (h, w), dtype=np.uint8),
+                rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+                rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+            )
+    media_bytes = os.path.getsize(src)
+
+    app = web.Application()
+    app.router.add_get("/clip.y4m", lambda r: web.FileResponse(src))
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    config = ConfigNode({"instance": {
+        "download_path": os.path.join(tmp, "dl"),
+        "upscale": {"enabled": True, "batch": 8, "use_mesh": False},
+    }})
+    broker = InMemoryBroker()
+    store = FilesystemObjectStore(os.path.join(tmp, "store"))
+    orchestrator, metrics, telemetry = build_service(config, broker, store)
+
+    # pre-seed + warm the engine so the measured run times the pipeline,
+    # not JAX backend init and XLA compilation
+    from downloader_tpu.stages.upscale import _ENGINE_KEY
+
+    engine = FrameUpscaler(batch=8, use_mesh=False)
+    orchestrator.stage_resources[_ENGINE_KEY] = engine
+    engine.upscale_batch(
+        np.zeros((1, h, w), np.uint8),
+        np.zeros((1, h // 2, w // 2), np.uint8),
+        np.zeros((1, h // 2, w // 2), np.uint8), 2, 2)
+
+    await orchestrator.start()
+    started = time.monotonic()
+    for i in range(jobs):
+        msg = schemas.Download(media=schemas.Media(
+            id=f"up-{i}", creator_id=f"c{i}",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=f"http://127.0.0.1:{port}/clip.y4m"))
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+    await broker.join(schemas.DOWNLOAD_QUEUE, timeout=600)
+    wall = time.monotonic() - started
+    published = len(broker.published(schemas.CONVERT_QUEUE))
+    assert published == jobs, f"only {published}/{jobs} upscale jobs done"
+    await orchestrator.shutdown(grace_seconds=5)
+    await runner.cleanup()
+
+    total_frames = jobs * frames
+    print(json.dumps({
+        "upscale_pipeline_mbps": round(jobs * media_bytes / 1e6 / wall, 1),
+        "upscale_pipeline_fps": round(total_frames / wall, 1),
+        "upscale_pipeline_jobs": jobs,
+        "upscale_pipeline_frames": total_frames,
+        "upscale_pipeline_wall_s": round(wall, 2),
+    }))
+
+
+asyncio.run(main())
+"""
+
+
+def bench_upscale_pipeline(timeout_s: float = 420.0) -> dict:
+    """THE tpu-framework number: Y4M media jobs through the FULL
+    pipeline (download -> process -> upscale-on-device -> upload), one
+    system.  Runs in a subprocess like bench_compute (a wedged device
+    tunnel must not take the headline staging metric down)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _UPSCALE_PIPELINE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"upscale_pipeline_error": f"timed out after {timeout_s:.0f}s"}
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no output"]
+        return {"upscale_pipeline_error": tail[0][:200]}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"upscale_pipeline_error": f"bad output {proc.stdout[:200]!r}"}
+
+
+async def bench_torrent(mib: int = 32) -> dict:
     """Secondary: loopback swarm throughput (seeder -> leeching client,
-    real peer wire protocol, SHA-1 verification, disk on both ends) —
-    plaintext for r01 comparability, plus an MSE/RC4-encrypted run."""
+    real peer wire protocol, SHA-1 verification, disk on both ends).
+
+    All three transports move the SAME payload size so their fixed costs
+    amortize identically (r2 used 64/32/16 MiB, which biased exactly the
+    comparison the table invites)."""
     import tempfile
 
     from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
@@ -245,8 +398,8 @@ async def bench_torrent(mib: int = 64) -> dict:
     out = {}
     for crypto, transport, label, size in (
         ("plaintext", "tcp", "torrent_swarm_mbps", mib),
-        ("require", "tcp", "torrent_swarm_encrypted_mbps", mib // 2),
-        ("plaintext", "utp", "torrent_swarm_utp_mbps", mib // 4),
+        ("require", "tcp", "torrent_swarm_encrypted_mbps", mib),
+        ("plaintext", "utp", "torrent_swarm_utp_mbps", mib),
     ):
         with tempfile.TemporaryDirectory() as tmp:
             src_dir = os.path.join(tmp, "seed", "payload")
@@ -285,13 +438,29 @@ def main() -> None:
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
+        "mbps_best": round(pipeline["mbps_best"], 1),
+        "mbps_spread": pipeline["mbps_spread"],
+        "reps": pipeline["reps"],
+        "cpu_s_per_gb": pipeline["cpu_s_per_gb"],
         "jobs_per_min": round(pipeline["jobs_per_min"], 1),
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
         **_bench_torrent_safe(),
         **bench_compute(),
+        **bench_upscale_pipeline(),
     }
+    # device-busy overlap of the combined run: in-pipeline fps over
+    # pure-device fps at the same geometry INCLUDING batch (1.0 =
+    # device never starved)
+    if "upscale_pipeline_fps" in extra and extra.get("upscaler_fps_180p_b8"):
+        extra["upscale_pipeline_overlap"] = round(
+            extra["upscale_pipeline_fps"] / extra["upscaler_fps_180p_b8"], 3
+        )
+    # value = MEDIAN over reps (v4, robust); vs_baseline compares the
+    # BEST rep against the v2 freeze because SELF_BASELINE_MBPS was
+    # recorded best-of-5 — a median/best ratio would read as a 10-20%
+    # regression on this host's noise band when nothing changed
     value = round(pipeline["mbps"], 1)
     print(
         json.dumps(
@@ -299,7 +468,7 @@ def main() -> None:
                 "metric": "pipeline_staging_throughput",
                 "value": value,
                 "unit": "MB/s",
-                "vs_baseline": round(value / SELF_BASELINE_MBPS, 3),
+                "vs_baseline": round(pipeline["mbps_best"] / SELF_BASELINE_MBPS, 3),
                 "extra": extra,
             }
         )

@@ -19,7 +19,7 @@ from .mq import new_queue, resolve_backend
 from .mq.memory import InMemoryBroker
 from .orchestrator import Orchestrator
 from .platform import metrics as prom
-from .platform.config import load_config
+from .platform.config import cfg_get, load_config
 from .platform.logging import get_logger
 from .platform.telemetry import Telemetry
 from .platform.tracing import init_tracer
@@ -36,6 +36,10 @@ def build_service(config=None, broker=None, store=None):
     logger = get_logger("downloader")
     tracer = init_tracer("downloader", logger, config)
     metrics = prom.new("downloader")
+
+    # optional field-number reconciliation with a real triton-core
+    # deployment (schemas/remap.py); bad tables fail here, at boot
+    schemas.configure_remap(cfg_get(config, "wire_remap", None))
 
     # Queue backend per config: a real AMQP connection pair (one for jobs,
     # one for telemetry, like the reference's AMQP + Telemetry connections,

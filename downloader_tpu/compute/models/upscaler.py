@@ -34,12 +34,20 @@ class UpscalerConfig:
 
 
 class Upscaler(nn.Module):
-    """(B, H, W, C) -> (B, H*scale, W*scale, C)"""
+    """(B, H, W, C) -> (B, H*scale, W*scale, C)
+
+    :meth:`backbone` exposes the pre-shuffle sub-pixel maps
+    (B, H, W, C*scale^2) — the inference engine's fused output tail does
+    colorspace + quantize in the sub-pixel domain BEFORE the shuffle
+    (measured 33% off the 720p stage step on a v5e, BASELINE.md r3), so
+    it needs the tensor the pixel shuffle would consume.  The param tree
+    is identical either way.
+    """
 
     config: UpscalerConfig = UpscalerConfig()
 
     @nn.compact
-    def __call__(self, frames: jax.Array) -> jax.Array:
+    def backbone(self, frames: jax.Array) -> jax.Array:
         cfg = self.config
         x = frames.astype(cfg.compute_dtype)
 
@@ -59,13 +67,15 @@ class Upscaler(nn.Module):
             )(x)
             x = nn.relu(x) + residual  # residual keeps deep stacks trainable
 
-        # project to scale^2 * channels sub-pixel maps, then rearrange
-        x = nn.Conv(
+        # project to scale^2 * channels sub-pixel maps
+        return nn.Conv(
             cfg.channels * cfg.scale * cfg.scale, (3, 3), padding="SAME",
             dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
             name="subpixel",
         )(x)
-        return pixel_shuffle(x, cfg.scale)
+
+    def __call__(self, frames: jax.Array) -> jax.Array:
+        return pixel_shuffle(self.backbone(frames), self.config.scale)
 
 
 def init_params(rng: jax.Array, config: UpscalerConfig = UpscalerConfig(),

@@ -19,25 +19,33 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+# Module-level constants are PLAIN numpy on purpose: a module-scope
+# jnp.array binds whatever trace context is active at first import, so a
+# lazy `import` inside a jitted function would store a tracer in these
+# globals and poison every later trace (UnexpectedTracerError — hit on
+# hardware in r3).  numpy constants are concrete everywhere and XLA
+# embeds them just the same.
 
 # forward (RGB -> YCbCr) matrix, rows = (Y, Cb, Cr)
-_RGB2YCC = jnp.array(
+_RGB2YCC = np.array(
     [
         [0.299, 0.587, 0.114],
         [-0.168736, -0.331264, 0.5],
         [0.5, -0.418688, -0.081312],
     ],
-    dtype=jnp.float32,
+    dtype=np.float32,
 )
 
 # inverse (YCbCr -> RGB) matrix, rows = (R, G, B), applied to (Y, Cb-128, Cr-128)
-_YCC2RGB = jnp.array(
+_YCC2RGB = np.array(
     [
         [1.0, 0.0, 1.402],
         [1.0, -0.344136, -0.714136],
         [1.0, 1.772, 0.0],
     ],
-    dtype=jnp.float32,
+    dtype=np.float32,
 )
 
 
@@ -67,6 +75,51 @@ def upsample_chroma(plane: jax.Array, sub_h: int, sub_w: int) -> jax.Array:
     if sub_w > 1:
         plane = jnp.repeat(plane, sub_w, axis=2)
     return plane
+
+
+def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
+    """Sub-pixel-domain output tail: colorspace + quantize BEFORE the
+    pixel shuffle.
+
+    Input: the model backbone's (B, H, W, scale^2*3) RGB sub-pixel maps
+    in the 0..255 float domain.  Output: ``(y_u8, cb_u8, cr_u8)`` with
+    ``y`` at (B, H*scale, W*scale) and chroma at (B, H, W) — i.e. the
+    4:2:0 planes for the ``scale``-upscaled frame when chroma subsampling
+    equals ``scale``.
+
+    Two algebraic identities make this much cheaper than
+    shuffle-then-transform (33% off the whole 720p stage step on a v5e,
+    BASELINE.md r3):
+
+    - box-downsampling the shuffled full-res chroma by ``scale`` is
+      EXACTLY the mean over each scale^2 sub-pixel channel group (the
+      box filter commutes with the shuffle), so full-res chroma planes
+      are never materialized; the chroma transform runs on channel
+      means at (H, W);
+    - the luma transform + quantize are elementwise, so they commute
+      with the shuffle: transform+quantize the scale^2 luma channels at
+      (H, W), then shuffle uint8 BYTES — 4x less relayout traffic than
+      shuffling float32.
+    """
+    from .pixel_shuffle import quantize_u8
+
+    b, h, w, c_full = subpixel_rgb.shape
+    r = scale
+    if c_full != r * r * 3:
+        raise ValueError(f"expected {r * r * 3} sub-pixel channels, got {c_full}")
+    # channel index factorizes as (di, dj, rgb) — matching pixel_shuffle
+    sub = subpixel_rgb.reshape(b, h, w, r * r, 3)
+    y_sub = sub @ _RGB2YCC[0]                      # (b, h, w, r*r)
+    y_u8 = quantize_u8(y_sub)
+    y_full = (
+        y_u8.reshape(b, h, w, r, r)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(b, h * r, w * r)
+    )
+    mean_rgb = sub.mean(axis=3)                    # (b, h, w, 3)
+    cb = mean_rgb @ _RGB2YCC[1] + 128.0
+    cr = mean_rgb @ _RGB2YCC[2] + 128.0
+    return y_full, quantize_u8(cb), quantize_u8(cr)
 
 
 def downsample_chroma(plane: jax.Array, sub_h: int, sub_w: int) -> jax.Array:

@@ -47,8 +47,14 @@ def pixel_shuffle_clip_u8(x: jax.Array, scale: int) -> jax.Array:
 def quantize_u8(x: jax.Array) -> jax.Array:
     """clip(round(x), 0, 255) -> uint8, via the Pallas kernel on TPU with
     the XLA path as fallback — the one dispatch point for the quantize
-    tail (inference uses it too)."""
-    if jax.default_backend() == "tpu":
+    tail (inference uses it too).
+
+    The Pallas path is only attempted on shapes Mosaic accepts (lane dim
+    a multiple of 128): a pallas_call that raises DURING tracing inside
+    an enclosing jit leaks tracers and poisons the whole trace, so shape
+    rejection must happen up front, not via try/except."""
+    if (jax.default_backend() == "tpu" and x.ndim >= 2
+            and x.shape[-1] % 128 == 0):
         try:
             return _pallas_quantize_u8(x)
         except Exception:  # pragma: no cover - pallas availability varies

@@ -27,6 +27,7 @@ import numpy as np
 from .models.upscaler import Upscaler, UpscalerConfig
 from .ops.colorspace import (
     downsample_chroma,
+    fused_subpixel_ycc,
     rgb_to_ycbcr,
     upsample_chroma,
     ycbcr_to_rgb,
@@ -93,11 +94,19 @@ class FrameUpscaler:
         jax, jnp = self._jax, self._jnp
         model = self.model
 
+        scale = self.config.scale
+
         def fn(params, y, cb, cr):
             yf = y.astype(jnp.float32)
             cbf = upsample_chroma(cb.astype(jnp.float32), sub_h, sub_w)
             crf = upsample_chroma(cr.astype(jnp.float32), sub_h, sub_w)
             rgb = ycbcr_to_rgb(yf, cbf, crf) / 255.0
+            if sub_h == scale and sub_w == scale:
+                # fused sub-pixel output tail (the common 4:2:0 +
+                # matching-scale path; 33% off the 720p step on a v5e)
+                h12 = model.apply(params, rgb, method=Upscaler.backbone)
+                return fused_subpixel_ycc(
+                    h12.astype(jnp.float32) * 255.0, scale)
             out = model.apply(params, rgb)
             y2, cb2, cr2 = rgb_to_ycbcr(out.astype(jnp.float32) * 255.0)
             cb2 = downsample_chroma(cb2, sub_h, sub_w)
@@ -112,6 +121,30 @@ class FrameUpscaler:
         return arr
 
     # ------------------------------------------------------------------
+    def _dispatch(self, y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
+                  sub_h: int, sub_w: int):
+        """Pad to the static batch and dispatch WITHOUT blocking.
+
+        Returns ``(device_arrays, n)``: JAX dispatch is asynchronous, so
+        the caller can keep reading/decoding input (or queue further
+        batches) while the device — and, over a tunneled chip, the RPC
+        round-trip — works.  :meth:`_fetch` materializes the result.
+        """
+        n = y.shape[0]
+        pad = self.batch - n
+        if pad:
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], np.uint8)])
+            cb = np.concatenate([cb, np.zeros((pad,) + cb.shape[1:], np.uint8)])
+            cr = np.concatenate([cr, np.zeros((pad,) + cr.shape[1:], np.uint8)])
+        fn = self._compiled(sub_h, sub_w)
+        out = fn(self.params, self._place(y), self._place(cb), self._place(cr))
+        return out, n
+
+    @staticmethod
+    def _fetch(dispatched) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        (y2, cb2, cr2), n = dispatched
+        return np.asarray(y2)[:n], np.asarray(cb2)[:n], np.asarray(cr2)[:n]
+
     def upscale_batch(
         self,
         y: np.ndarray,
@@ -124,33 +157,46 @@ class FrameUpscaler:
 
         Pads n up to the static batch, runs the compiled fn, slices back.
         """
-        n = y.shape[0]
-        pad = self.batch - n
-        if pad:
-            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], np.uint8)])
-            cb = np.concatenate([cb, np.zeros((pad,) + cb.shape[1:], np.uint8)])
-            cr = np.concatenate([cr, np.zeros((pad,) + cr.shape[1:], np.uint8)])
-        fn = self._compiled(sub_h, sub_w)
-        y2, cb2, cr2 = fn(self.params, self._place(y), self._place(cb), self._place(cr))
-        return (
-            np.asarray(y2)[:n],
-            np.asarray(cb2)[:n],
-            np.asarray(cr2)[:n],
-        )
+        return self._fetch(self._dispatch(y, cb, cr, sub_h, sub_w))
 
     def upscale_y4m(self, src_path: str, dst_path: str) -> int:
         """Upscale a Y4M file; returns the number of frames written."""
-        with open(src_path, "rb") as src, open(dst_path, "wb") as dst:
-            reader = Y4MReader(src)
+        with open(src_path, "rb") as src:
+            return self.upscale_stream(src, dst_path)
+
+    def upscale_stream(self, src_fh, dst_path: str, depth: int = 3) -> int:
+        """Upscale a Y4M byte stream (file or pipe — e.g. a decode
+        front-end's ``ffmpeg -f yuv4mpegpipe -`` stdout) to ``dst_path``;
+        returns the number of frames written.
+
+        Keeps up to ``depth`` batches in flight: batch i+1 is read and
+        dispatched while batch i is still executing, so host IO (and the
+        per-dispatch RPC latency of a tunneled device) overlaps device
+        compute instead of serializing with it.
+        """
+        from collections import deque
+
+        with open(dst_path, "wb") as dst:
+            reader = Y4MReader(src_fh)
             hdr = reader.header
             writer = Y4MWriter(dst, hdr.scaled(self.config.scale))
             sub_h, sub_w = hdr.subsampling
             frames = 0
-            for y, cb, cr in _batched(iter(reader), self.batch):
-                y2, cb2, cr2 = self.upscale_batch(y, cb, cr, sub_h, sub_w)
+            inflight: deque = deque()
+
+            def drain_one() -> None:
+                nonlocal frames
+                y2, cb2, cr2 = self._fetch(inflight.popleft())
                 for i in range(y2.shape[0]):
                     writer.write_frame(y2[i], cb2[i], cr2[i])
                 frames += y2.shape[0]
+
+            for y, cb, cr in _batched(iter(reader), self.batch):
+                inflight.append(self._dispatch(y, cb, cr, sub_h, sub_w))
+                if len(inflight) >= depth:
+                    drain_one()
+            while inflight:
+                drain_one()
         return frames
 
 

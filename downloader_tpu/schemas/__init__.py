@@ -58,13 +58,65 @@ def load(name: str) -> Type[Message]:
         ) from None
 
 
+# Optional process-wide wire remap (see remap.py): reconciling our frozen
+# field numbers with a real triton-core deployment is a config change
+# (`wire_remap:` table), not a schema migration.
+_active_remap = None
+
+
+def configure_remap(tables) -> None:
+    """Install (or clear, with a falsy argument) the wire remap.
+
+    ``tables`` is the ``wire_remap`` config section: per message simple
+    name, a mapping of OUR field name to the DEPLOYMENT's wire number.
+    Bad tables (unknown fields, duplicate numbers) fail here, at boot,
+    not on the first job.
+    """
+    global _active_remap
+    if not tables:
+        _active_remap = None
+        return
+    from .remap import RemapError, WireRemap
+
+    # every table key must name a message reachable from the registry —
+    # a typo ('Mdia') must not silently disable the remap for that type
+    known = set()
+    stack = [t.DESCRIPTOR for t in _MESSAGE_TYPES.values()]
+    while stack:
+        descriptor = stack.pop()
+        if descriptor.name in known:
+            continue
+        known.add(descriptor.name)
+        stack.extend(f.message_type for f in descriptor.fields
+                     if f.message_type is not None)
+    unknown = set(tables) - known
+    if unknown:
+        raise RemapError(
+            f"wire_remap names unknown message type(s) {sorted(unknown)}; "
+            f"known: {sorted(known)}"
+        )
+
+    remap = WireRemap(tables)
+    for msg_type in _MESSAGE_TYPES.values():  # compile now -> fail fast
+        remap.to_wire(msg_type.DESCRIPTOR, b"")
+        remap.from_wire(msg_type.DESCRIPTOR, b"")
+    _active_remap = remap
+
+
 def encode(msg: Message) -> bytes:
-    """Serialize a message to its binary wire format."""
-    return msg.SerializeToString()
+    """Serialize a message to its binary wire format (remapped to the
+    deployment's field numbers when a wire remap is configured)."""
+    data = msg.SerializeToString()
+    if _active_remap is not None:
+        data = _active_remap.to_wire(msg.DESCRIPTOR, data)
+    return data
 
 
 def decode(msg_type: Type[Message], data: bytes) -> Message:
-    """Parse binary wire format into a message instance."""
+    """Parse binary wire format into a message instance (translating
+    from the deployment's field numbers when a wire remap is configured)."""
+    if _active_remap is not None:
+        data = _active_remap.from_wire(msg_type.DESCRIPTOR, data)
     msg = msg_type()
     msg.ParseFromString(data)
     return msg

@@ -605,29 +605,34 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             try:
                 await asyncio.gather(*tasks)
             finally:
-                # gather does NOT cancel siblings when one raises: every
-                # task must be settled BEFORE the fd closes, or an orphan
-                # segment pwrites into a closed (and soon reused) fd —
-                # which would corrupt the sequential fallback's file
-                for task in tasks:
-                    task.cancel()
-                await asyncio.gather(*tasks, return_exceptions=True)
-                # likewise settle the saver so it can't resurrect the
-                # state file after the success path removes it
-                saver.cancel()
-                await asyncio.gather(saver, return_exceptions=True)
                 try:
-                    await _save_state()
-                except OSError:
-                    pass
+                    # gather does NOT cancel siblings when one raises:
+                    # every task must be settled BEFORE the fd closes, or
+                    # an orphan segment pwrites into a closed (and soon
+                    # reused) fd — which would corrupt the sequential
+                    # fallback's file
+                    for task in tasks:
+                        task.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    # likewise settle the saver so it can't resurrect the
+                    # state file after the success path removes it
+                    saver.cancel()
+                    await asyncio.gather(saver, return_exceptions=True)
+                    try:
+                        await _save_state()
+                    except OSError:
+                        pass
                 finally:
-                    # drain any write a cancelled task left running in
-                    # the pool BEFORE the fd closes.  Synchronous on
-                    # purpose: this must run even when this task itself
-                    # is being cancelled (another await here could be
-                    # interrupted again, leaking the fd and the thread);
-                    # the pending work is page-cache writes, so the
-                    # brief loop stall is confined to error teardown.
+                    # drain the pool BEFORE the fd closes, even when a
+                    # second cancellation interrupts any await above
+                    # (this inner finally is the ONLY cleanup guaranteed
+                    # to run on that path).  Synchronous on purpose: an
+                    # await here could itself be interrupted, leaking
+                    # the fd and the thread; pool shutdown also rejects
+                    # any still-unsettled task's later submissions, so
+                    # nothing can reach a closed fd.  The pending work
+                    # is page-cache writes — the brief loop stall is
+                    # confined to error teardown.
                     io_pool.shutdown(wait=True)
                     os.close(fd)
             os.replace(seg_partial, output)

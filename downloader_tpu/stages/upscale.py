@@ -14,11 +14,16 @@ Gating and scope:
 - Enabled only when ``config.instance.upscale.enabled`` is true; the
   default pipeline stays byte-for-byte reference-parity
   (download -> process -> upload).
-- Only raw Y4M streams are transformed (sniffed by content magic, not
-  extension — see :func:`~downloader_tpu.compute.video.sniff_y4m`).
-  Compressed containers pass through untouched: decoding them needs a
-  codec stack (ffmpeg) that a production deployment would run as a
-  decode front-end piping y4m into this stage.
+- Raw Y4M streams (sniffed by content magic, not extension — see
+  :func:`~downloader_tpu.compute.video.sniff_y4m`) are transformed
+  directly.  Compressed containers (the extensions the process stage
+  selects, reference lib/process.js:15-20) go through a config-gated
+  decode front-end: ``instance.upscale.decode: true`` pipes
+  ``<decoder> -i file -f yuv4mpegpipe -`` (ffmpeg by default) straight
+  into the same Y4M path — no intermediate raw file on disk.  The
+  decoder binary is feature-detected; absent decoder or disabled flag
+  means the container passes through untouched, preserving the
+  reference-parity default.
 - The engine (params + compiled functions + device mesh) is memoized in
   ``ctx.resources`` so every job in the process shares one compilation
   cache and one copy of the params in HBM.
@@ -32,12 +37,20 @@ from __future__ import annotations
 
 import asyncio
 import os
+import shutil
+import subprocess
+import tempfile
 import threading
 
 from .base import Job, StageContext, StageFn
 
 _ENGINE_KEY = "upscale.engine"
 _ENGINE_LOCK = threading.Lock()  # _get_engine runs in worker threads
+
+# containers the decode front-end will attempt — exactly the set the
+# process stage selects as media (one source of truth; reference
+# lib/process.js:15-20)
+from .process import MEDIA_EXTS as _DECODE_EXTS  # noqa: E402
 
 
 def _engine_config(config):
@@ -54,6 +67,8 @@ def _engine_config(config):
         "batch": int(opt("batch", 8)),
         "checkpoint": opt("checkpoint", None),
         "use_mesh": bool(opt("use_mesh", True)),
+        "decode": bool(opt("decode", False)),
+        "decoder": str(opt("decoder", "ffmpeg")),
     }
 
 
@@ -87,8 +102,49 @@ def _get_engine(ctx: StageContext):
     return engine
 
 
+def _decode_and_upscale(engine, binary: str, src: str, dst: str) -> int:
+    """Pipe ``binary``'s yuv4mpegpipe output through the engine.
+
+    stderr goes to a temp file (not a pipe) so a chatty decoder can never
+    deadlock against our stdout reads; it is replayed into the error on
+    failure."""
+    from ..compute.video import Y4MError
+
+    with tempfile.TemporaryFile() as err:
+        proc = subprocess.Popen(
+            [binary, "-i", src, "-f", "yuv4mpegpipe", "-pix_fmt", "yuv420p",
+             "-loglevel", "error", "-"],
+            stdout=subprocess.PIPE, stderr=err,
+        )
+
+        def _stderr_tail() -> str:
+            err.seek(0)
+            return err.read()[-500:].decode("utf-8", errors="replace").strip()
+
+        try:
+            frames = engine.upscale_stream(proc.stdout, dst)
+            returncode = proc.wait()
+        except Y4MError as exc:
+            proc.kill()
+            returncode = proc.wait()
+            raise RuntimeError(
+                f"decoder produced invalid y4m (exit {returncode}): {exc}; "
+                f"{_stderr_tail()}"
+            ) from exc
+        except BaseException:
+            proc.kill()
+            proc.wait()
+            raise
+        if returncode != 0:
+            raise RuntimeError(
+                f"decoder exited {returncode}: {_stderr_tail()}"
+            )
+        return frames
+
+
 async def stage_factory(ctx: StageContext) -> StageFn:
     logger = ctx.logger
+    opts = _engine_config(ctx.config)
 
     async def upscale(job: Job):
         from ..compute.video import sniff_y4m
@@ -103,31 +159,55 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         with ctx.tracer.span("stage.upscale", files=len(files)):
             for path in files:
                 header = sniff_y4m(path)
+                decoder = None
                 if header is None:
-                    logger.info(
-                        "passing through non-y4m media", path=os.path.basename(path)
-                    )
-                    out_files.append(path)
-                    continue
+                    ext = os.path.splitext(path)[1].lower()
+                    if opts["decode"] and ext in _DECODE_EXTS:
+                        decoder = shutil.which(opts["decoder"])
+                        if decoder is None:
+                            logger.warn(
+                                "decoder not available; passing through",
+                                decoder=opts["decoder"],
+                                path=os.path.basename(path),
+                            )
+                    if decoder is None:
+                        logger.info(
+                            "passing through non-y4m media",
+                            path=os.path.basename(path),
+                        )
+                        out_files.append(path)
+                        continue
                 # engine construction does JAX backend init + model init —
                 # seconds even when healthy, and a wedged device tunnel
                 # hangs PJRT init — so it must not block the event loop
                 # any more than the per-file device work below does
                 engine = await asyncio.to_thread(_get_engine, ctx)
                 stem, ext = os.path.splitext(path)
-                dst = f"{stem}.{engine.config.scale}x{ext}"
+                # decoded output is raw y4m regardless of the source
+                # container; the FULL source name stays in the dst so
+                # movie.mkv and movie.mp4 in one job cannot collide on
+                # one output.  Direct y4m input keeps its extension.
+                dst = (f"{path}.{engine.config.scale}x.y4m" if decoder
+                       else f"{stem}.{engine.config.scale}x{ext}")
                 logger.info(
                     "upscaling",
                     path=os.path.basename(path),
-                    size=f"{header.width}x{header.height}",
+                    size=(f"{header.width}x{header.height}" if header
+                          else "compressed"),
                     scale=engine.config.scale,
+                    decoded=decoder is not None,
                 )
                 try:
                     # the device work holds the GIL only between dispatches;
                     # running in a thread keeps heartbeats/telemetry flowing
-                    frames = await asyncio.to_thread(
-                        engine.upscale_y4m, path, dst
-                    )
+                    if decoder is not None:
+                        frames = await asyncio.to_thread(
+                            _decode_and_upscale, engine, decoder, path, dst
+                        )
+                    else:
+                        frames = await asyncio.to_thread(
+                            engine.upscale_y4m, path, dst
+                        )
                 except BaseException:
                     # a partial .y4m output would be picked up as media by
                     # the redelivered job's process walk — remove it

@@ -62,6 +62,19 @@ async def announce(
             uploaded=uploaded, downloaded=downloaded, left=left, event=event,
             session=session,
         )
+    if scheme in ("ws", "wss"):
+        # webtorrent (the reference's engine) can announce to WebSocket
+        # trackers and fetch from the browser (WebRTC) peers they serve;
+        # this server-side client deliberately does not carry an
+        # ICE/DTLS/SCTP stack, so WSS trackers are skipped with this
+        # explicit error rather than failing as an unknown scheme
+        # (documented divergence — PARITY.md "WebSocket trackers")
+        raise TrackerError(
+            f"WebSocket tracker {tracker_url!r} not supported: WSS "
+            "trackers serve browser/WebRTC peers, which a server-side "
+            "client cannot dial; skipping (other peer sources — "
+            "http/udp trackers, DHT, PEX, x.pe — are unaffected)"
+        )
     raise TrackerError(f"unsupported tracker scheme: {scheme!r}")
 
 

@@ -1,0 +1,83 @@
+"""Where does loopback uTP throughput go?  (BASELINE.md r3)
+
+Runs a one-way bulk transfer over a UtpEndpoint pair in-process (same
+topology as the torrent swarm bench: both endpoints share the event loop
+and the GIL) under cProfile, and prints per-packet cost accounting.
+
+  python scripts/utp_profile.py [MiB] [payload_bytes]
+"""
+
+import asyncio
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from downloader_tpu.torrent import utp as utp_mod  # noqa: E402
+from downloader_tpu.torrent.utp import UtpEndpoint, open_utp_connection  # noqa: E402
+
+
+async def transfer(mib: int) -> float:
+    payload = os.urandom(mib << 20)
+    done = asyncio.Event()
+    got = 0
+
+    async def handler(reader, writer):
+        nonlocal got
+        while True:
+            chunk = await reader.read(1 << 18)
+            if not chunk:
+                break
+            got += len(chunk)
+        done.set()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        _reader, writer = await open_utp_connection(*server.local_addr)
+        start = time.monotonic()
+        view = memoryview(payload)
+        for off in range(0, len(view), 1 << 18):
+            writer.write(view[off:off + (1 << 18)])
+            await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+        await asyncio.wait_for(done.wait(), 60)
+        elapsed = time.monotonic() - start
+        assert got == len(payload), (got, len(payload))
+        return elapsed
+    finally:
+        server.close()
+
+
+def main():
+    mib = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    if len(sys.argv) > 2:
+        # loopback connections size packets via payload_for ->
+        # LOOPBACK_PAYLOAD; patch BOTH so the sweep knob really applies
+        utp_mod.MAX_PAYLOAD = int(sys.argv[2])
+        utp_mod.LOOPBACK_PAYLOAD = int(sys.argv[2])
+
+    profile = cProfile.Profile()
+    profile.enable()
+    elapsed = asyncio.run(transfer(mib))
+    profile.disable()
+
+    mbps = mib * (1 << 20) / 1e6 / elapsed
+    payload_sz = utp_mod.payload_for("127.0.0.1")
+    pkts = (mib << 20) // payload_sz
+    print(f"== {mib} MiB @ payload {payload_sz}: "
+          f"{mbps:.1f} MB/s ({elapsed:.2f}s, ~{pkts} data pkts, "
+          f"{elapsed / max(pkts, 1) * 1e6:.1f} us/pkt round-trip-inclusive)")
+    stream = io.StringIO()
+    stats = pstats.Stats(profile, stream=stream)
+    stats.sort_stats("cumulative").print_stats(18)
+    for line in stream.getvalue().splitlines():
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

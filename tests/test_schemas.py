@@ -61,3 +61,80 @@ def test_registry_load():
     assert schemas.load("downloader.Download") is schemas.Download
     with pytest.raises(KeyError):
         schemas.load("api.Nope")
+
+
+# ---------------------------------------------------------------- wire remap
+
+@pytest.fixture
+def remap_reset():
+    yield
+    schemas.configure_remap(None)
+
+
+def test_remap_rewrites_field_numbers_bytewise(remap_reset):
+    """The interop hedge: under a wire_remap table, encode() emits the
+    DEPLOYMENT's field numbers.  Media.id moved from our 1 to their 3
+    must serialize as tag 0x1a (field 3, wire type 2)."""
+    # swap id <-> name (a partial table that collides with an unmoved
+    # field is rejected — see test_remap_bad_tables_fail_at_configure)
+    schemas.configure_remap({"Media": {"id": 3, "name": 1}})
+    data = schemas.encode(schemas.Media(id="x"))
+    assert data == b"\x1a\x01x"  # (3 << 3) | 2, len 1, b"x"
+    # and decode translates the deployment numbering back to ours
+    back = schemas.decode(schemas.Media, data)
+    assert back.id == "x"
+
+
+def test_remap_roundtrips_nested_message(remap_reset):
+    """A Download under a multi-field remap (including the nested Media)
+    round-trips exactly; the same bytes parsed WITHOUT the remap land in
+    the wrong fields — proof the wire numbering really moved."""
+    msg = schemas.Download(
+        media=schemas.Media(
+            id="job-7", creator_id="card-9", name="A Show",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri="http://example/media.mkv",
+        ),
+        created_at="2026-07-31T00:00:00Z",
+    )
+    table = {
+        "Download": {"media": 2, "created_at": 1},  # swapped
+        "Media": {"id": 9, "creator_id": 8, "source_uri": 7},
+    }
+    schemas.configure_remap(table)
+    wire = schemas.encode(msg)
+    assert schemas.decode(schemas.Download, wire) == msg
+
+    # without the remap the bytes are unparseable under our numbering
+    # (created_at's string sits on the number our schema calls `media`,
+    # a submessage) — proof the wire numbering really moved
+    from google.protobuf.message import DecodeError
+
+    schemas.configure_remap(None)
+    with pytest.raises(DecodeError):
+        schemas.decode(schemas.Download, wire)
+
+
+def test_remap_passes_unknown_fields_through(remap_reset):
+    """Field numbers outside the schema transit the remap untouched, so
+    unknown-field preservation (tests/test_wire_freeze.py) still holds."""
+    from downloader_tpu.schemas.remap import WireRemap
+
+    remap = WireRemap({"Media": {"id": 3, "name": 1}})
+    # our field 1 ("x") plus unknown field 15 (varint 7)
+    data = b"\x0a\x01x" + b"\x78\x07"
+    out = remap.to_wire(schemas.Media.DESCRIPTOR, data)
+    assert out == b"\x1a\x01x" + b"\x78\x07"
+
+
+def test_remap_bad_tables_fail_at_configure(remap_reset):
+    from downloader_tpu.schemas.remap import RemapError
+
+    with pytest.raises(RemapError, match="unknown field"):
+        schemas.configure_remap({"Media": {"no_such_field": 4}})
+    with pytest.raises(RemapError, match="unknown message type"):
+        schemas.configure_remap({"Mdia": {"id": 3}})  # typo must not boot
+    with pytest.raises(RemapError, match="both map to wire number"):
+        # creator_id moved onto id's (unmoved) number
+        schemas.configure_remap({"Media": {"creator_id": 1}})

@@ -162,6 +162,31 @@ async def test_download_from_magnet_fetches_metadata(swarm, tmp_path):
     assert swarm.tracker.announces[0]["info_hash"] == swarm.meta.info_hash
 
 
+async def test_wss_tracker_announce_rejected():
+    """WebSocket trackers serve browser/WebRTC peers this server-side
+    client cannot dial — the announce fails with an explicit, documented
+    error instead of a generic unknown-scheme one (PARITY.md)."""
+    from downloader_tpu.torrent.tracker import TrackerError, announce
+
+    with pytest.raises(TrackerError, match="WebSocket tracker"):
+        await announce("wss://tracker.example/announce", b"\x01" * 20,
+                       b"-DT0001-123456789012", port=0)
+
+
+async def test_magnet_with_only_wss_trackers_uses_other_sources(
+        swarm, tmp_path):
+    """A magnet whose only trackers are WSS must not fail the download:
+    the WSS announce is skipped with a log and the remaining peer
+    sources (here the magnet's own x.pe hint) carry the job."""
+    uri = (make_magnet(swarm.meta.info_hash, swarm.meta.name,
+                       ["wss://tracker.example/announce"])
+           + f"&x.pe=127.0.0.1:{swarm.seeder.port}")
+    dest = str(tmp_path / "dl-wss")
+    meta = await TorrentClient().download(uri, dest)
+    assert meta.info_hash == swarm.meta.info_hash
+    assert_downloaded(swarm, dest)
+
+
 async def test_resume_skips_existing_pieces(swarm, tmp_path):
     dest = str(tmp_path / "dl-resume")
     client = TorrentClient()

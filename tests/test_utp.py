@@ -234,7 +234,7 @@ async def test_zero_window_recovery(monkeypatch):
         async with asyncio.timeout(30):
             # the deadlock state: peer quenched us, nothing in flight,
             # bytes still waiting to be sent
-            while not (conn._peer_wnd < utp_mod.MAX_PAYLOAD
+            while not (conn._peer_wnd < conn.max_payload
                        and not conn._inflight and conn._send_buf):
                 await asyncio.sleep(0.02)
             release.set()
