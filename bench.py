@@ -343,13 +343,31 @@ async def main():
     await orchestrator.shutdown(grace_seconds=5)
     await runner.cleanup()
 
+    # host<->device link probe: on a tunneled chip the pipeline number
+    # is bounded by THIS, not the framework (frames must actually cross
+    # the link; the pure-fps benches only fetch a scalar).  Reported so
+    # the overlap ratio can be read against the link, not just the chip.
+    import jax
+
+    probe = np.zeros((4 << 20,), np.uint8)
+    t0 = time.monotonic()
+    dev = jax.device_put(probe)
+    dev.block_until_ready()
+    h2d_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    np.asarray(dev)
+    d2h_s = time.monotonic() - t0
+
     total_frames = jobs * frames
+    probe_mb = (4 << 20) / 1e6  # MiB buffer -> MB, like every other metric
     print(json.dumps({
         "upscale_pipeline_mbps": round(jobs * media_bytes / 1e6 / wall, 1),
         "upscale_pipeline_fps": round(total_frames / wall, 1),
         "upscale_pipeline_jobs": jobs,
         "upscale_pipeline_frames": total_frames,
         "upscale_pipeline_wall_s": round(wall, 2),
+        "link_h2d_mbps": round(probe_mb / h2d_s, 1),
+        "link_d2h_mbps": round(probe_mb / d2h_s, 1),
     }))
 
 

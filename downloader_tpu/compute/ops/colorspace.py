@@ -49,15 +49,22 @@ _YCC2RGB = np.array(
 )
 
 
+# All colorspace matmuls pin precision=HIGHEST: these are 3-wide
+# contractions (free next to the convs), and the default TPU matmul
+# precision rounds matvec vs matmul lowerings differently — measured ±1
+# u8 disagreements between the fused and naive output tails on a v5e
+# until both paths were pinned.
+
+
 def ycbcr_to_rgb(y: jax.Array, cb: jax.Array, cr: jax.Array) -> jax.Array:
     """Full-res (B, H, W) float planes in 0..255 -> (B, H, W, 3) RGB 0..255."""
     ycc = jnp.stack([y, cb - 128.0, cr - 128.0], axis=-1)
-    return ycc @ _YCC2RGB.T
+    return jnp.matmul(ycc, _YCC2RGB.T, precision="highest")
 
 
 def rgb_to_ycbcr(rgb: jax.Array):
     """(B, H, W, 3) RGB 0..255 -> three (B, H, W) float planes in 0..255."""
-    ycc = rgb @ _RGB2YCC.T
+    ycc = jnp.matmul(rgb, _RGB2YCC.T, precision="highest")
     y = ycc[..., 0]
     cb = ycc[..., 1] + 128.0
     cr = ycc[..., 2] + 128.0
@@ -100,6 +107,11 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
       with the shuffle: transform+quantize the scale^2 luma channels at
       (H, W), then shuffle uint8 BYTES — 4x less relayout traffic than
       shuffling float32.
+
+    Agreement with the naive shuffle-then-transform path: exact on CPU;
+    on accelerators both paths pin matmul precision=HIGHEST (see module
+    note), and chroma may still differ by one u8 step where float
+    summation order lands a value on a rounding boundary.
     """
     from .pixel_shuffle import quantize_u8
 
@@ -109,7 +121,7 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
         raise ValueError(f"expected {r * r * 3} sub-pixel channels, got {c_full}")
     # channel index factorizes as (di, dj, rgb) — matching pixel_shuffle
     sub = subpixel_rgb.reshape(b, h, w, r * r, 3)
-    y_sub = sub @ _RGB2YCC[0]                      # (b, h, w, r*r)
+    y_sub = jnp.matmul(sub, _RGB2YCC[0], precision="highest")  # (b,h,w,r*r)
     y_u8 = quantize_u8(y_sub)
     y_full = (
         y_u8.reshape(b, h, w, r, r)
@@ -117,8 +129,8 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
         .reshape(b, h * r, w * r)
     )
     mean_rgb = sub.mean(axis=3)                    # (b, h, w, 3)
-    cb = mean_rgb @ _RGB2YCC[1] + 128.0
-    cr = mean_rgb @ _RGB2YCC[2] + 128.0
+    cb = jnp.matmul(mean_rgb, _RGB2YCC[1], precision="highest") + 128.0
+    cr = jnp.matmul(mean_rgb, _RGB2YCC[2], precision="highest") + 128.0
     return y_full, quantize_u8(cb), quantize_u8(cr)
 
 

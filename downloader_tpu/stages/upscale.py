@@ -114,7 +114,9 @@ def _decode_and_upscale(engine, binary: str, src: str, dst: str) -> int:
         proc = subprocess.Popen(
             [binary, "-i", src, "-f", "yuv4mpegpipe", "-pix_fmt", "yuv420p",
              "-loglevel", "error", "-"],
-            stdout=subprocess.PIPE, stderr=err,
+            # DEVNULL: ffmpeg with an inherited tty enables interactive
+            # key handling (a stray 'q' kills the decode mid-stream)
+            stdin=subprocess.DEVNULL, stdout=subprocess.PIPE, stderr=err,
         )
 
         def _stderr_tail() -> str:
