@@ -62,6 +62,28 @@ def ycbcr_to_rgb(y: jax.Array, cb: jax.Array, cr: jax.Array) -> jax.Array:
     return jnp.matmul(ycc, _YCC2RGB.T, precision="highest")
 
 
+# Model-domain input transform with the /255 normalization and the ±128
+# chroma offsets FOLDED into the matrix and a bias vector.  Standalone
+# elementwise passes over lane-dim-3 tensors run at 3/128 lane
+# utilization on TPU, so whether they cost ~0 or ~30 ms/step depends on
+# whether XLA fuses them into neighbors (measured both outcomes on a
+# v5e: a synthetic variant paid 31 ms for a bare /255; the shipped
+# nested-jit graph fused most of it and the fold nets ~2 ms).  Folding
+# makes the cost structural instead of fusion-dependent.
+_YCC2RGB_UNIT = (_YCC2RGB / 255.0).astype(np.float32)
+_YCC2RGB_UNIT_BIAS = (
+    -(128.0 / 255.0) * (_YCC2RGB[:, 1] + _YCC2RGB[:, 2])
+).astype(np.float32)
+
+
+def ycbcr_to_unit_rgb(y: jax.Array, cb: jax.Array, cr: jax.Array) -> jax.Array:
+    """(B, H, W) YCbCr planes in 0..255 -> (B, H, W, 3) RGB in [0, 1]
+    (the model's input domain), in one fused contraction."""
+    ycc = jnp.stack([y, cb, cr], axis=-1)
+    return (jnp.matmul(ycc, _YCC2RGB_UNIT.T, precision="highest")
+            + _YCC2RGB_UNIT_BIAS)
+
+
 def rgb_to_ycbcr(rgb: jax.Array):
     """(B, H, W, 3) RGB 0..255 -> three (B, H, W) float planes in 0..255."""
     ycc = jnp.matmul(rgb, _RGB2YCC.T, precision="highest")
@@ -89,7 +111,11 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
     pixel shuffle.
 
     Input: the model backbone's (B, H, W, scale^2*3) RGB sub-pixel maps
-    in the 0..255 float domain.  Output: ``(y_u8, cb_u8, cr_u8)`` with
+    in the MODEL's [0, 1] domain (any float dtype — the x255 display
+    scaling is folded into the f32 transform coefficients so the
+    astype+scale over the lane-dim-12 tensor never exists as a
+    standalone, fusion-dependent pass; see the note at
+    :data:`_YCC2RGB_UNIT`).  Output: ``(y_u8, cb_u8, cr_u8)`` with
     ``y`` at (B, H*scale, W*scale) and chroma at (B, H, W) — i.e. the
     4:2:0 planes for the ``scale``-upscaled frame when chroma subsampling
     equals ``scale``.
@@ -108,10 +134,13 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
       (H, W), then shuffle uint8 BYTES — 4x less relayout traffic than
       shuffling float32.
 
-    Agreement with the naive shuffle-then-transform path: exact on CPU;
-    on accelerators both paths pin matmul precision=HIGHEST (see module
-    note), and chroma may still differ by one u8 step where float
-    summation order lands a value on a rounding boundary.
+    Agreement with the naive shuffle-then-transform path: within one u8
+    step everywhere, >97% byte-exact (pinned by
+    ``test_fused_subpixel_tail_matches_naive`` and verified byte-exact
+    on a real v5e for the shipped seeds).  The identities are exact
+    algebraically; the folded factoring and chroma summation order
+    differ in the last float ulp, so values on a rounding boundary may
+    land one step away — on CPU as well as TPU.
     """
     from .pixel_shuffle import quantize_u8
 
@@ -121,16 +150,21 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
         raise ValueError(f"expected {r * r * 3} sub-pixel channels, got {c_full}")
     # channel index factorizes as (di, dj, rgb) — matching pixel_shuffle
     sub = subpixel_rgb.reshape(b, h, w, r * r, 3)
-    y_sub = jnp.matmul(sub, _RGB2YCC[0], precision="highest")  # (b,h,w,r*r)
+    # f32 coefficients upcast the (typically bf16) model output inside
+    # the contraction — no separate astype pass
+    y_sub = jnp.matmul(sub, 255.0 * _RGB2YCC[0],
+                       precision="highest")        # (b, h, w, r*r)
     y_u8 = quantize_u8(y_sub)
     y_full = (
         y_u8.reshape(b, h, w, r, r)
         .transpose(0, 1, 3, 2, 4)
         .reshape(b, h * r, w * r)
     )
-    mean_rgb = sub.mean(axis=3)                    # (b, h, w, 3)
-    cb = jnp.matmul(mean_rgb, _RGB2YCC[1], precision="highest") + 128.0
-    cr = jnp.matmul(mean_rgb, _RGB2YCC[2], precision="highest") + 128.0
+    mean_rgb = sub.mean(axis=3, dtype=jnp.float32)  # (b, h, w, 3)
+    cb = jnp.matmul(mean_rgb, 255.0 * _RGB2YCC[1],
+                    precision="highest") + 128.0
+    cr = jnp.matmul(mean_rgb, 255.0 * _RGB2YCC[2],
+                    precision="highest") + 128.0
     return y_full, quantize_u8(cb), quantize_u8(cr)
 
 

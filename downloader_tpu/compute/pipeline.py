@@ -30,7 +30,7 @@ from .ops.colorspace import (
     fused_subpixel_ycc,
     rgb_to_ycbcr,
     upsample_chroma,
-    ycbcr_to_rgb,
+    ycbcr_to_unit_rgb,
 )
 from .ops.pixel_shuffle import quantize_u8
 from .video import Y4MReader, Y4MWriter
@@ -100,13 +100,15 @@ class FrameUpscaler:
             yf = y.astype(jnp.float32)
             cbf = upsample_chroma(cb.astype(jnp.float32), sub_h, sub_w)
             crf = upsample_chroma(cr.astype(jnp.float32), sub_h, sub_w)
-            rgb = ycbcr_to_rgb(yf, cbf, crf) / 255.0
+            # normalization folded into the transform coefficients (a
+            # small structural win; lane-dim-3/12 elementwise passes are
+            # fusion-dependent on TPU — BASELINE.md r3)
+            rgb = ycbcr_to_unit_rgb(yf, cbf, crf)
             if sub_h == scale and sub_w == scale:
                 # fused sub-pixel output tail (the common 4:2:0 +
-                # matching-scale path; 33% off the 720p step on a v5e)
+                # matching-scale path)
                 h12 = model.apply(params, rgb, method=Upscaler.backbone)
-                return fused_subpixel_ycc(
-                    h12.astype(jnp.float32) * 255.0, scale)
+                return fused_subpixel_ycc(h12, scale)
             out = model.apply(params, rgb)
             y2, cb2, cr2 = rgb_to_ycbcr(out.astype(jnp.float32) * 255.0)
             cb2 = downsample_chroma(cb2, sub_h, sub_w)

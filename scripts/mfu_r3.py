@@ -447,6 +447,104 @@ def main():
                     best[name] = dt_ms
         out.update({f"{k}_ms": round(v, 3) for k, v in best.items()})
 
+    elif which == "stage3":
+        # shave the remaining input-side tail: fold /255 into the
+        # colorspace matrix+bias (one less full-tensor pass) and try the
+        # input colorspace in bf16 (the model casts to bf16 anyway)
+        import numpy as np
+
+        from downloader_tpu.compute.ops.colorspace import (
+            fused_subpixel_ycc, ycbcr_to_rgb, ycbcr_to_unit_rgb,
+        )
+
+        h, w = 720, 1280
+        host = np.random.default_rng(0)
+        y0 = jnp.asarray(host.integers(0, 256, (B, h, w), np.uint8))
+        cb0 = jnp.asarray(host.integers(0, 256, (B, h // 2, w // 2), np.uint8))
+        cr0 = jnp.asarray(host.integers(0, 256, (B, h // 2, w // 2), np.uint8))
+
+        def up2(p):
+            return jnp.repeat(jnp.repeat(p, 2, axis=1), 2, axis=2)
+
+        def backbone(x):
+            x = x.astype(jnp.bfloat16)
+            x = jax.nn.relu(conv(x, 5, 5, 3, F, key=1))
+            for i in range(3):
+                x = jax.nn.relu(conv(x, 3, 3, F, F, key=10 + i)) + x
+            return conv(x, 3, 3, F, 12, key=20)
+
+        from downloader_tpu.compute.ops.colorspace import (
+            _YCC2RGB_UNIT, _YCC2RGB_UNIT_BIAS,
+        )
+
+        def front_current(y, cb, cr):
+            # the pre-fold front: separate /255 pass
+            yf = y.astype(jnp.float32)
+            cbf = up2(cb.astype(jnp.float32))
+            crf = up2(cr.astype(jnp.float32))
+            return ycbcr_to_rgb(yf, cbf, crf) / 255.0
+
+        def front_folded(y, cb, cr):
+            # THE SHIPPED transform (one source of truth)
+            return ycbcr_to_unit_rgb(
+                y.astype(jnp.float32),
+                up2(cb.astype(jnp.float32)),
+                up2(cr.astype(jnp.float32)))
+
+        def front_folded_bf16(y, cb, cr):
+            ycc = jnp.stack(
+                [y.astype(jnp.bfloat16),
+                 up2(cb.astype(jnp.bfloat16)),
+                 up2(cr.astype(jnp.bfloat16))], axis=-1)
+            return (ycc @ jnp.asarray(_YCC2RGB_UNIT, jnp.bfloat16).T
+                    + jnp.asarray(_YCC2RGB_UNIT_BIAS, jnp.bfloat16))
+
+        def make_stage(front):
+            def fn(y, cb, cr):
+                # unit-domain contract: fused_subpixel_ycc folds the
+                # display scaling into its coefficients
+                return fused_subpixel_ycc(backbone(front(y, cb, cr)), 2)
+            return fn
+
+        def rollout(fn, iters):
+            fn = jax.jit(fn)
+
+            def step(s, _):
+                y2, cb2, cr2 = fn(y0 + s, cb0 + s, cr0 + s)
+                total = (jnp.sum(y2, dtype=jnp.int32)
+                         + jnp.sum(cb2, dtype=jnp.int32)
+                         + jnp.sum(cr2, dtype=jnp.int32))
+                return total.astype(jnp.uint8), ()
+
+            def run():
+                final, _ = jax.lax.scan(step, jnp.uint8(0), None, length=iters)
+                return final
+
+            return jax.jit(run)
+
+        fns = {"front_current": make_stage(front_current),
+               "front_folded": make_stage(front_folded),
+               "front_folded_bf16": make_stage(front_folded_bf16)}
+        lo_i, hi_i = 4, 12
+        compiled = {}
+        for name, fn in fns.items():
+            lo_f, hi_f = rollout(fn, lo_i), rollout(fn, hi_i)
+            jax.device_get(lo_f())
+            jax.device_get(hi_f())
+            compiled[name] = (lo_f, hi_f)
+        best = {name: None for name in fns}
+        for _ in range(4):
+            for name, (lo_f, hi_f) in compiled.items():
+                t0 = time.monotonic()
+                jax.device_get(lo_f())
+                t1 = time.monotonic()
+                jax.device_get(hi_f())
+                t2 = time.monotonic()
+                dt_ms = ((t2 - t1) - (t1 - t0)) / (hi_i - lo_i) * 1e3
+                if best[name] is None or dt_ms < best[name]:
+                    best[name] = dt_ms
+        out.update({f"{k}_ms": round(v, 3) for k, v in best.items()})
+
     elif which == "shuffle":
         x12 = jax.random.uniform(
             rng, (B, H, W, 12), jnp.float32).astype(jnp.bfloat16)

@@ -615,6 +615,90 @@ async def test_http_segmented_orphan_state_without_data_refetches(
     assert (target_dir / "file.mkv").read_bytes() == payload
 
 
+async def test_http_segmented_cancel_midflight_then_resume(
+        tmp_path, broker, range_server, small_segments):
+    """Cancelling a segmented download mid-transfer must tear down
+    cleanly (checkpoint written by the drained writer thread, fd closed,
+    no torn tmp files) and a later attempt must RESUME from the
+    checkpoint rather than refetching from zero."""
+    import asyncio
+    import json as json_mod
+
+    from aiohttp import web
+
+    from tests.helpers import start_http_server
+
+    _base, payload, fast_requests = range_server
+    started = asyncio.Event()
+    stop = asyncio.Event()  # lets runner.cleanup() finish promptly
+
+    async def trickle(request):
+        rng = request.headers.get("Range")
+        if rng == "bytes=0-0":
+            return web.Response(
+                status=206, body=b"\x00",
+                headers={"ETag": ETAG,
+                         "Content-Range": f"bytes 0-0/{len(payload)}"},
+            )
+        start_s, _, _end_s = rng.removeprefix("bytes=").partition("-")
+        start = int(start_s)
+        resp = web.StreamResponse(
+            status=206,
+            headers={"ETag": ETAG,
+                     "Content-Range":
+                         f"bytes {start}-{len(payload) - 1}/{len(payload)}"},
+        )
+        await resp.prepare(request)
+        # trickle a little real data, then stall until cancelled
+        await resp.write(payload[start:start + 2048])
+        started.set()
+        try:
+            await asyncio.wait_for(stop.wait(), 60)
+        except TimeoutError:
+            pass
+        return resp
+
+    runner, slow_base = await start_http_server(trickle,
+                                                path="/media/file.mkv")
+    stage = await make_stage(tmp_path, broker)
+    try:
+        task = asyncio.create_task(
+            stage(make_job("HTTP", f"{slow_base}/media/file.mkv")))
+        async with asyncio.timeout(30):
+            await started.wait()
+            await asyncio.sleep(0.1)  # let some bytes land + a flush
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+    finally:
+        stop.set()
+        await runner.cleanup()
+
+    target_dir = tmp_path / "downloads" / "job-1"
+    state_path = target_dir / "file.mkv.partial-seg.state"
+    # the teardown checkpoint is present and VALID json (the dedicated
+    # writer thread was drained, not killed mid-write)
+    state = json_mod.loads(state_path.read_text())
+    assert state["validator"] == ETAG and state["total"] == len(payload)
+    assert (target_dir / "file.mkv.partial-seg").stat().st_size == len(payload)
+    resumed = sum(pos - start for start, pos, _end in state["segments"])
+    assert resumed > 0  # some progress was checkpointed
+
+    # second attempt against the normal fixture server resumes
+    result = await stage(make_job("HTTP",
+                                  f"{_base}/media/file.mkv"))
+    assert result == {"path": str(target_dir)}
+    assert (target_dir / "file.mkv").read_bytes() == payload
+    # at least one segment range did NOT start from its segment origin
+    # (proof bytes were credited from the cancelled attempt)
+    span = -(-len(payload) // 4)
+    origins = {f"bytes={lo}-{min(lo + span, len(payload)) - 1}"
+               for lo in range(0, len(payload), span)}
+    resumed_ranges = [r for r, _ in fast_requests
+                      if r and r != "bytes=0-0" and r not in origins]
+    assert resumed_ranges, "no segment resumed from a checkpointed offset"
+
+
 async def test_http_segmented_falls_back_without_ranges(
         tmp_path, broker, http_server, small_segments):
     """A server with no byte-range support gets the sequential path."""

@@ -197,11 +197,12 @@ def test_sharded_inference_matches_single_device():
 
 def test_fused_subpixel_tail_matches_naive():
     """The sub-pixel-domain output tail (colorspace+quantize BEFORE the
-    shuffle) must match shuffle-then-transform: luma exactly (elementwise
-    ops commute with the shuffle), chroma within 1 u8 step (the box
-    filter commutes with the shuffle algebraically; float summation
-    order differs, so a value sitting exactly on a rounding boundary may
-    land one step away)."""
+    shuffle, display scaling folded into the coefficients) must match
+    shuffle-then-transform within 1 u8 step everywhere: the identities
+    are exact algebraically, but the folded factoring (matmul by 255*M
+    on unit-domain input vs matmul by M on 0..255 input) and the chroma
+    summation order differ in the last float ulp, so a value sitting on
+    a rounding boundary may land one step away."""
     import jax.numpy as jnp
 
     from downloader_tpu.compute.ops.colorspace import (
@@ -215,21 +216,24 @@ def test_fused_subpixel_tail_matches_naive():
     )
 
     rng = np.random.default_rng(7)
-    h12 = jnp.asarray(
-        rng.uniform(-20, 275, size=(2, 6, 8, 12)).astype(np.float32))
+    # model-domain values incl. out-of-range (clipping is exercised)
+    h01 = jnp.asarray(
+        rng.uniform(-0.1, 1.1, size=(2, 6, 8, 12)).astype(np.float32))
 
-    y_f, cb_f, cr_f = fused_subpixel_ycc(h12, 2)
+    y_f, cb_f, cr_f = fused_subpixel_ycc(h01, 2)
 
-    out = pixel_shuffle(h12, 2)
+    out = pixel_shuffle(h01 * 255.0, 2)
     y_n, cb_n, cr_n = rgb_to_ycbcr(out)
     y_n = quantize_u8(y_n)
     cb_n = quantize_u8(downsample_chroma(cb_n, 2, 2))
     cr_n = quantize_u8(downsample_chroma(cr_n, 2, 2))
 
-    assert np.array_equal(np.asarray(y_f), np.asarray(y_n))
-    for fused, naive in ((cb_f, cb_n), (cr_f, cr_n)):
+    for fused, naive in ((y_f, y_n), (cb_f, cb_n), (cr_f, cr_n)):
         diff = np.abs(np.asarray(fused).astype(int) - np.asarray(naive).astype(int))
         assert diff.max() <= 1
+        # and the overwhelming majority agree exactly (catches gross
+        # factoring mistakes that a bare <=1 bound would let through)
+        assert (diff == 0).mean() > 0.97
 
 
 def test_flops_model_and_peaks():
