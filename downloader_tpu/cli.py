@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 
 from . import schemas
@@ -110,15 +111,22 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="exit after N events (0 = run until ^C)")
 
     upscale = sub.add_parser(
-        "upscale", help="upscale a Y4M file through the TPU model"
+        "upscale", help="upscale Y4M (or, with --decode, any container "
+                        "an external decoder reads) through the TPU model"
     )
-    upscale.add_argument("src", help="input .y4m path")
+    upscale.add_argument("src", help="input .y4m path (any container "
+                                     "with --decode)")
     upscale.add_argument("dst", help="output .y4m path (2x dimensions)")
     upscale.add_argument("--checkpoint-dir", default=None,
                          help="orbax checkpoint dir with trained params "
                               "(default: random init)")
     upscale.add_argument("--batch", type=int, default=8,
                          help="frames per device dispatch")
+    upscale.add_argument("--decode", action="store_true",
+                         help="pipe src through the decoder's "
+                              "yuv4mpegpipe output first")
+    upscale.add_argument("--decoder", default="ffmpeg",
+                         help="decoder binary for --decode")
 
     train = sub.add_parser(
         "train", help="fit the upscaler on Y4M media (self-supervised SR)"
@@ -385,10 +393,37 @@ def _upscale(args) -> int:
     except ImportError:
         print("upscale needs the [compute] extra (jax/flax)", file=sys.stderr)
         return 2
+    binary = None
+    if getattr(args, "decode", False):
+        # resolve the decoder BEFORE FrameUpscaler(): JAX backend init
+        # costs seconds (and hangs on a wedged device tunnel) — a usage
+        # error must not pay that
+        import shutil
+
+        binary = shutil.which(args.decoder)
+        if binary is None:
+            print(f"decoder {args.decoder!r} not found on PATH",
+                  file=sys.stderr)
+            return 2
     upscaler = FrameUpscaler(
         batch=args.batch, checkpoint_dir=args.checkpoint_dir
     )
-    frames = upscaler.upscale_y4m(args.src, args.dst)
+    if binary is not None:
+        from .stages.upscale import decode_and_upscale
+
+        try:
+            frames = decode_and_upscale(upscaler, binary, args.src, args.dst)
+        except RuntimeError as err:
+            # match the stage: no partial .y4m left to be mistaken for
+            # valid output, and a clean error instead of a traceback
+            try:
+                os.unlink(args.dst)
+            except OSError:
+                pass
+            print(f"decode failed: {err}", file=sys.stderr)
+            return 1
+    else:
+        frames = upscaler.upscale_y4m(args.src, args.dst)
     print(f"upscaled {frames} frames -> {args.dst}")
     return 0
 

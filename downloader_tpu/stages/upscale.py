@@ -102,7 +102,7 @@ def _get_engine(ctx: StageContext):
     return engine
 
 
-def _decode_and_upscale(engine, binary: str, src: str, dst: str) -> int:
+def decode_and_upscale(engine, binary: str, src: str, dst: str) -> int:
     """Pipe ``binary``'s yuv4mpegpipe output through the engine.
 
     stderr goes to a temp file (not a pipe) so a chatty decoder can never
@@ -204,7 +204,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     # running in a thread keeps heartbeats/telemetry flowing
                     if decoder is not None:
                         frames = await asyncio.to_thread(
-                            _decode_and_upscale, engine, decoder, path, dst
+                            decode_and_upscale, engine, decoder, path, dst
                         )
                     else:
                         frames = await asyncio.to_thread(

@@ -165,3 +165,60 @@ def test_cli_train_and_upscale(media_dir, tmp_path, capsys):
     with open(dst, "rb") as fh:
         header = Y4MReader(fh).header
     assert (header.width, header.height) == (128, 96)
+
+
+def test_cli_upscale_decode_via_stub(tmp_path, capsys):
+    """`cli upscale --decode` pipes the source through the external
+    decoder (stubbed here) before the model — CLI parity with the
+    pipeline stage's decode front-end."""
+    from downloader_tpu.cli import main
+
+    fixture = tmp_path / "decoded.y4m"
+    fixture.write_bytes(make_y4m(16, 12, frames=2))
+    stub = tmp_path / "stub-decoder"
+    stub.write_text(
+        "#!/usr/bin/env python3\nimport sys\n"
+        f"sys.stdout.buffer.write(open({str(fixture)!r}, 'rb').read())\n"
+    )
+    stub.chmod(0o755)
+    movie = tmp_path / "movie.mkv"
+    movie.write_bytes(b"\x00opaque container\x00" * 64)
+
+    dst = tmp_path / "movie.2x.y4m"
+    rc = main([
+        "upscale", str(movie), str(dst), "--batch", "2",
+        "--decode", "--decoder", str(stub),
+    ])
+    assert rc == 0
+    assert "upscaled 2 frames" in capsys.readouterr().out
+    from downloader_tpu.compute.video import Y4MReader
+
+    with open(dst, "rb") as fh:
+        header = Y4MReader(fh).header
+    assert (header.width, header.height) == (32, 24)
+
+    # missing decoder fails cleanly with rc 2
+    rc = main([
+        "upscale", str(movie), str(dst), "--decode",
+        "--decoder", "no-such-decoder-xyz",
+    ])
+    assert rc == 2
+
+
+def test_cli_upscale_decode_failure_is_clean(tmp_path, capsys):
+    """A dying decoder yields a clean stderr error and rc 1, with no
+    partial output file left behind (stage-parity, review r3)."""
+    from downloader_tpu.cli import main
+
+    stub = tmp_path / "bad-decoder"
+    stub.write_text("#!/usr/bin/env python3\nimport sys\n"
+                    "sys.stderr.write('boom: codec\\n')\nsys.exit(3)\n")
+    stub.chmod(0o755)
+    movie = tmp_path / "movie.mkv"
+    movie.write_bytes(b"\x00junk\x00" * 32)
+    dst = tmp_path / "movie.2x.y4m"
+    rc = main(["upscale", str(movie), str(dst), "--batch", "2",
+               "--decode", "--decoder", str(stub)])
+    assert rc == 1
+    assert "boom: codec" in capsys.readouterr().err
+    assert not dst.exists()
