@@ -125,8 +125,9 @@ def _build_parser() -> argparse.ArgumentParser:
     upscale.add_argument("--decode", action="store_true",
                          help="pipe src through the decoder's "
                               "yuv4mpegpipe output first")
-    upscale.add_argument("--decoder", default="ffmpeg",
-                         help="decoder binary for --decode")
+    upscale.add_argument("--decoder", default=None,
+                         help="decoder binary (implies --decode; "
+                              "default ffmpeg)")
 
     train = sub.add_parser(
         "train", help="fit the upscaler on Y4M media (self-supervised SR)"
@@ -394,15 +395,18 @@ def _upscale(args) -> int:
         print("upscale needs the [compute] extra (jax/flax)", file=sys.stderr)
         return 2
     binary = None
-    if getattr(args, "decode", False):
+    # naming a decoder implies decoding (a --decoder without --decode
+    # would otherwise be silently ignored and die parsing the container)
+    if getattr(args, "decode", False) or getattr(args, "decoder", None):
         # resolve the decoder BEFORE FrameUpscaler(): JAX backend init
         # costs seconds (and hangs on a wedged device tunnel) — a usage
         # error must not pay that
         import shutil
 
-        binary = shutil.which(args.decoder)
+        decoder = args.decoder or "ffmpeg"
+        binary = shutil.which(decoder)
         if binary is None:
-            print(f"decoder {args.decoder!r} not found on PATH",
+            print(f"decoder {decoder!r} not found on PATH",
                   file=sys.stderr)
             return 2
     upscaler = FrameUpscaler(
@@ -413,15 +417,19 @@ def _upscale(args) -> int:
 
         try:
             frames = decode_and_upscale(upscaler, binary, args.src, args.dst)
-        except RuntimeError as err:
-            # match the stage: no partial .y4m left to be mistaken for
-            # valid output, and a clean error instead of a traceback
+        except BaseException as err:
+            # match the stage: NOTHING may leave a partial .y4m behind
+            # to be mistaken for valid output (upscale_stream creates
+            # dst before the first byte parses)
             try:
                 os.unlink(args.dst)
             except OSError:
                 pass
-            print(f"decode failed: {err}", file=sys.stderr)
-            return 1
+            if isinstance(err, RuntimeError):
+                # clean operator error instead of a traceback
+                print(f"decode failed: {err}", file=sys.stderr)
+                return 1
+            raise
     else:
         frames = upscaler.upscale_y4m(args.src, args.dst)
     print(f"upscaled {frames} frames -> {args.dst}")

@@ -236,6 +236,25 @@ def test_fused_subpixel_tail_matches_naive():
         assert (diff == 0).mean() > 0.97
 
 
+def test_frame_upscaler_handles_444_via_generic_tail(tmp_path):
+    """4:4:4 input (chroma subsampling != scale) takes the generic
+    shuffle-then-transform tail, not the fused sub-pixel one — the
+    engine must still produce a correct 2x stream."""
+    src = tmp_path / "clip444.y4m"
+    src.write_bytes(make_y4m(16, 12, frames=3, colorspace="444"))
+    dst = tmp_path / "clip444.2x.y4m"
+
+    engine = _tiny_engine(batch=4)
+    assert engine.upscale_y4m(str(src), str(dst)) == 3
+    reader = Y4MReader(open(dst, "rb"))
+    assert reader.header.width == 32 and reader.header.height == 24
+    assert reader.header.colorspace == "444"
+    frames = list(reader)
+    assert len(frames) == 3
+    # 4:4:4 chroma planes are full-res
+    assert frames[0][1].shape == (24, 32)
+
+
 def test_flops_model_and_peaks():
     from downloader_tpu.compute.models.upscaler import UpscalerConfig
     from downloader_tpu.compute.pipeline import (
