@@ -412,26 +412,26 @@ def _upscale(args) -> int:
     upscaler = FrameUpscaler(
         batch=args.batch, checkpoint_dir=args.checkpoint_dir
     )
-    if binary is not None:
-        from .stages.upscale import decode_and_upscale
+    try:
+        if binary is not None:
+            from .stages.upscale import decode_and_upscale
 
-        try:
             frames = decode_and_upscale(upscaler, binary, args.src, args.dst)
-        except BaseException as err:
-            # match the stage: NOTHING may leave a partial .y4m behind
-            # to be mistaken for valid output (upscale_stream creates
-            # dst before the first byte parses)
-            try:
-                os.unlink(args.dst)
-            except OSError:
-                pass
-            if isinstance(err, RuntimeError):
-                # clean operator error instead of a traceback
-                print(f"decode failed: {err}", file=sys.stderr)
-                return 1
-            raise
-    else:
-        frames = upscaler.upscale_y4m(args.src, args.dst)
+        else:
+            frames = upscaler.upscale_y4m(args.src, args.dst)
+    except BaseException as err:
+        # match the stage: NOTHING may leave a partial .y4m behind to
+        # be mistaken for valid output (upscale_stream creates dst
+        # before the first byte parses) — on either path
+        try:
+            os.unlink(args.dst)
+        except OSError:
+            pass
+        if isinstance(err, RuntimeError):
+            # clean operator error instead of a traceback
+            print(f"decode failed: {err}", file=sys.stderr)
+            return 1
+        raise
     print(f"upscaled {frames} frames -> {args.dst}")
     return 0
 

@@ -138,3 +138,32 @@ def test_remap_bad_tables_fail_at_configure(remap_reset):
     with pytest.raises(RemapError, match="both map to wire number"):
         # creator_id moved onto id's (unmoved) number
         schemas.configure_remap({"Media": {"creator_id": 1}})
+
+
+def test_remap_random_tables_roundtrip(remap_reset):
+    """Property check: any valid (injective) random renumbering of the
+    full Download/Media field set round-trips every message exactly."""
+    import random as stdlib_random
+
+    rng = stdlib_random.Random(0xC0FFEE)
+    media_fields = [f.name for f in schemas.Media.DESCRIPTOR.fields]
+    download_fields = [f.name for f in schemas.Download.DESCRIPTOR.fields]
+    msg = schemas.Download(
+        media=schemas.Media(
+            id="m-1", creator_id="c-9", name="N",
+            type=schemas.MediaType.Value("TV"),
+            source=schemas.SourceType.Value("TORRENT"),
+            source_uri="magnet:?xt=urn:btih:" + "ab" * 20,
+        ),
+        created_at="2026-07-31T12:00:00Z",
+    )
+    for _ in range(25):
+        media_numbers = rng.sample(range(1, 60), len(media_fields))
+        download_numbers = rng.sample(range(1, 60), len(download_fields))
+        table = {
+            "Media": dict(zip(media_fields, media_numbers)),
+            "Download": dict(zip(download_fields, download_numbers)),
+        }
+        schemas.configure_remap(table)
+        assert schemas.decode(schemas.Download, schemas.encode(msg)) == msg
+        schemas.configure_remap(None)

@@ -222,3 +222,19 @@ def test_cli_upscale_decode_failure_is_clean(tmp_path, capsys):
     assert rc == 1
     assert "boom: codec" in capsys.readouterr().err
     assert not dst.exists()
+
+
+def test_cli_upscale_direct_failure_leaves_no_partial(tmp_path):
+    """The non-decode path must also clean up its partial output when
+    the input is a corrupt y4m (review r3)."""
+    import pytest as pytest_mod
+
+    from downloader_tpu.cli import main
+    from downloader_tpu.compute.video import Y4MError
+
+    src = tmp_path / "corrupt.y4m"
+    src.write_bytes(make_y4m(16, 12, frames=2)[:-10])
+    dst = tmp_path / "out.y4m"
+    with pytest_mod.raises(Y4MError):
+        main(["upscale", str(src), str(dst), "--batch", "2"])
+    assert not dst.exists()
