@@ -66,17 +66,41 @@ def make_mesh(n_devices: Optional[int] = None, model_axis: int = 1) -> MeshPlan:
     return MeshPlan(Mesh(grid, axis_names=("data", "model")))
 
 
+def _make_global(value, sharding: NamedSharding):
+    """Assemble a (possibly multi-process) global array from a host
+    value.
+
+    ``jax.device_put`` can only target devices addressable by THIS
+    process; on a mesh spanning several processes (multi-host training,
+    or the two-process CPU harness in tests/test_multihost.py) each
+    process must instead contribute its addressable shards of the same
+    logically-global value — every host is assumed to hold an identical
+    copy (same PRNG seed / same input pipeline slice convention), the
+    standard multi-controller JAX recipe.  Single-process this reduces
+    to a plain sharded placement.
+    """
+    if jax.process_count() == 1:
+        # single-controller: plain sharded placement, no host round trip
+        # (values may already live on device; over a tunneled chip a
+        # d2h+h2d bounce costs real seconds)
+        return jax.device_put(value, sharding)
+    value = np.asarray(value)
+    return jax.make_array_from_callback(
+        value.shape, sharding, lambda idx: value[idx]
+    )
+
+
 def shard_params(plan: MeshPlan, params):
-    """Place a param pytree according to the plan (device_put with named
-    shardings; XLA partitions the arrays)."""
+    """Place a param pytree according to the plan (named shardings; XLA
+    partitions the arrays, collectives ride the mesh)."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree_util.tree_structure(params)
     placed = [
-        jax.device_put(value, plan.param_sharding(path, value))
+        _make_global(value, plan.param_sharding(path, value))
         for path, value in flat
     ]
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
 def shard_batch(plan: MeshPlan, batch):
-    return jax.device_put(batch, plan.data_sharding)
+    return _make_global(batch, plan.data_sharding)

@@ -1,0 +1,121 @@
+"""Multi-process ("multi-host") distributed training over DCN-style
+coordination.
+
+The r1-r3 multichip artifacts prove sharding across devices of ONE
+process; real TPU pods are multi-controller — one JAX process per host,
+a global mesh spanning all of them, collectives riding ICI/DCN, the
+coordination service over gRPC.  This suite runs that exact topology on
+CPU: two OS processes x 4 virtual devices each, `jax.distributed`
+coordination on localhost, the production ``make_mesh``/``shard_params``
+/``shard_batch``/``make_train_step`` path over the 8-device global
+mesh.  Gradient psums cross the process boundary; both processes must
+see identical, finite losses.
+
+The workers switch platform IN-PROCESS (``jax.config.update`` +
+``clear_backends``): env-level ``XLA_FLAGS`` reaches the workers fine
+(the device-count assert below depends on it), but env-level
+``JAX_PLATFORMS=cpu`` at interpreter start makes this image's startup
+hook initialize the backend before the flags apply (1 device).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r'''
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jb
+
+_jb.clear_backends()
+jax.distributed.initialize(
+    coordinator_address="127.0.0.1:%PORT%",
+    num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+
+import jax.numpy as jnp
+
+from downloader_tpu.compute.models.upscaler import UpscalerConfig
+from downloader_tpu.compute.parallel.mesh import (
+    make_mesh, shard_batch, shard_params,
+)
+from downloader_tpu.compute.train import make_train_step
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4, jax.local_device_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+plan = make_mesh(8, model_axis=2)
+config = UpscalerConfig(features=8, depth=2, scale=2)
+train_step, init_state = make_train_step(config)
+
+# identical seeds on every process = identical host copies, the
+# standard multi-controller recipe shard_params/shard_batch assume
+rng = jax.random.PRNGKey(0)
+params, opt_state = init_state(rng, sample_shape=(1, 16, 16, 3))
+params = shard_params(plan, params)
+opt_state = shard_params(plan, opt_state)
+
+low = jax.random.uniform(rng, (8, 16, 16, 3), jnp.float32)
+high = jax.random.uniform(rng, (8, 32, 32, 3), jnp.float32)
+
+with plan.mesh:
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    for i in range(2):
+        params, opt_state, loss = step(
+            params, opt_state, shard_batch(plan, low),
+            shard_batch(plan, high))
+        print(f"proc {jax.process_index()} step {i} "
+              f"loss {float(loss):.8f}", flush=True)
+'''
+
+
+def test_two_process_training_over_global_mesh():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    env = {**os.environ,
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    env.pop("JAX_PLATFORMS", None)  # workers switch in-process
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = _WORKER.replace("%PORT%", str(port))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", src, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo, env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=300)
+            outputs.append(out)
+            assert proc.returncode == 0, out[-2000:]
+    finally:
+        # a hung/failed worker must not stay alive to steal the rest of
+        # the suite's single core (one orphan JAX process collapses the
+        # timing-sensitive tests that follow)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # both processes computed the SAME global losses (the gradient psum
+    # crossed the process boundary and agreed), and training progressed
+    def losses(out):
+        return [line.split("loss ")[1] for line in out.splitlines()
+                if " loss " in line]
+
+    l0, l1 = losses(outputs[0]), losses(outputs[1])
+    assert len(l0) == len(l1) == 2, (outputs[0][-500:], outputs[1][-500:])
+    assert l0 == l1
+    assert float(l0[1]) < float(l0[0])  # adam moved downhill on step 2
