@@ -128,6 +128,17 @@ def _build_parser() -> argparse.ArgumentParser:
     upscale.add_argument("--decoder", default=None,
                          help="decoder binary (implies --decode; "
                               "default ffmpeg)")
+    upscale.add_argument("--encode", action="store_true",
+                         help="pipe the upscaled y4m through an encoder "
+                              "into dst (compressed container out)")
+    upscale.add_argument("--encoder", default=None,
+                         help="encoder binary (implies --encode; "
+                              "default ffmpeg)")
+    upscale.add_argument("--encode-arg", action="append", default=None,
+                         metavar="ARG", dest="encode_args",
+                         help="extra encoder args before the output path "
+                              "(repeatable; default: -c:v libx264 "
+                              "-preset veryfast -crf 18)")
 
     train = sub.add_parser(
         "train", help="fit the upscaler on Y4M media (self-supervised SR)"
@@ -394,42 +405,68 @@ def _upscale(args) -> int:
     except ImportError:
         print("upscale needs the [compute] extra (jax/flax)", file=sys.stderr)
         return 2
-    binary = None
-    # naming a decoder implies decoding (a --decoder without --decode
-    # would otherwise be silently ignored and die parsing the container)
-    if getattr(args, "decode", False) or getattr(args, "decoder", None):
-        # resolve the decoder BEFORE FrameUpscaler(): JAX backend init
-        # costs seconds (and hangs on a wedged device tunnel) — a usage
-        # error must not pay that
-        import shutil
+    import shutil
 
-        decoder = args.decoder or "ffmpeg"
-        binary = shutil.which(decoder)
-        if binary is None:
-            print(f"decoder {decoder!r} not found on PATH",
-                  file=sys.stderr)
+    # naming a decoder/encoder (or passing encode args) implies the mode
+    # (a --decoder without --decode would otherwise be silently ignored
+    # and die parsing the container).  Resolve binaries BEFORE
+    # FrameUpscaler(): JAX backend init costs seconds (and hangs on a
+    # wedged device tunnel) — a usage error must not pay that.
+    decoder = encoder = None
+    if getattr(args, "decode", False) or getattr(args, "decoder", None):
+        name = args.decoder or "ffmpeg"
+        decoder = shutil.which(name)
+        if decoder is None:
+            print(f"decoder {name!r} not found on PATH", file=sys.stderr)
+            return 2
+    if (getattr(args, "encode", False) or getattr(args, "encoder", None)
+            or getattr(args, "encode_args", None)):
+        name = args.encoder or "ffmpeg"
+        encoder = shutil.which(name)
+        if encoder is None:
+            print(f"encoder {name!r} not found on PATH", file=sys.stderr)
             return 2
     upscaler = FrameUpscaler(
         batch=args.batch, checkpoint_dir=args.checkpoint_dir
     )
+    # snapshot dst BEFORE running: failure cleanup must only remove
+    # output THIS run wrote (created or truncated), never a pre-existing
+    # file from an earlier successful run that an early usage error
+    # (e.g. missing src) never touched
     try:
-        if binary is not None:
-            from .stages.upscale import decode_and_upscale
+        pre = os.stat(args.dst)
+    except OSError:
+        pre = None
+    try:
+        from .compute.transcode import DEFAULT_ENCODE_ARGS, transcode
 
-            frames = decode_and_upscale(upscaler, binary, args.src, args.dst)
-        else:
-            frames = upscaler.upscale_y4m(args.src, args.dst)
+        frames = transcode(
+            upscaler, args.src, args.dst,
+            decoder=decoder, encoder=encoder,
+            encode_args=(args.encode_args if getattr(args, "encode_args", None)
+                         else DEFAULT_ENCODE_ARGS),
+        )
     except BaseException as err:
-        # match the stage: NOTHING may leave a partial .y4m behind to
-        # be mistaken for valid output (upscale_stream creates dst
-        # before the first byte parses) — on either path
+        # match the stage: NOTHING may leave a partial output behind to
+        # be mistaken for valid media (the y4m/container dst is created
+        # before the first byte parses) — but only if this run touched it
         try:
-            os.unlink(args.dst)
+            cur = os.stat(args.dst)
         except OSError:
-            pass
+            cur = None
+        touched = cur is not None and (
+            pre is None
+            or (cur.st_ino, cur.st_mtime_ns, cur.st_size)
+            != (pre.st_ino, pre.st_mtime_ns, pre.st_size)
+        )
+        if touched:
+            try:
+                os.unlink(args.dst)
+            except OSError:
+                pass
         if isinstance(err, RuntimeError):
             # clean operator error instead of a traceback
-            print(f"decode failed: {err}", file=sys.stderr)
+            print(f"transcode failed: {err}", file=sys.stderr)
             return 1
         raise
     print(f"upscaled {frames} frames -> {args.dst}")

@@ -103,4 +103,12 @@ def shard_params(plan: MeshPlan, params):
 
 
 def shard_batch(plan: MeshPlan, batch):
-    return _make_global(batch, plan.data_sharding)
+    """Place a batch (array or pytree of arrays) on the data axis.
+
+    Mapped over leaves: ``_make_global``'s multi-process branch indexes a
+    single ndarray, so a tuple/dict batch that worked single-process
+    (``device_put`` takes pytrees) would otherwise crash on a
+    multi-process mesh."""
+    return jax.tree_util.tree_map(
+        lambda leaf: _make_global(leaf, plan.data_sharding), batch
+    )

@@ -169,7 +169,14 @@ class FrameUpscaler:
     def upscale_stream(self, src_fh, dst_path: str, depth: int = 3) -> int:
         """Upscale a Y4M byte stream (file or pipe — e.g. a decode
         front-end's ``ffmpeg -f yuv4mpegpipe -`` stdout) to ``dst_path``;
-        returns the number of frames written.
+        returns the number of frames written."""
+        with open(dst_path, "wb") as dst:
+            return self.upscale_to(src_fh, dst, depth=depth)
+
+    def upscale_to(self, src_fh, dst_fh, depth: int = 3) -> int:
+        """Upscale a Y4M byte stream into an open writable — a file, or a
+        pipe such as an encode back-end's ``ffmpeg -f yuv4mpegpipe -i -``
+        stdin; returns the number of frames written.
 
         Keeps up to ``depth`` batches in flight: batch i+1 is read and
         dispatched while batch i is still executing, so host IO (and the
@@ -178,27 +185,26 @@ class FrameUpscaler:
         """
         from collections import deque
 
-        with open(dst_path, "wb") as dst:
-            reader = Y4MReader(src_fh)
-            hdr = reader.header
-            writer = Y4MWriter(dst, hdr.scaled(self.config.scale))
-            sub_h, sub_w = hdr.subsampling
-            frames = 0
-            inflight: deque = deque()
+        reader = Y4MReader(src_fh)
+        hdr = reader.header
+        writer = Y4MWriter(dst_fh, hdr.scaled(self.config.scale))
+        sub_h, sub_w = hdr.subsampling
+        frames = 0
+        inflight: deque = deque()
 
-            def drain_one() -> None:
-                nonlocal frames
-                y2, cb2, cr2 = self._fetch(inflight.popleft())
-                for i in range(y2.shape[0]):
-                    writer.write_frame(y2[i], cb2[i], cr2[i])
-                frames += y2.shape[0]
+        def drain_one() -> None:
+            nonlocal frames
+            y2, cb2, cr2 = self._fetch(inflight.popleft())
+            for i in range(y2.shape[0]):
+                writer.write_frame(y2[i], cb2[i], cr2[i])
+            frames += y2.shape[0]
 
-            for y, cb, cr in _batched(iter(reader), self.batch):
-                inflight.append(self._dispatch(y, cb, cr, sub_h, sub_w))
-                if len(inflight) >= depth:
-                    drain_one()
-            while inflight:
+        for y, cb, cr in _batched(iter(reader), self.batch):
+            inflight.append(self._dispatch(y, cb, cr, sub_h, sub_w))
+            if len(inflight) >= depth:
                 drain_one()
+        while inflight:
+            drain_one()
         return frames
 
 

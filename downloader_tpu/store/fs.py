@@ -11,16 +11,45 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
+import re
 import shutil
 from typing import AsyncIterator
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
+
+# in-flight ingest temp name: <dst>.tmp.<pid>.<counter> (fput_object)
+_TMP_RE = re.compile(r"\.tmp\.(\d+)\.\d+$")
+
+
+def _is_stale_tmp(filename: str) -> bool:
+    """True for an ingest temp file whose writing process is gone.
+
+    A put interrupted by SIGKILL/power loss leaves its per-call-unique
+    temp behind with nothing to reclaim it; the embedded pid tells us
+    whether the writer could still be mid-``os.replace``."""
+    match = _TMP_RE.search(filename)
+    if match is None:
+        return False
+    try:
+        os.kill(int(match.group(1)), 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass  # EPERM: pid exists under another uid — treat as live
+    return False
 
 
 def _safe_parts(name: str) -> list:
     parts = [p for p in name.split("/") if p not in ("", ".")]
     if any(p == ".." for p in parts):
         raise ValueError(f"object name {name!r} escapes the bucket")
+    if parts and _TMP_RE.search(parts[-1]):
+        # the ingest-temp suffix is a reserved namespace: without this, a
+        # user key matching it would be hidden from list_objects and
+        # silently reclaimed by the constructor sweep (review r4)
+        raise ValueError(
+            f"object name {name!r} uses the reserved ingest-temp suffix"
+        )
     return parts
 
 
@@ -44,6 +73,19 @@ class FilesystemObjectStore(ObjectStore):
         self.link_puts = link_puts
         self._tmp_seq = itertools.count()
         os.makedirs(self.root, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """Reclaim ingest temps orphaned by a killed process.  Live-pid
+        temps are left alone (a concurrent store over the same root may
+        be mid-put); they are invisible anyway — list/stat filter them."""
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            for filename in filenames:
+                if _is_stale_tmp(filename):
+                    try:
+                        os.unlink(os.path.join(dirpath, filename))
+                    except OSError:
+                        pass
 
     def _bucket_path(self, bucket: str) -> str:
         (part,) = _safe_parts(bucket) or [""]
@@ -67,7 +109,13 @@ class FilesystemObjectStore(ObjectStore):
 
     async def put_object(self, bucket: str, name: str, data: bytes) -> None:
         path = self._object_path(bucket, name)
-        await asyncio.to_thread(_write_file_atomic, path, data)
+        # same unique reclaimable temp naming as fput_object: a bare
+        # '<path>.tmp' orphaned by SIGKILL would be enumerated as an
+        # object forever (review r4)
+        await asyncio.to_thread(
+            _write_file_atomic, path, data,
+            f"{os.getpid()}.{next(self._tmp_seq)}",
+        )
 
     async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
         src = self._object_path(bucket, name)
@@ -95,6 +143,8 @@ class FilesystemObjectStore(ObjectStore):
             found = []
             for dirpath, _dirnames, filenames in os.walk(bucket_path):
                 for filename in filenames:
+                    if _TMP_RE.search(filename):
+                        continue  # in-flight/orphaned ingest temp, not an object
                     full = os.path.join(dirpath, filename)
                     key = os.path.relpath(full, bucket_path).replace(os.sep, "/")
                     if key.startswith(prefix):
@@ -125,12 +175,19 @@ def _read_file(path: str) -> bytes:
         return fh.read()
 
 
-def _write_file_atomic(path: str, data: bytes) -> None:
+def _write_file_atomic(path: str, data: bytes, suffix: str) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-    os.replace(tmp, path)
+    tmp = f"{path}.tmp.{suffix}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str) -> None:

@@ -345,7 +345,10 @@ class UtpConnection:
                 and self._peer_wnd < self.max_payload
                 and now - self._probe_at >= max(self._rto, MIN_RTO)):
             self._probe_at = now
-            self._send_next_chunk()
+            # TCP-window-probe style: ONE byte past the window, so a
+            # stalled receiver's buffer overshoot is bounded to ~nothing
+            # (a full chunk per RTO would pile up toward the 4x backstop)
+            self._send_next_chunk(limit=1)
 
     # -- connect (initiator side) --------------------------------------
     def send_syn(self) -> None:
@@ -579,9 +582,10 @@ class UtpConnection:
                 and self._fin_seq is None):
             self._send_fin()
 
-    def _send_next_chunk(self) -> None:
+    def _send_next_chunk(self, limit: Optional[int] = None) -> None:
         """Packetize and transmit one chunk off the send buffer."""
-        chunk = bytes(self._send_buf[:self.max_payload])
+        size = self.max_payload if limit is None else min(limit, self.max_payload)
+        chunk = bytes(self._send_buf[:size])
         del self._send_buf[:len(chunk)]
         pkt = _Inflight(self._seq, ST_DATA, chunk)
         self._inflight[self._seq] = pkt

@@ -1,0 +1,185 @@
+"""The OpenCV-backed codec shim (`downloader_tpu.codec`): flag parsing,
+y4m<->container roundtrips, and — the load-bearing part — the upscale
+stage driving it as a REAL external decoder/encoder subprocess over real
+compressed containers.  The zlib stubs in test_upscale.py prove the
+plumbing hermetically; this file proves the ffmpeg flag contract against
+a binary that actually parses it."""
+
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.compute.video import Y4MReader
+
+from tests.test_upscale import make_y4m
+
+pytestmark = pytest.mark.anyio
+
+# CV2_REQUIRED=1 (set by CI, which installs opencv-python-headless) turns
+# the cv2-missing skip into a hard failure — this file's coverage must
+# not silently vanish from CI (review r4)
+if os.environ.get("CV2_REQUIRED", "") == "1":
+    import cv2
+else:
+    cv2 = pytest.importorskip("cv2")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def codec_bin(tmp_path):
+    """The shim as an executable, the way the stage invokes codecs."""
+    wrapper = tmp_path / "tpu-codec"
+    wrapper.write_text(
+        "#!/bin/sh\n"
+        f'PYTHONPATH={REPO_ROOT} exec {sys.executable} '
+        '-m downloader_tpu.codec "$@"\n'
+    )
+    wrapper.chmod(0o755)
+    return str(wrapper)
+
+
+def _encode_container(codec_bin, y4m: bytes, dst: str, codec="mpeg4"):
+    proc = subprocess.run(
+        [codec_bin, "-y", "-f", "yuv4mpegpipe", "-i", "-",
+         "-loglevel", "error", "-c:v", codec, dst],
+        input=y4m, capture_output=True,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def _decode_container(codec_bin, src: str) -> Y4MReader:
+    proc = subprocess.run(
+        [codec_bin, "-i", src, "-f", "yuv4mpegpipe",
+         "-pix_fmt", "yuv420p", "-loglevel", "error", "-"],
+        capture_output=True,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+    return Y4MReader(io.BytesIO(proc.stdout))
+
+
+# ------------------------------------------------------------- unit level
+
+def test_parse_rejects_bad_usage(capsys):
+    from downloader_tpu.codec import main
+
+    assert main(["-i", "x.mkv"]) == 1  # no output
+    assert "no output" in capsys.readouterr().err
+    assert main(["-wat", "x", "-"]) == 1  # unknown flag
+    assert "unknown flag" in capsys.readouterr().err
+    assert main(["out.mkv"]) == 1  # no input
+    assert "no input" in capsys.readouterr().err
+    assert main(["-i", "a.mkv", "b.mkv"]) == 1  # no pipe side
+    assert "need a pipe" in capsys.readouterr().err
+
+
+def test_decode_missing_file_fails_cleanly(capsys):
+    from downloader_tpu.codec import main
+
+    rc = main(["-i", "/nonexistent/clip.mkv", "-f", "yuv4mpegpipe",
+               "-pix_fmt", "yuv420p", "-"])
+    assert rc == 1
+    assert "cannot open" in capsys.readouterr().err
+
+
+def test_container_roundtrip_preserves_geometry(codec_bin, tmp_path):
+    """y4m -> mpeg4/mkv -> y4m keeps dims, frame count, and fps; the
+    container is genuinely compressed (gradient frames compress well)."""
+    y4m = make_y4m(64, 48, frames=6, fps=(30, 1))
+    container = str(tmp_path / "clip.mkv")
+    _encode_container(codec_bin, y4m, container)
+    assert 0 < os.path.getsize(container) < len(y4m) // 2
+
+    reader = _decode_container(codec_bin, container)
+    assert (reader.header.width, reader.header.height) == (64, 48)
+    assert (reader.header.fps_num, reader.header.fps_den) == (30, 1)
+    frames = list(reader)
+    assert len(frames) == 6
+    # lossy codec: content survives approximately (gradient planes)
+    src_frames = list(Y4MReader(io.BytesIO(y4m)))
+    err = np.abs(frames[0][0].astype(int) - src_frames[0][0].astype(int))
+    assert err.mean() < 16, err.mean()
+
+
+def test_odd_dimensions_are_cropped_even(codec_bin, tmp_path):
+    """4:2:0 requires even dims; the decode side crops a stray line/col
+    instead of dying (real containers have odd-height streams)."""
+    # build a 63x47 container directly with cv2
+    path = str(tmp_path / "odd.mkv")
+    writer = cv2.VideoWriter(
+        path, cv2.VideoWriter_fourcc(*"mp4v"), 25, (63, 47))
+    assert writer.isOpened()
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        writer.write(rng.integers(0, 256, (47, 63, 3), np.uint8))
+    writer.release()
+
+    reader = _decode_container(codec_bin, path)
+    assert (reader.header.width, reader.header.height) == (62, 46)
+    assert len(list(reader)) == 3
+
+
+# ------------------------------------------------- through the stage
+
+async def test_stage_transcodes_real_container_via_shim(codec_bin, tmp_path):
+    """decode front-end + encode back-end with a REAL codec subprocess:
+    a compressed .mkv goes in, an upscaled compressed .mkv comes out,
+    and the output container decodes to 2x geometry.  This is the
+    ffmpeg-contract integration test runnable on hosts without ffmpeg
+    (VERDICT r3 next-round items 1 and 7)."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    from tests.test_upscale import _upscale_config
+
+    movie = tmp_path / "movie.mkv"
+    _encode_container(codec_bin, make_y4m(32, 24, frames=5),
+                      str(movie))
+
+    ctx = StageContext(
+        config=_upscale_config(
+            tmp_path, decode=True, decoder=codec_bin,
+            encode=True, encoder=codec_bin,
+            encode_args=["-c:v", "mpeg4"],
+        ),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="rc1", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(movie)], "downloadPath": str(tmp_path)},
+    )
+    result = await table["upscale"](job)
+
+    (out,) = result["files"]
+    assert out.endswith("movie.mkv.2x.mkv")
+    reader = _decode_container(codec_bin, out)
+    assert (reader.header.width, reader.header.height) == (64, 48)
+    assert len(list(reader)) == 5
+    # the staged artifact stays compressed: far below raw y4m size
+    raw_bytes = 64 * 48 * 3 // 2 * 5
+    assert os.path.getsize(out) < raw_bytes
+
+
+def test_cli_upscale_transcodes_real_container(codec_bin, tmp_path, capsys):
+    from downloader_tpu.cli import main
+
+    movie = tmp_path / "movie.mkv"
+    _encode_container(codec_bin, make_y4m(16, 12, frames=2), str(movie))
+    dst = tmp_path / "movie.2x.mkv"
+    rc = main([
+        "upscale", str(movie), str(dst), "--batch", "2",
+        "--decoder", codec_bin, "--encoder", codec_bin,
+        "--encode-arg=-c:v", "--encode-arg=mpeg4",
+    ])
+    assert rc == 0
+    assert "upscaled 2 frames" in capsys.readouterr().out
+    reader = _decode_container(codec_bin, str(dst))
+    assert (reader.header.width, reader.header.height) == (32, 24)

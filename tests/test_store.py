@@ -86,6 +86,77 @@ async def test_fs_rejects_traversal(tmp_path):
         await store.put_object("b", "../escape", b"x")
 
 
+async def test_fs_stale_tmp_swept_and_filtered(tmp_path):
+    """An ingest temp orphaned by SIGKILL (dead pid in its name) is swept
+    at store construction; a live-pid temp (possibly a concurrent put) is
+    kept but never enumerated as an object (advisor r3)."""
+    import os
+    import subprocess
+    import sys
+
+    root = tmp_path / "objects"
+    fs = FilesystemObjectStore(str(root))
+    await fs.make_bucket("b")
+    await fs.put_object("b", "dir/obj", b"real")
+
+    # a pid guaranteed dead: a child we already reaped
+    child = subprocess.Popen([sys.executable, "-c", ""])
+    child.wait()
+    bucket_dir = root / "b" / "dir"
+    dead = bucket_dir / f"obj2.tmp.{child.pid}.0"
+    dead.write_bytes(b"orphaned partial")
+    live = bucket_dir / f"obj3.tmp.{os.getpid()}.7"
+    live.write_bytes(b"concurrent put in flight")
+
+    # neither temp is an object, even before any sweep
+    names = [info.name async for info in fs.list_objects("b")]
+    assert names == ["dir/obj"]
+
+    # construction over the same root reclaims the orphan only
+    fs2 = FilesystemObjectStore(str(root))
+    assert not dead.exists()
+    assert live.exists()
+    names = [info.name async for info in fs2.list_objects("b")]
+    assert names == ["dir/obj"]
+    assert (await fs2.get_object("b", "dir/obj")) == b"real"
+
+
+async def test_fs_reserved_tmp_suffix_rejected(tmp_path):
+    """A user key matching the ingest-temp pattern would be invisible to
+    list and reclaimable by the sweep — reject it up front instead of
+    losing data silently (review r4)."""
+    fs = FilesystemObjectStore(str(tmp_path / "objects"))
+    await fs.make_bucket("b")
+    with pytest.raises(ValueError, match="reserved"):
+        await fs.put_object("b", "backup.tmp.123.0", b"x")
+    with pytest.raises(ValueError, match="reserved"):
+        await fs.fput_object("b", "a/b.tmp.1.2", __file__)
+    # near-misses stay legal
+    await fs.put_object("b", "file.tmp", b"x")
+    await fs.put_object("b", "x.tmp.notpid.0", b"y")
+
+
+async def test_fs_put_object_orphan_is_reclaimed(tmp_path):
+    """put_object's temps use the same unique reclaimable naming as
+    fput_object — a SIGKILLed byte put must not leave a phantom object
+    (review r4: the old bare '<path>.tmp' was never swept)."""
+    import subprocess
+    import sys
+
+    root = tmp_path / "objects"
+    fs = FilesystemObjectStore(str(root))
+    await fs.make_bucket("b")
+    child = subprocess.Popen([sys.executable, "-c", ""])
+    child.wait()
+    orphan = root / "b" / f"half.bin.tmp.{child.pid}.3"
+    orphan.write_bytes(b"half-written by a killed process")
+
+    names = [info.name async for info in fs.list_objects("b")]
+    assert names == []  # never enumerated
+    FilesystemObjectStore(str(root))  # constructor sweep reclaims
+    assert not orphan.exists()
+
+
 # -- filesystem backend: hardlink ingest fast path ----------------------
 
 

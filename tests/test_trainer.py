@@ -238,3 +238,63 @@ def test_cli_upscale_direct_failure_leaves_no_partial(tmp_path):
     with pytest_mod.raises(Y4MError):
         main(["upscale", str(src), str(dst), "--batch", "2"])
     assert not dst.exists()
+
+
+def test_cli_upscale_usage_error_preserves_existing_dst(tmp_path):
+    """A failure BEFORE this run ever opens dst (missing src here) must
+    not delete a pre-existing output from an earlier successful run
+    (advisor r3: cleanup unlinked dst unconditionally)."""
+    import pytest as pytest_mod
+
+    from downloader_tpu.cli import main
+
+    dst = tmp_path / "out.y4m"
+    dst.write_bytes(b"precious output from a previous run")
+    with pytest_mod.raises(FileNotFoundError):
+        main(["upscale", str(tmp_path / "nope.y4m"), str(dst),
+              "--batch", "2"])
+    assert dst.read_bytes() == b"precious output from a previous run"
+
+
+def test_cli_upscale_encode_via_stub(tmp_path, capsys):
+    """`cli upscale --encoder` pipes the upscaled stream through the
+    external encoder into dst — CLI parity with the pipeline stage's
+    encode back-end."""
+    import io
+    import zlib
+
+    from downloader_tpu.cli import main
+    from downloader_tpu.compute.video import Y4MReader
+
+    from tests.test_upscale import _write_stub_encoder
+
+    stub = _write_stub_encoder(tmp_path)
+    src = tmp_path / "clip.y4m"
+    src.write_bytes(make_y4m(16, 12, frames=2))
+    dst = tmp_path / "out.mkv"
+    rc = main(["upscale", str(src), str(dst), "--batch", "2",
+               "--encoder", str(stub)])
+    assert rc == 0
+    assert "upscaled 2 frames" in capsys.readouterr().out
+    blob = dst.read_bytes()
+    assert blob.startswith(b"STUB!")
+    reader = Y4MReader(io.BytesIO(zlib.decompress(blob[5:])))
+    assert (reader.header.width, reader.header.height) == (32, 24)
+
+    # a dying encoder exits 1 with its stderr surfaced and no partial dst
+    bad = tmp_path / "bad-encoder"
+    bad.write_text("#!/usr/bin/env python3\nimport sys\n"
+                   "open(sys.argv[-1], 'wb').write(b'junk')\n"
+                   "sys.stderr.write('enc boom\\n')\nsys.exit(4)\n")
+    bad.chmod(0o755)
+    dst2 = tmp_path / "out2.mkv"
+    rc = main(["upscale", str(src), str(dst2), "--batch", "2",
+               "--encoder", str(bad)])
+    assert rc == 1
+    assert "enc boom" in capsys.readouterr().err
+    assert not dst2.exists()
+
+    # missing encoder binary is a fast usage error (rc 2)
+    rc = main(["upscale", str(src), str(dst2),
+               "--encoder", "no-such-encoder-xyz"])
+    assert rc == 2

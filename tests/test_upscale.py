@@ -420,6 +420,165 @@ async def test_decode_front_end_missing_decoder_passes_through(tmp_path):
     assert result["files"] == [str(movie)]
 
 
+def _write_stub_encoder(tmp_path, body: str = None) -> str:
+    """An executable script standing in for ``ffmpeg -f yuv4mpegpipe -i -
+    … <dst>``: reads the y4m stream off stdin, writes a zlib "container"
+    (magic-prefixed) at the last argv — enough structure for tests to
+    verify the stream that reached the encoder, byte for byte."""
+    stub = tmp_path / "stub-encoder"
+    stub.write_text("#!/usr/bin/env python3\n" + (body or (
+        "import sys, zlib\n"
+        "data = sys.stdin.buffer.read()\n"
+        "with open(sys.argv[-1], 'wb') as fh:\n"
+        "    fh.write(b'STUB!' + zlib.compress(data))\n"
+    )))
+    stub.chmod(0o755)
+    return str(stub)
+
+
+def _unwrap_stub_container(path: str) -> bytes:
+    import zlib
+
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    assert blob.startswith(b"STUB!"), blob[:16]
+    return zlib.decompress(blob[5:])
+
+
+async def test_encode_back_end_wraps_output_in_container(tmp_path):
+    """With ``encode`` enabled the upscaled stream is piped through the
+    external encoder and the staged artifact is the encoder's container,
+    not raw Y4M (VERDICT r3 "what's missing" #1)."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    clip = tmp_path / "clip.y4m"
+    clip.write_bytes(make_y4m(16, 12, frames=3))
+    stub = _write_stub_encoder(tmp_path)
+    ctx = StageContext(
+        config=_upscale_config(tmp_path, encode=True, encoder=stub),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="e1", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(clip)], "downloadPath": str(tmp_path)},
+    )
+    result = await table["upscale"](job)
+
+    (out,) = result["files"]
+    assert out.endswith("clip.y4m.2x.mkv")
+    y4m = _unwrap_stub_container(out)
+    reader = Y4MReader(io.BytesIO(y4m))
+    assert reader.header.width == 32 and reader.header.height == 24
+    assert len(list(reader)) == 3
+
+
+async def test_decode_encode_compressed_end_to_end(tmp_path):
+    """The full transcode: compressed container -> external decoder ->
+    model -> external encoder -> compressed container; no intermediate
+    raw file is left anywhere in the job dir."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    fixture = tmp_path / "decoded.y4m"
+    fixture.write_bytes(make_y4m(16, 12, frames=5))
+    dec = _write_stub_decoder(tmp_path, (
+        "import sys\n"
+        f"with open({str(fixture)!r}, 'rb') as fh:\n"
+        "    sys.stdout.buffer.write(fh.read())\n"
+    ))
+    enc = _write_stub_encoder(tmp_path)
+    movie = tmp_path / "movie.mkv"
+    movie.write_bytes(os.urandom(1024))
+
+    ctx = StageContext(
+        config=_upscale_config(
+            tmp_path, decode=True, decoder=dec, encode=True, encoder=enc,
+            container="webm",
+        ),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="e2", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(movie)], "downloadPath": str(tmp_path)},
+    )
+    result = await table["upscale"](job)
+
+    (out,) = result["files"]
+    assert out.endswith("movie.mkv.2x.webm")  # container from config
+    reader = Y4MReader(io.BytesIO(_unwrap_stub_container(out)))
+    assert reader.header.width == 32 and reader.header.height == 24
+    assert len(list(reader)) == 5
+    # streaming contract: no intermediate raw y4m anywhere
+    assert not [p for p in os.listdir(tmp_path)
+                if p.endswith(".2x.y4m")]
+
+
+async def test_encode_failure_surfaces_stderr_and_cleans(tmp_path):
+    """An encoder that dies must fail the stage with its stderr in the
+    error and leave no partial container behind."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    enc = _write_stub_encoder(tmp_path, (
+        "import sys\n"
+        "with open(sys.argv[-1], 'wb') as fh:\n"
+        "    fh.write(b'partial garbage')\n"
+        "sys.stderr.write('encoder blew up: no such codec\\n')\n"
+        "sys.exit(4)\n"
+    ))
+    clip = tmp_path / "clip.y4m"
+    clip.write_bytes(make_y4m(16, 12, frames=3))
+    ctx = StageContext(
+        config=_upscale_config(tmp_path, encode=True, encoder=enc),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="e3", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(clip)], "downloadPath": str(tmp_path)},
+    )
+    with pytest.raises(RuntimeError, match="encoder.*blew up"):
+        await table["upscale"](job)
+    assert not (tmp_path / "clip.y4m.2x.mkv").exists()
+
+
+async def test_encode_missing_encoder_falls_back_to_raw(tmp_path):
+    """Feature detection: encode enabled but no encoder binary — the
+    upscale still runs and the output is raw y4m (the pre-encode
+    behavior), never a silent passthrough of un-upscaled media."""
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext, load_stages
+    from downloader_tpu.utils import EventEmitter
+
+    clip = tmp_path / "clip.y4m"
+    clip.write_bytes(make_y4m(16, 12, frames=2))
+    ctx = StageContext(
+        config=_upscale_config(
+            tmp_path, encode=True, encoder="no-such-encoder-xyz"),
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+    )
+    table = await load_stages(ctx, ["upscale"])
+    job = Job(
+        media=schemas.Media(id="e4", type=schemas.MediaType.Value("MOVIE")),
+        last_stage={"files": [str(clip)], "downloadPath": str(tmp_path)},
+    )
+    result = await table["upscale"](job)
+    (out,) = result["files"]
+    assert out.endswith("clip.2x.y4m")
+    header = sniff_y4m(out)
+    assert header.width == 32 and header.height == 24
+
+
 async def test_decode_front_end_failure_surfaces_stderr(tmp_path):
     """A decoder that dies must fail the stage with its stderr in the
     error and leave no partial output behind."""
@@ -532,6 +691,58 @@ async def test_pipeline_end_to_end_with_upscale(tmp_path):
 
         engine = orchestrator.stage_resources["upscale.engine"]
         assert engine.n_devices == 8  # ran sharded over the virtual mesh
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await media_srv.cleanup()
+
+
+async def test_pipeline_end_to_end_with_encode(tmp_path):
+    """download -> upscale -> ENCODE -> upload: the staged object is the
+    encoder's compressed container, closing the loop the reference's
+    pipeline expects (compressed media in staging, lib/process.js:15-20)."""
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.store import InMemoryObjectStore
+
+    from helpers import start_media_server
+
+    stub = _write_stub_encoder(tmp_path)
+    clip = make_y4m(16, 12, frames=4)
+    media_srv, base = await start_media_server(clip, path="/clip.y4m")
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = Orchestrator(
+        config=_upscale_config(tmp_path, encode=True, encoder=stub),
+        mq=MemoryQueue(broker),
+        store=store,
+        logger=NullLogger(),
+        stages=["download", "process", "upscale", "upload"],
+    )
+    await orchestrator.start()
+    try:
+        msg = schemas.Download(
+            media=schemas.Media(
+                id="enc-1",
+                creator_id="card-1",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=f"{base}/clip.y4m",
+            )
+        )
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
+
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+        name = "enc-1/original/" + base64.b64encode(b"clip.y4m.2x.mkv").decode()
+        staged = await store.get_object("triton-staging", name)
+        import zlib
+
+        assert staged.startswith(b"STUB!")
+        reader = Y4MReader(io.BytesIO(zlib.decompress(staged[5:])))
+        assert reader.header.width == 32 and reader.header.height == 24
+        assert len(list(reader)) == 4
+        await store.get_object("triton-staging", "enc-1/original/done")
     finally:
         await orchestrator.shutdown(grace_seconds=5)
         await media_srv.cleanup()

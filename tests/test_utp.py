@@ -245,6 +245,63 @@ async def test_zero_window_recovery(monkeypatch):
         server.close()
 
 
+async def test_zero_window_probe_is_minimal(monkeypatch):
+    """The sender-side probe past a closed window carries ONE byte, not a
+    full (up to 60 KiB on loopback) chunk — a stalled receiver's buffer
+    overshoot stays bounded near zero instead of piling toward the 4x
+    backstop (advisor r3)."""
+    from downloader_tpu.torrent import utp as utp_mod
+
+    monkeypatch.setattr(utp_mod, "RECV_WINDOW", 64 << 10)
+    release = asyncio.Event()
+    got = bytearray()
+    done = asyncio.Event()
+
+    async def handler(reader, writer):
+        await release.wait()
+        while True:
+            chunk = await reader.read(1 << 16)
+            if not chunk:
+                break
+            got.extend(chunk)
+        done.set()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        payload = os.urandom(512 << 10)
+        reader, writer = await open_utp_connection(*server.local_addr)
+        conn = writer._conn
+        writer.write(payload)
+        async with asyncio.timeout(30):
+            # reach the stall: peer quenched us, flight empty, data queued
+            while not (conn._peer_wnd < conn.max_payload
+                       and not conn._inflight and conn._send_buf):
+                await asyncio.sleep(0.02)
+            # record what the stalled sender puts on the wire from here on
+            sent = []
+            orig_send = conn.endpoint._send
+
+            def spy(data, addr):
+                sent.append(bytes(data))
+                orig_send(data, addr)
+
+            conn.endpoint._send = spy
+            while not any(decode_packet(d)[0] == ST_DATA for d in sent):
+                await asyncio.sleep(0.05)
+            probe_payloads = [decode_packet(d)[8] for d in sent
+                              if decode_packet(d)[0] == ST_DATA]
+            assert all(len(p) == 1 for p in probe_payloads), (
+                [len(p) for p in probe_payloads]
+            )
+            conn.endpoint._send = orig_send
+            release.set()
+            writer.close()
+            await done.wait()
+        assert bytes(got) == payload
+    finally:
+        server.close()
+
+
 async def test_transfer_over_ipv6():
     """Trackers/PEX hand out IPv6 peers (BEP 7); the uTP dial must work
     there too.  The 4-tuple IPv6 addr normalizes to (host, port) for the
