@@ -136,9 +136,10 @@ def _build_parser() -> argparse.ArgumentParser:
                               "default ffmpeg)")
     upscale.add_argument("--encode-arg", action="append", default=None,
                          metavar="ARG", dest="encode_args",
-                         help="extra encoder args before the output path "
-                              "(repeatable; default: -c:v libx264 "
-                              "-preset veryfast -crf 18)")
+                         help="encoder args before the output path "
+                              "(repeatable; REPLACES the default set "
+                              "'-c:v libx264 -preset veryfast -crf 18', "
+                              "so restate what you still want)")
 
     train = sub.add_parser(
         "train", help="fit the upscaler on Y4M media (self-supervised SR)"

@@ -1,3 +1,4 @@
-from .mesh import MeshPlan, make_mesh, shard_batch, shard_params
+from .mesh import MeshPlan, make_global, make_mesh, shard_batch, shard_params
 
-__all__ = ["MeshPlan", "make_mesh", "shard_batch", "shard_params"]
+__all__ = ["MeshPlan", "make_global", "make_mesh", "shard_batch",
+           "shard_params"]

@@ -66,7 +66,7 @@ def make_mesh(n_devices: Optional[int] = None, model_axis: int = 1) -> MeshPlan:
     return MeshPlan(Mesh(grid, axis_names=("data", "model")))
 
 
-def _make_global(value, sharding: NamedSharding):
+def make_global(value, sharding: NamedSharding):
     """Assemble a (possibly multi-process) global array from a host
     value.
 
@@ -96,7 +96,7 @@ def shard_params(plan: MeshPlan, params):
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree_util.tree_structure(params)
     placed = [
-        _make_global(value, plan.param_sharding(path, value))
+        make_global(value, plan.param_sharding(path, value))
         for path, value in flat
     ]
     return jax.tree_util.tree_unflatten(treedef, placed)
@@ -105,10 +105,10 @@ def shard_params(plan: MeshPlan, params):
 def shard_batch(plan: MeshPlan, batch):
     """Place a batch (array or pytree of arrays) on the data axis.
 
-    Mapped over leaves: ``_make_global``'s multi-process branch indexes a
+    Mapped over leaves: ``make_global``'s multi-process branch indexes a
     single ndarray, so a tuple/dict batch that worked single-process
     (``device_put`` takes pytrees) would otherwise crash on a
     multi-process mesh."""
     return jax.tree_util.tree_map(
-        lambda leaf: _make_global(leaf, plan.data_sharding), batch
+        lambda leaf: make_global(leaf, plan.data_sharding), batch
     )

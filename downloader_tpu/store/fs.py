@@ -13,6 +13,7 @@ import itertools
 import os
 import re
 import shutil
+import time
 from typing import AsyncIterator
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
@@ -20,15 +21,31 @@ from .base import ObjectInfo, ObjectNotFound, ObjectStore
 # in-flight ingest temp name: <dst>.tmp.<pid>.<counter> (fput_object)
 _TMP_RE = re.compile(r"\.tmp\.(\d+)\.\d+$")
 
+# reclaim grace periods: a dead-pid temp younger than the short grace
+# may belong to a DIFFERENT host sharing the root (NFS — the pid probe
+# is host-local); any temp older than the long bound is junk even if
+# its pid number was recycled by some unrelated long-lived process
+_STALE_GRACE_S = 300.0
+_STALE_MAX_AGE_S = 24 * 3600.0
 
-def _is_stale_tmp(filename: str) -> bool:
-    """True for an ingest temp file whose writing process is gone.
+
+def _is_stale_tmp(filename: str, path: str) -> bool:
+    """True for an ingest temp whose writer is provably gone.
 
     A put interrupted by SIGKILL/power loss leaves its per-call-unique
-    temp behind with nothing to reclaim it; the embedded pid tells us
-    whether the writer could still be mid-``os.replace``."""
+    temp behind with nothing to reclaim it.  Dead embedded pid + a
+    5-minute age (cross-host writers have no pid here) marks it stale;
+    a day-old temp is stale regardless of the pid check (pid reuse)."""
     match = _TMP_RE.search(filename)
     if match is None:
+        return False
+    try:
+        age = time.time() - os.stat(path).st_mtime
+    except OSError:
+        return False  # gone already (concurrent replace/reclaim)
+    if age > _STALE_MAX_AGE_S:
+        return True
+    if age < _STALE_GRACE_S:
         return False
     try:
         os.kill(int(match.group(1)), 0)
@@ -73,19 +90,6 @@ class FilesystemObjectStore(ObjectStore):
         self.link_puts = link_puts
         self._tmp_seq = itertools.count()
         os.makedirs(self.root, exist_ok=True)
-        self._sweep_stale_tmp()
-
-    def _sweep_stale_tmp(self) -> None:
-        """Reclaim ingest temps orphaned by a killed process.  Live-pid
-        temps are left alone (a concurrent store over the same root may
-        be mid-put); they are invisible anyway — list/stat filter them."""
-        for dirpath, _dirnames, filenames in os.walk(self.root):
-            for filename in filenames:
-                if _is_stale_tmp(filename):
-                    try:
-                        os.unlink(os.path.join(dirpath, filename))
-                    except OSError:
-                        pass
 
     def _bucket_path(self, bucket: str) -> str:
         (part,) = _safe_parts(bucket) or [""]
@@ -143,9 +147,18 @@ class FilesystemObjectStore(ObjectStore):
             found = []
             for dirpath, _dirnames, filenames in os.walk(bucket_path):
                 for filename in filenames:
-                    if _TMP_RE.search(filename):
-                        continue  # in-flight/orphaned ingest temp, not an object
                     full = os.path.join(dirpath, filename)
+                    if _TMP_RE.search(filename):
+                        # in-flight/orphaned ingest temp, never an
+                        # object; reclaim orphans opportunistically —
+                        # piggybacking on this walk keeps the sweep
+                        # free (no constructor-time full-tree scan)
+                        if _is_stale_tmp(filename, full):
+                            try:
+                                os.unlink(full)
+                            except OSError:
+                                pass
+                        continue
                     key = os.path.relpath(full, bucket_path).replace(os.sep, "/")
                     if key.startswith(prefix):
                         found.append(ObjectInfo(name=key, size=os.path.getsize(full)))

@@ -86,6 +86,18 @@ IDLE_TIMEOUT = 300.0
 # retransmits — correctness is unaffected, memory stays bounded)
 MAX_OOO = 2048
 
+# Delayed acks (r4): the r3 profile measured one ST_STATE per ST_DATA —
+# roughly half the per-packet processing budget on a loopback transfer
+# ("uTP: where the time goes", BASELINE.md).  Cumulative ack_nr makes
+# acking every Nth in-order packet protocol-legal (BEP 29 specifies no
+# ack schedule; libutp likewise delays); anything out of the ordinary —
+# reordering, duplicates, FIN — still acks immediately, so dup-ack fast
+# retransmit and loss recovery behave exactly as before.  The safety
+# valve: the 50 ms timer tick flushes a pending ack long before the
+# sender's MIN_RTO (500 ms) can fire.
+DELAYED_ACK_EVERY = 2
+DELAYED_ACK_TIMEOUT = 0.05
+
 
 def _now_us() -> int:
     return time.monotonic_ns() // 1000 & 0xFFFFFFFF
@@ -129,7 +141,12 @@ class PacketError(ValueError):
 
 
 def decode_packet(data: bytes):
-    """-> (type, conn_id, ts, ts_diff, wnd, seq, ack, sack_mask, payload)"""
+    """-> (type, conn_id, ts, ts_diff, wnd, seq, ack, sack_mask, payload)
+
+    The payload is a zero-copy memoryview into ``data`` (60 KiB loopback
+    datagrams made the per-packet slice copy a measurable term — r4); it
+    compares equal to bytes and feeds ``StreamReader.feed_data``
+    directly."""
     if len(data) < HEADER_SIZE:
         raise PacketError("short packet")
     (tv, ext, conn_id, ts, ts_diff, wnd, seq, ack) = _HEADER.unpack_from(data)
@@ -152,7 +169,8 @@ def decode_packet(data: bytes):
             sack = data[offset + 2:offset + 2 + length]
         ext = next_ext
         offset += 2 + length
-    return ptype, conn_id, ts, ts_diff, wnd, seq, ack, sack, data[offset:]
+    return (ptype, conn_id, ts, ts_diff, wnd, seq, ack, sack,
+            memoryview(data)[offset:])
 
 
 class _Inflight:
@@ -228,7 +246,14 @@ class UtpConnection:
         # instead of scanning the whole inflight dict per datagram
         self._resend: deque = deque()
         self._flight_bytes = 0
-        self._send_buf = bytearray()
+        # send queue: deque of whole buffers + consumed-prefix offset.
+        # The r3 bytearray (`del buf[:60KiB]` per packet) memmoved the
+        # entire remaining window left on EVERY packetization — ~270 MB
+        # of memmove per 32 MiB transferred at a 1 MiB buffer; profiled
+        # as a first-order term of the per-packet bound (r4)
+        self._send_q: deque = deque()
+        self._send_q_len = 0
+        self._send_off = 0
         self._send_lo = asyncio.Event()
         self._send_lo.set()
         # path-aware packet size (loopback gets large datagrams; the
@@ -249,6 +274,8 @@ class UtpConnection:
         self._last_ack_seen = -1
 
         self._ack_scheduled = False
+        self._pending_acks = 0  # in-order data packets not yet acked
+        self._ack_deadline = 0.0
         self._quenched_peer = False  # we advertised < one packet of room
         self._wnd_update_at = 0.0
         self._probe_at = 0.0
@@ -294,6 +321,11 @@ class UtpConnection:
         if now - self._last_recv > IDLE_TIMEOUT:
             self.abort(ConnectionResetError("uTP idle timeout"))
             return
+        # delayed-ack safety valve: an odd trailing packet (or a sender
+        # pausing mid-window) gets its ack at the deadline, far inside
+        # the sender's MIN_RTO
+        if self._pending_acks and now >= self._ack_deadline:
+            self._send_ack()
         if self._connected.is_set():
             self._check_zero_window(now)
         if not self._inflight:
@@ -341,7 +373,7 @@ class UtpConnection:
             # stall it exists to break
             self._wnd_update_at = now
             self._send_ack()
-        if (self._send_buf and not self._inflight
+        if (self._send_q_len and not self._inflight
                 and self._peer_wnd < self.max_payload
                 and now - self._probe_at >= max(self._rto, MIN_RTO)):
             self._probe_at = now
@@ -383,12 +415,10 @@ class UtpConnection:
             return
 
     # -- receive path ---------------------------------------------------
-    def on_datagram(self, data: bytes) -> None:
-        try:
-            (ptype, _cid, ts, ts_diff, wnd, seq, ack, sack,
-             payload) = decode_packet(data)
-        except PacketError:
-            return
+    def on_datagram(self, packet) -> None:
+        """Handle one already-decoded packet tuple (the endpoint decodes
+        exactly once, for routing and for us — r3 decoded twice)."""
+        (ptype, _cid, ts, ts_diff, wnd, seq, ack, sack, payload) = packet
         if self._closed:
             return
         self._last_recv = time.monotonic()
@@ -408,11 +438,19 @@ class UtpConnection:
         self._handle_ack(ack, sack, ts_diff)
 
         if ptype in (ST_DATA, ST_FIN):
-            self._handle_data(ptype, seq, payload)
-            # coalesce: a burst of datagrams already queued on the loop
-            # produces ONE ack (with SACK state as of the last packet),
-            # not one per packet — halves the datagram rate under load
-            if not self._ack_scheduled:
+            in_order = self._handle_data(ptype, seq, payload)
+            self._pending_acks += 1
+            if self._pending_acks == 1:
+                self._ack_deadline = (time.monotonic()
+                                      + DELAYED_ACK_TIMEOUT)
+            # immediate ack on anything irregular (dup-ack fast
+            # retransmit depends on it) or every Nth in-order packet;
+            # in between, the timer tick flushes (delayed ack).  The
+            # call_soon coalesces a burst already queued on the loop
+            # into ONE ack with SACK state as of the last packet.
+            if ((not in_order or ptype == ST_FIN
+                 or self._pending_acks >= DELAYED_ACK_EVERY)
+                    and not self._ack_scheduled):
                 self._ack_scheduled = True
                 asyncio.get_running_loop().call_soon(self._flush_ack)
         elif ptype == ST_SYN:
@@ -422,10 +460,14 @@ class UtpConnection:
 
     def _flush_ack(self) -> None:
         self._ack_scheduled = False
-        if not self._closed:
+        if not self._closed and self._pending_acks:
             self._send_ack()
 
-    def _handle_data(self, ptype: int, seq: int, payload: bytes) -> None:
+    def _handle_data(self, ptype: int, seq: int, payload: bytes) -> bool:
+        """Returns True for the plain in-order case (eligible for a
+        delayed ack); False for anything that must be acked NOW —
+        duplicates (stop the retransmitting sender), reordering (feed
+        the sender's dup-ack fast retransmit), backstop drops."""
         # data arriving means the sender knows our window again; if the
         # consumer stalls once more, _recv_window re-arms the flag
         self._quenched_peer = False
@@ -434,14 +476,14 @@ class UtpConnection:
         # dropped packet goes unacked, so a compliant-after-all sender
         # just retransmits once the consumer catches up)
         if len(self.reader._buffer) > 4 * RECV_WINDOW:  # noqa: SLF001
-            return
+            return False
         nxt = (self._ack + 1) & 0xFFFF
         if _seq_lt(seq, nxt):
-            return  # duplicate
+            return False  # duplicate
         if seq != nxt:
             if len(self._ooo) < MAX_OOO:
                 self._ooo.setdefault(seq, (ptype, payload))
-            return
+            return False
         self._deliver(ptype, payload)
         self._ack = seq
         # drain any now-in-order packets
@@ -452,6 +494,7 @@ class UtpConnection:
                 break
             self._deliver(entry[0], entry[1])
             self._ack = nxt
+        return not self._ooo
 
     def _deliver(self, ptype: int, payload: bytes) -> None:
         if ptype == ST_FIN:
@@ -460,7 +503,7 @@ class UtpConnection:
                 self.reader.feed_eof()
             # no more data will be accepted; if our FIN is also done,
             # the connection can retire
-            if self._closing and not self._inflight and not self._send_buf:
+            if self._closing and not self._inflight and not self._send_q_len:
                 self._retire()
             return
         if payload and self._eof_seq is None:
@@ -549,16 +592,22 @@ class UtpConnection:
     def _write(self, data: bytes) -> None:
         if self._closing or self._closed:
             raise ConnectionResetError("uTP writer is closed")
-        self._send_buf += data
+        if data:
+            # bytes(bytes) is a refcount bump, not a copy; memoryview/
+            # bytearray callers get the one defensive copy the old
+            # bytearray-append also paid
+            self._send_q.append(data if isinstance(data, bytes)
+                                else bytes(data))
+            self._send_q_len += len(data)
         if not self._send_buf_low():
             self._send_lo.clear()
         self._flush()
 
     def _send_buf_low(self) -> bool:
-        return len(self._send_buf) < RECV_WINDOW // 2
+        return self._send_q_len < RECV_WINDOW // 2
 
     async def _drain(self) -> None:
-        if self._closed and self._send_buf:
+        if self._closed and self._send_q_len:
             raise ConnectionResetError("uTP connection closed")
         await self._send_lo.wait()
 
@@ -574,19 +623,44 @@ class UtpConnection:
             if pkt.need_resend and pkt.seq in self._inflight:
                 self._transmit(pkt)
         window = min(self._cwnd, self._peer_wnd)
-        while self._send_buf and self._flight_bytes < window:
+        while self._send_q_len and self._flight_bytes < window:
             self._send_next_chunk()
         if self._send_buf_low():
             self._send_lo.set()
-        if (self._closing and not self._send_buf
+        if (self._closing and not self._send_q_len
                 and self._fin_seq is None):
             self._send_fin()
+
+    def _take_chunk(self, size: int) -> bytes:
+        """Dequeue up to ``size`` bytes: whole queued buffers pass
+        through with zero copies; a partially-consumed head advances an
+        offset instead of memmoving the remainder."""
+        parts = []
+        need = size
+        while need and self._send_q:
+            head = self._send_q[0]
+            avail = len(head) - self._send_off
+            if avail <= need:
+                parts.append(memoryview(head)[self._send_off:]
+                             if self._send_off else head)
+                self._send_q.popleft()
+                self._send_off = 0
+                need -= avail
+            else:
+                parts.append(
+                    memoryview(head)[self._send_off:self._send_off + need])
+                self._send_off += need
+                need = 0
+        self._send_q_len -= size - need
+        if len(parts) == 1:
+            part = parts[0]
+            return part if isinstance(part, bytes) else bytes(part)
+        return b"".join(parts)
 
     def _send_next_chunk(self, limit: Optional[int] = None) -> None:
         """Packetize and transmit one chunk off the send buffer."""
         size = self.max_payload if limit is None else min(limit, self.max_payload)
-        chunk = bytes(self._send_buf[:size])
-        del self._send_buf[:len(chunk)]
+        chunk = self._take_chunk(min(size, self._send_q_len))
         pkt = _Inflight(self._seq, ST_DATA, chunk)
         self._inflight[self._seq] = pkt
         self._order.append(self._seq)
@@ -606,6 +680,7 @@ class UtpConnection:
         return bytes(mask)
 
     def _send_ack(self) -> None:
+        self._pending_acks = 0  # cumulative: covers everything pending
         self._transmit_raw(encode_packet(
             ST_STATE, self.send_id, _now_us(), self._reply_micro,
             self._recv_window(), self._seq, self._ack,
@@ -737,28 +812,25 @@ class UtpEndpoint(asyncio.DatagramProtocol):
     def datagram_received(self, data: bytes, addr) -> None:
         addr = addr[:2]
         try:
-            (ptype, conn_id, *_rest) = decode_packet(data)
+            packet = decode_packet(data)
         except PacketError:
             return
+        ptype, conn_id = packet[0], packet[1]
         if self._remote is not None:
             addr = self._remote  # connected socket: normalize the key
         conn = self._conns.get((addr, conn_id))
         if conn is not None:
-            conn.on_datagram(data)
+            conn.on_datagram(packet)
             return
         if ptype == ST_SYN and self.accept_cb is not None:
-            self._accept(data, addr)
+            self._accept(packet, addr)
         elif ptype not in (ST_RESET, ST_SYN):
             # unknown connection: tell the sender to go away
             self._send(encode_packet(
                 ST_RESET, conn_id, _now_us(), 0, 0, 0, 0), addr)
 
-    def _accept(self, data: bytes, addr) -> None:
-        try:
-            (_t, conn_id, _ts, _td, _wnd, seq, _ack, _sack,
-             _payload) = decode_packet(data)
-        except PacketError:
-            return
+    def _accept(self, packet, addr) -> None:
+        conn_id, seq = packet[1], packet[5]
         # SYN retransmit (our ST_STATE was lost or slow): the live
         # acceptor is registered under conn_id+1 — packets from the
         # initiator carry that id, but retransmitted SYNs still carry the

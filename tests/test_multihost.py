@@ -75,7 +75,8 @@ with plan.mesh:
 '''
 
 
-def test_two_process_training_over_global_mesh():
+def _run_two_workers(worker_src: str, timeout: int = 300) -> list:
+    """Launch two coordinated worker processes; return their outputs."""
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
@@ -85,7 +86,7 @@ def test_two_process_training_over_global_mesh():
            "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
     env.pop("JAX_PLATFORMS", None)  # workers switch in-process
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = _WORKER.replace("%PORT%", str(port))
+    src = worker_src.replace("%PORT%", str(port))
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", src, str(i)],
@@ -97,7 +98,7 @@ def test_two_process_training_over_global_mesh():
     outputs = []
     try:
         for proc in procs:
-            out, _ = proc.communicate(timeout=300)
+            out, _ = proc.communicate(timeout=timeout)
             outputs.append(out)
             assert proc.returncode == 0, out[-2000:]
     finally:
@@ -108,6 +109,11 @@ def test_two_process_training_over_global_mesh():
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+    return outputs
+
+
+def test_two_process_training_over_global_mesh():
+    outputs = _run_two_workers(_WORKER)
 
     # both processes computed the SAME global losses (the gradient psum
     # crossed the process boundary and agreed), and training progressed
@@ -119,3 +125,78 @@ def test_two_process_training_over_global_mesh():
     assert len(l0) == len(l1) == 2, (outputs[0][-500:], outputs[1][-500:])
     assert l0 == l1
     assert float(l0[1]) < float(l0[0])  # adam moved downhill on step 2
+
+
+_INFER_WORKER = r'''
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jb
+
+_jb.clear_backends()
+jax.distributed.initialize(
+    coordinator_address="127.0.0.1:%PORT%",
+    num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+
+import numpy as np
+
+from downloader_tpu.compute.models.upscaler import UpscalerConfig
+from downloader_tpu.compute.pipeline import FrameUpscaler
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+config = UpscalerConfig(features=8, depth=2, scale=2)
+
+# the PRODUCTION inference layout: batch sharded over a 1-axis mesh of
+# ALL global devices, params replicated (compute/pipeline.py) — the one
+# graph the service ships, now crossing a process boundary
+engine = FrameUpscaler(config=config, batch=8, use_mesh=True)
+assert engine.n_devices == 8, engine.n_devices
+
+# single-device reference in the SAME process (identical seed => same
+# params); byte-equality of each addressable shard against its slice of
+# the reference output proves the cross-process layout computes the same
+reference = FrameUpscaler(config=config, batch=8, use_mesh=False)
+
+rng = np.random.default_rng(7)
+y = rng.integers(0, 256, (8, 16, 16), np.uint8)
+cb = rng.integers(0, 256, (8, 8, 8), np.uint8)
+cr = rng.integers(0, 256, (8, 8, 8), np.uint8)
+
+ref = reference.upscale_batch(y, cb, cr, 2, 2)
+dispatched, _n = engine._dispatch(y, cb, cr, 2, 2)
+
+checksum = 0
+for plane, ref_plane in zip(dispatched, ref):
+    assert not plane.is_fully_addressable  # really crosses processes
+    shards = plane.addressable_shards
+    assert len(shards) == 4, len(shards)  # 4 local devices of 8
+    for shard in shards:
+        local = np.asarray(shard.data)
+        np.testing.assert_array_equal(local, ref_plane[shard.index])
+        checksum += int(local.sum())
+
+print(f"proc {jax.process_index()} shards-ok checksum {checksum}",
+      flush=True)
+'''
+
+
+def test_two_process_inference_matches_single_device():
+    """The upscale stage's data-parallel inference layout over a mesh
+    spanning TWO OS processes produces byte-identical planes to the
+    single-device engine — the multi-controller proof for the one
+    production graph that only had single-process evidence (VERDICT r3
+    weak #5 / next-round item 6)."""
+    outputs = _run_two_workers(_INFER_WORKER)
+    for out in outputs:
+        assert "shards-ok" in out, out[-2000:]
+    # each process verified byte-equality of ITS shard half; the two
+    # halves cover disjoint device sets, so together: the full batch
+    checks = [line for o in outputs for line in o.splitlines()
+              if "shards-ok" in line]
+    assert len(checks) == 2, checks

@@ -87,12 +87,15 @@ async def test_fs_rejects_traversal(tmp_path):
 
 
 async def test_fs_stale_tmp_swept_and_filtered(tmp_path):
-    """An ingest temp orphaned by SIGKILL (dead pid in its name) is swept
-    at store construction; a live-pid temp (possibly a concurrent put) is
-    kept but never enumerated as an object (advisor r3)."""
+    """An ingest temp orphaned by SIGKILL (dead pid in its name, older
+    than the cross-host grace) is reclaimed by the next list walk; a
+    live-pid temp and a FRESH dead-pid temp (possibly another host's
+    in-flight put — the pid probe is host-local) are kept, and no temp
+    is ever enumerated as an object (advisor r3 / review r4)."""
     import os
     import subprocess
     import sys
+    import time
 
     root = tmp_path / "objects"
     fs = FilesystemObjectStore(str(root))
@@ -103,22 +106,23 @@ async def test_fs_stale_tmp_swept_and_filtered(tmp_path):
     child = subprocess.Popen([sys.executable, "-c", ""])
     child.wait()
     bucket_dir = root / "b" / "dir"
-    dead = bucket_dir / f"obj2.tmp.{child.pid}.0"
-    dead.write_bytes(b"orphaned partial")
+    dead_old = bucket_dir / f"obj2.tmp.{child.pid}.0"
+    dead_old.write_bytes(b"orphaned partial")
+    aged = time.time() - 600  # past the 5-minute cross-host grace
+    os.utime(dead_old, (aged, aged))
+    dead_fresh = bucket_dir / f"obj4.tmp.{child.pid}.1"
+    dead_fresh.write_bytes(b"maybe another host's put")
     live = bucket_dir / f"obj3.tmp.{os.getpid()}.7"
     live.write_bytes(b"concurrent put in flight")
+    os.utime(live, (aged, aged))
 
-    # neither temp is an object, even before any sweep
+    # the walk filters all temps and reclaims only the aged orphan
     names = [info.name async for info in fs.list_objects("b")]
     assert names == ["dir/obj"]
-
-    # construction over the same root reclaims the orphan only
-    fs2 = FilesystemObjectStore(str(root))
-    assert not dead.exists()
+    assert not dead_old.exists()
+    assert dead_fresh.exists()
     assert live.exists()
-    names = [info.name async for info in fs2.list_objects("b")]
-    assert names == ["dir/obj"]
-    assert (await fs2.get_object("b", "dir/obj")) == b"real"
+    assert (await fs.get_object("b", "dir/obj")) == b"real"
 
 
 async def test_fs_reserved_tmp_suffix_rejected(tmp_path):
@@ -140,8 +144,10 @@ async def test_fs_put_object_orphan_is_reclaimed(tmp_path):
     """put_object's temps use the same unique reclaimable naming as
     fput_object — a SIGKILLed byte put must not leave a phantom object
     (review r4: the old bare '<path>.tmp' was never swept)."""
+    import os
     import subprocess
     import sys
+    import time
 
     root = tmp_path / "objects"
     fs = FilesystemObjectStore(str(root))
@@ -150,10 +156,11 @@ async def test_fs_put_object_orphan_is_reclaimed(tmp_path):
     child.wait()
     orphan = root / "b" / f"half.bin.tmp.{child.pid}.3"
     orphan.write_bytes(b"half-written by a killed process")
+    aged = time.time() - 600
+    os.utime(orphan, (aged, aged))
 
     names = [info.name async for info in fs.list_objects("b")]
-    assert names == []  # never enumerated
-    FilesystemObjectStore(str(root))  # constructor sweep reclaims
+    assert names == []  # never enumerated; the walk reclaims it
     assert not orphan.exists()
 
 

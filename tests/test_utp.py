@@ -235,7 +235,7 @@ async def test_zero_window_recovery(monkeypatch):
             # the deadlock state: peer quenched us, nothing in flight,
             # bytes still waiting to be sent
             while not (conn._peer_wnd < conn.max_payload
-                       and not conn._inflight and conn._send_buf):
+                       and not conn._inflight and conn._send_q_len):
                 await asyncio.sleep(0.02)
             release.set()
             writer.close()
@@ -275,7 +275,7 @@ async def test_zero_window_probe_is_minimal(monkeypatch):
         async with asyncio.timeout(30):
             # reach the stall: peer quenched us, flight empty, data queued
             while not (conn._peer_wnd < conn.max_payload
-                       and not conn._inflight and conn._send_buf):
+                       and not conn._inflight and conn._send_q_len):
                 await asyncio.sleep(0.02)
             # record what the stalled sender puts on the wire from here on
             sent = []
@@ -298,6 +298,67 @@ async def test_zero_window_probe_is_minimal(monkeypatch):
             writer.close()
             await done.wait()
         assert bytes(got) == payload
+    finally:
+        server.close()
+
+
+async def test_delayed_acks_halve_ack_rate():
+    """On a clean in-order bulk transfer the receiver acks every Nth
+    data packet (cumulative ack_nr makes this protocol-legal), so
+    ST_STATE datagrams run at ~1/N the data rate — the r3 profile
+    measured one ack per data packet as roughly half the per-packet
+    processing budget (BASELINE.md 'uTP: where the time goes')."""
+    from downloader_tpu.torrent.utp import DELAYED_ACK_EVERY
+
+    counts = {"data": 0, "state": 0}
+
+    class Counting:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def sendto(self, data, addr=None):
+            kind = decode_packet(bytes(data))[0]
+            if kind == ST_DATA:
+                counts["data"] += 1
+            elif kind == ST_STATE:
+                counts["state"] += 1
+            if addr is None:
+                self._inner.sendto(data)
+            else:
+                self._inner.sendto(data, addr)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    done = asyncio.Event()
+
+    async def handler(reader, writer):
+        while True:
+            chunk = await reader.read(1 << 18)
+            if not chunk:
+                break
+        done.set()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    server._transport = Counting(server._transport)  # counts server acks
+    try:
+        _reader, writer = await open_utp_connection(*server.local_addr)
+        conn = writer._conn
+        payload = os.urandom(4 << 20)
+        view = memoryview(payload)
+        async with asyncio.timeout(30):
+            for off in range(0, len(view), 1 << 18):
+                writer.write(view[off:off + (1 << 18)])
+                await writer.drain()
+            writer.close()
+            await writer.wait_closed()
+            await done.wait()
+        data_pkts = 4 * (1 << 20) // conn.max_payload
+        # the server's ST_STATEs ack the client's data stream: near
+        # 1/DELAYED_ACK_EVERY of the data packets, far below 1 per
+        # packet (slack for handshake/FIN/timer-flushed odd tails)
+        assert counts["state"] <= data_pkts / DELAYED_ACK_EVERY + 10, counts
+        assert counts["state"] >= data_pkts / (2 * DELAYED_ACK_EVERY), counts
     finally:
         server.close()
 
