@@ -39,40 +39,46 @@ class Upscaler(nn.Module):
     :meth:`backbone` exposes the pre-shuffle sub-pixel maps
     (B, H, W, C*scale^2) — the inference engine's fused output tail does
     colorspace + quantize in the sub-pixel domain BEFORE the shuffle
-    (measured 33% off the 720p stage step on a v5e, BASELINE.md r3), so
-    it needs the tensor the pixel shuffle would consume.  The param tree
-    is identical either way.
+    (measured 33% off the 720p stage step on a v5e, BASELINE.md r3).
+    :meth:`trunk` exposes the pre-head features (B, H, W, features) —
+    the engine's s2d head (r4) replaces the lane-starved C_out=scale^2*3
+    head conv with a stride-2 packed conv built from the SAME ``subpixel``
+    params (see ``ops.s2d_head``).  The param tree is identical on every
+    path (``stem``, ``body_i``, ``subpixel`` — setup-defined so all three
+    entry points share one set of submodules).
     """
 
     config: UpscalerConfig = UpscalerConfig()
 
-    @nn.compact
-    def backbone(self, frames: jax.Array) -> jax.Array:
+    def setup(self):
         cfg = self.config
-        x = frames.astype(cfg.compute_dtype)
-
-        x = nn.Conv(
+        self.stem = nn.Conv(
             cfg.features, (5, 5), padding="SAME",
             dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
-            name="stem",
-        )(x)
-        x = nn.relu(x)
-
-        for i in range(cfg.depth - 1):
-            residual = x
-            x = nn.Conv(
+        )
+        self.body = [
+            nn.Conv(
                 cfg.features, (3, 3), padding="SAME",
                 dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
-                name=f"body_{i}",
-            )(x)
-            x = nn.relu(x) + residual  # residual keeps deep stacks trainable
-
+            )
+            for _ in range(cfg.depth - 1)
+        ]
         # project to scale^2 * channels sub-pixel maps
-        return nn.Conv(
+        self.subpixel = nn.Conv(
             cfg.channels * cfg.scale * cfg.scale, (3, 3), padding="SAME",
             dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
-            name="subpixel",
-        )(x)
+        )
+
+    def trunk(self, frames: jax.Array) -> jax.Array:
+        """Stem + residual body: the pre-head feature maps."""
+        x = frames.astype(self.config.compute_dtype)
+        x = nn.relu(self.stem(x))
+        for conv in self.body:
+            x = nn.relu(conv(x)) + x  # residual keeps deep stacks trainable
+        return x
+
+    def backbone(self, frames: jax.Array) -> jax.Array:
+        return self.subpixel(self.trunk(frames))
 
     def __call__(self, frames: jax.Array) -> jax.Array:
         return pixel_shuffle(self.backbone(frames), self.config.scale)

@@ -168,6 +168,49 @@ def fused_subpixel_ycc(subpixel_rgb: jax.Array, scale: int):
     return y_full, quantize_u8(cb), quantize_u8(cr)
 
 
+def fused_subpixel_ycc_s2d(packed: jax.Array, scale: int):
+    """The fused sub-pixel tail for the s2d head's packed output.
+
+    Input: ``(B, H/2, W/2, 4*scale^2*3)`` from :func:`ops.s2d_head.s2d_head`
+    — channel block ``g = di*2+dj`` holds the ``scale^2*3`` sub-pixel
+    maps of full-res position ``(2i+di, 2j+dj)``, each block laid out
+    exactly like :func:`fused_subpixel_ycc`'s input.  Output: identical
+    planes to ``fused_subpixel_ycc(h12, scale)`` on the corresponding
+    unpacked tensor — ``y`` at (B, H*scale, W*scale), chroma at
+    (B, H, W) — via a two-level shuffle (s2d block, then sub-pixel).
+    The arithmetic per element is the same contraction, so the two
+    paths agree exactly (pinned by ``test_s2d_tail_matches_fused``).
+    """
+    from .pixel_shuffle import quantize_u8
+
+    b, hh, ww, c_full = packed.shape
+    r = scale
+    if c_full != 4 * r * r * 3:
+        raise ValueError(
+            f"expected {4 * r * r * 3} packed sub-pixel channels, got {c_full}")
+    sub = packed.reshape(b, hh, ww, 4, r * r, 3)
+    y_sub = jnp.matmul(sub, 255.0 * _RGB2YCC[0],
+                       precision="highest")        # (b, hh, ww, 4, r*r)
+    y_u8 = quantize_u8(y_sub)
+    yv = y_u8.reshape(b, hh, ww, 2, 2, r, r)       # (di, dj, si, sj)
+    y_full = (
+        yv.transpose(0, 1, 3, 5, 2, 4, 6)          # rows i,di,si / cols j,dj,sj
+        .reshape(b, hh * 2 * r, ww * 2 * r)
+    )
+    mean_rgb = sub.mean(axis=4, dtype=jnp.float32)  # (b, hh, ww, 4, 3)
+    cb = jnp.matmul(mean_rgb, 255.0 * _RGB2YCC[1],
+                    precision="highest") + 128.0
+    cr = jnp.matmul(mean_rgb, 255.0 * _RGB2YCC[2],
+                    precision="highest") + 128.0
+
+    def _chroma(plane_u8):
+        return (plane_u8.reshape(b, hh, ww, 2, 2)
+                .transpose(0, 1, 3, 2, 4)
+                .reshape(b, hh * 2, ww * 2))
+
+    return y_full, _chroma(quantize_u8(cb)), _chroma(quantize_u8(cr))
+
+
 def downsample_chroma(plane: jax.Array, sub_h: int, sub_w: int) -> jax.Array:
     """(B, H, W) -> (B, H/sub_h, W/sub_w) by box (mean) filter — the
     standard siting-agnostic decimation for re-encoding subsampled chroma."""

@@ -28,11 +28,13 @@ from .models.upscaler import Upscaler, UpscalerConfig
 from .ops.colorspace import (
     downsample_chroma,
     fused_subpixel_ycc,
+    fused_subpixel_ycc_s2d,
     rgb_to_ycbcr,
     upsample_chroma,
     ycbcr_to_unit_rgb,
 )
 from .ops.pixel_shuffle import quantize_u8
+from .ops.s2d_head import s2d_head
 from .video import Y4MReader, Y4MWriter
 
 
@@ -108,6 +110,8 @@ class FrameUpscaler:
 
         scale = self.config.scale
 
+        compute_dtype = self.config.compute_dtype
+
         def fn(params, y, cb, cr):
             yf = y.astype(jnp.float32)
             cbf = upsample_chroma(cb.astype(jnp.float32), sub_h, sub_w)
@@ -116,9 +120,21 @@ class FrameUpscaler:
             # small structural win; lane-dim-3/12 elementwise passes are
             # fusion-dependent on TPU — BASELINE.md r3)
             rgb = ycbcr_to_unit_rgb(yf, cbf, crf)
+            height, width = y.shape[1], y.shape[2]
             if sub_h == scale and sub_w == scale:
-                # fused sub-pixel output tail (the common 4:2:0 +
-                # matching-scale path)
+                # the common 4:2:0 + matching-scale path
+                if height % 2 == 0 and width % 2 == 0:
+                    # s2d head (r4): the plain head's C_out=scale^2*3
+                    # starves the MXU's 128 output lanes (~27 ms of a
+                    # ~100 ms 720p step); the stride-2 packed head
+                    # computes the same numbers at 4x the lane width —
+                    # -34% on the whole step (scripts/mfu_r4.py group 3)
+                    feats = model.apply(params, rgb, method=Upscaler.trunk)
+                    head = params["params"]["subpixel"]
+                    packed = s2d_head(feats, head["kernel"], head["bias"],
+                                      compute_dtype)
+                    return fused_subpixel_ycc_s2d(packed, scale)
+                # odd frame dims: fused sub-pixel tail on the plain head
                 h12 = model.apply(params, rgb, method=Upscaler.backbone)
                 return fused_subpixel_ycc(h12, scale)
             out = model.apply(params, rgb)

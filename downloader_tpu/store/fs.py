@@ -34,8 +34,11 @@ def _is_stale_tmp(filename: str, path: str) -> bool:
 
     A put interrupted by SIGKILL/power loss leaves its per-call-unique
     temp behind with nothing to reclaim it.  Dead embedded pid + a
-    5-minute age (cross-host writers have no pid here) marks it stale;
-    a day-old temp is stale regardless of the pid check (pid reuse)."""
+    5-minute age (cross-host writers have no pid here) marks it stale.
+    A pid the probe confirms LIVE is never reclaimed — a local writer
+    mid-put must not lose its temp no matter how slow (review r4); the
+    day-scale max age applies only when the probe is inconclusive
+    (EPERM: the pid exists under another uid, possibly recycled)."""
     match = _TMP_RE.search(filename)
     if match is None:
         return False
@@ -43,8 +46,6 @@ def _is_stale_tmp(filename: str, path: str) -> bool:
         age = time.time() - os.stat(path).st_mtime
     except OSError:
         return False  # gone already (concurrent replace/reclaim)
-    if age > _STALE_MAX_AGE_S:
-        return True
     if age < _STALE_GRACE_S:
         return False
     try:
@@ -52,8 +53,8 @@ def _is_stale_tmp(filename: str, path: str) -> bool:
     except ProcessLookupError:
         return True
     except OSError:
-        pass  # EPERM: pid exists under another uid — treat as live
-    return False
+        return age > _STALE_MAX_AGE_S  # inconclusive probe
+    return False  # provably live local writer
 
 
 def _safe_parts(name: str) -> list:
@@ -188,8 +189,30 @@ def _read_file(path: str) -> bytes:
         return fh.read()
 
 
+def _reclaim_dir(dirpath: str) -> None:
+    """Unlink provably-orphaned ingest temps in ONE directory.
+
+    Called on every put (cheap: one listdir of a typically-small dir)
+    so write-only workloads reclaim their orphans too — the list walk
+    is the other reclaim point, and a deployment that never lists
+    would otherwise accumulate SIGKILLed partials forever (review r4)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return
+    for name in names:
+        if _TMP_RE.search(name):
+            full = os.path.join(dirpath, name)
+            if _is_stale_tmp(name, full):
+                try:
+                    os.unlink(full)
+                except OSError:
+                    pass
+
+
 def _write_file_atomic(path: str, data: bytes, suffix: str) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    _reclaim_dir(os.path.dirname(path))
     tmp = f"{path}.tmp.{suffix}"
     try:
         with open(tmp, "wb") as fh:
@@ -205,6 +228,7 @@ def _write_file_atomic(path: str, data: bytes, suffix: str) -> None:
 
 def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str) -> None:
     os.makedirs(os.path.dirname(dst), exist_ok=True)
+    _reclaim_dir(os.path.dirname(dst))
     tmp = f"{dst}.tmp.{suffix}"
     try:
         if link_ok:

@@ -1,0 +1,315 @@
+"""Round-4 v4-methodology time budget of the shipped 720p stage step.
+
+The r2 per-layer budget was built on the discredited v3 harness (scalar
+slice feedback -> XLA elision); no v4-era accounting existed, leaving
+~54% of chip peak unattributed (VERDICT r3 weak #2).  This script times
+FULL-STAGE graph variants — never isolated ops — interleaved in one
+process (drift-immune), with the v4 sum-through-nonlinear-quantize
+feedback, and derives the budget from graph DIFFERENCES:
+
+  body   : depth sweep (1/2/3 residual convs) -> per-conv slope
+  stem   : 5x5 stem vs 1x1 stem (same channels) -> 5x5 cost minus a
+           small 1x1 residual (K=3 -> ~0 flops)
+  head   : 3x3 vs 1x1 head -> likewise
+  front  : shipped colorspace front vs stack-only (no 3x3 matmul) vs
+           luma-broadcast (no chroma upsample either)
+  tail   : fused sub-pixel tail vs quantize-h12-and-stop (backbone_q)
+           and vs the naive shuffle->colorspace->downsample tail
+
+  python scripts/mfu_r4.py budget          # the accounting
+  python scripts/mfu_r4.py budget-quick    # fewer rounds (sanity)
+
+Prints one JSON line: per-variant ms/step, the derived component
+budget, conv-flops MFU per component, and HBM roofline estimates.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, H, W = 8, 720, 1280
+F = 128
+SCALE = 2
+
+# public v5e numbers (cloud.google.com/tpu/docs): dense bf16 peak and
+# HBM bandwidth per chip
+PEAK_TFLOPS = 197.0
+HBM_GBPS = 819.0
+
+
+def conv(x, kh, kw, cin, cout, key=0):
+    k = jax.random.normal(jax.random.PRNGKey(key), (kh, kw, cin, cout),
+                          jnp.bfloat16) * 0.05
+    return jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def make_variants():
+    from downloader_tpu.compute.ops.colorspace import (
+        fused_subpixel_ycc, rgb_to_ycbcr, upsample_chroma,
+        ycbcr_to_unit_rgb,
+    )
+    from downloader_tpu.compute.ops.pixel_shuffle import (
+        pixel_shuffle, quantize_u8,
+    )
+
+    def front_full(y, cb, cr):
+        yf = y.astype(jnp.float32)
+        cbf = upsample_chroma(cb.astype(jnp.float32), 2, 2)
+        crf = upsample_chroma(cr.astype(jnp.float32), 2, 2)
+        return ycbcr_to_unit_rgb(yf, cbf, crf)
+
+    def front_nomat(y, cb, cr):
+        # stack + scale only: difference vs front_full = the 3x3
+        # colorspace matmul pass
+        yf = y.astype(jnp.float32)
+        cbf = upsample_chroma(cb.astype(jnp.float32), 2, 2)
+        crf = upsample_chroma(cr.astype(jnp.float32), 2, 2)
+        return jnp.stack([yf, cbf, crf], axis=-1) * (1.0 / 255.0)
+
+    def front_luma(y, cb, cr):
+        # luma broadcast: difference vs front_nomat = chroma upsample
+        yf = y.astype(jnp.float32) * (1.0 / 255.0)
+        return jnp.stack([yf, yf, yf], axis=-1)
+
+    def backbone(rgb, depth=3, stem=(5, 5), head=(3, 3)):
+        x = rgb.astype(jnp.bfloat16)
+        x = jax.nn.relu(conv(x, stem[0], stem[1], 3, F, key=1))
+        for i in range(depth):
+            x = jax.nn.relu(conv(x, 3, 3, F, F, key=10 + i)) + x
+        return conv(x, head[0], head[1], F, 3 * SCALE * SCALE, key=20)
+
+    def tail_fused(h12):
+        return fused_subpixel_ycc(h12, SCALE)
+
+    def tail_naive(h12):
+        out = pixel_shuffle(h12.astype(jnp.float32), SCALE)
+        y2, cb2, cr2 = rgb_to_ycbcr(out * 255.0)
+        b, hh, ww = y2.shape
+        cb2 = cb2.reshape(b, hh // 2, 2, ww // 2, 2).mean(axis=(2, 4))
+        cr2 = cr2.reshape(b, hh // 2, 2, ww // 2, 2).mean(axis=(2, 4))
+        return quantize_u8(y2), quantize_u8(cb2), quantize_u8(cr2)
+
+    def stage(front=front_full, depth=3, stem=(5, 5), head=(3, 3),
+              tail=tail_fused):
+        def fn(y, cb, cr):
+            h12 = backbone(front(y, cb, cr), depth, stem, head)
+            return tail(h12)
+        return fn
+
+    def backbone_q(y, cb, cr):
+        # stop after the head: quantize h12 at 720p and emit planes of
+        # the REAL output shapes (so harness cost stays comparable);
+        # difference vs full = tail minus this quantize/slice
+        h12 = backbone(front_full(y, cb, cr))
+        q = quantize_u8(h12.astype(jnp.float32) * 255.0)
+        y2 = jnp.repeat(jnp.repeat(q[..., 0], 2, axis=1), 2, axis=2)
+        return y2, q[..., 1], q[..., 2]
+
+    def stage_head_s2d(y, cb, cr):
+        """Group-3 candidate: the head's C_out=12 uses 12/128 of the
+        MXU's output lanes (group-1 measured it at ~27 ms vs a ~1 ms
+        flops bound).  Reformulate as a stride-2 4x4 conv producing 48
+        channels at 360p — the four shifted 3x3 windows of each 2x2
+        output block share one matmul, so N goes 12 -> 48 for 16/9 the
+        flops.  The tail then does a two-level sub-pixel shuffle."""
+        x = backbone_pre(front_full(y, cb, cr))
+        # timing stand-in for the zero-padded packed kernel (zeros don't
+        # change conv timing)
+        k = jax.random.normal(jax.random.PRNGKey(21), (4, 4, F, 48),
+                              jnp.bfloat16) * 0.05
+        h48 = jax.lax.conv_general_dilated(
+            x, k, (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        b, hh, ww, _ = h48.shape  # 360p
+        sub = h48.astype(jnp.float32).reshape(b, hh, ww, 4, 4, 3)
+        # luma for all 16 sub-pixels of the 4x4 block (unit domain x255)
+        y_sub = (76.544 * sub[..., 0] + 150.272 * sub[..., 1]
+                 + 29.184 * sub[..., 2])
+        y_u8 = quantize_u8(y_sub)  # (b, hh, ww, 4(g), 4(s))
+        y16 = y_u8.reshape(b, hh, ww, 2, 2, 2, 2)  # (di, dj, si, sj)
+        y2 = y16.transpose(0, 1, 3, 5, 2, 4, 6).reshape(
+            b, hh * 4, ww * 4)
+        mean_rgb = sub.reshape(b, hh, ww, 2, 2, 2, 2, 3).mean(axis=(5, 6))
+        cb2 = (-43.2 * mean_rgb[..., 0] - 84.8 * mean_rgb[..., 1]
+               + 128.0 * mean_rgb[..., 2]) + 128.0  # (b,hh,ww,2,2)
+        cr2 = (128.0 * mean_rgb[..., 0] - 107.2 * mean_rgb[..., 1]
+               - 20.8 * mean_rgb[..., 2]) + 128.0
+        cb_u8 = quantize_u8(cb2).transpose(0, 1, 3, 2, 4).reshape(
+            b, hh * 2, ww * 2)
+        cr_u8 = quantize_u8(cr2).transpose(0, 1, 3, 2, 4).reshape(
+            b, hh * 2, ww * 2)
+        return y2, cb_u8, cr_u8
+
+    def backbone_pre(rgb):
+        x = rgb.astype(jnp.bfloat16)
+        x = jax.nn.relu(conv(x, 5, 5, 3, F, key=1))
+        for i in range(3):
+            x = jax.nn.relu(conv(x, 3, 3, F, F, key=10 + i)) + x
+        return x
+
+    return {
+        "full": stage(),
+        "body_d1": stage(depth=1),
+        "body_d2": stage(depth=2),
+        "stem_1x1": stage(stem=(1, 1)),
+        "head_1x1": stage(head=(1, 1)),
+        "front_nomat": stage(front=front_nomat),
+        "front_luma": stage(front=front_luma),
+        "tail_naive": stage(tail=tail_naive),
+        "backbone_q": backbone_q,
+        "head_s2d": stage_head_s2d,
+    }
+
+
+def time_variants(fns, rounds=4, lo_i=4, hi_i=12):
+    host = np.random.default_rng(0)
+    y0 = jnp.asarray(host.integers(0, 256, (B, H, W), np.uint8))
+    cb0 = jnp.asarray(host.integers(0, 256, (B, H // 2, W // 2), np.uint8))
+    cr0 = jnp.asarray(host.integers(0, 256, (B, H // 2, W // 2), np.uint8))
+
+    def rollout(fn, iters):
+        fn = jax.jit(fn)  # nested jit, like the engine's _compiled fn
+
+        def step(s, _):
+            y2, cb2, cr2 = fn(y0 + s, cb0 + s, cr0 + s)
+            total = (jnp.sum(y2, dtype=jnp.int32)
+                     + jnp.sum(cb2, dtype=jnp.int32)
+                     + jnp.sum(cr2, dtype=jnp.int32))
+            return total.astype(jnp.uint8), ()
+
+        def run():
+            final, _ = jax.lax.scan(step, jnp.uint8(0), None, length=iters)
+            return final
+
+        return jax.jit(run)
+
+    compiled = {}
+    for name, fn in fns.items():
+        lo_f, hi_f = rollout(fn, lo_i), rollout(fn, hi_i)
+        jax.device_get(lo_f())
+        jax.device_get(hi_f())
+        compiled[name] = (lo_f, hi_f)
+    best = {name: None for name in fns}
+    for _ in range(rounds):
+        for name, (lo_f, hi_f) in compiled.items():
+            t0 = time.monotonic()
+            jax.device_get(lo_f())
+            t1 = time.monotonic()
+            jax.device_get(hi_f())
+            t2 = time.monotonic()
+            dt_ms = ((t2 - t1) - (t1 - t0)) / (hi_i - lo_i) * 1e3
+            if best[name] is None or dt_ms < best[name]:
+                best[name] = dt_ms
+    return best
+
+
+def conv_flops(kh, kw, cin, cout):
+    return 2 * B * H * W * kh * kw * cin * cout
+
+
+def derive_budget(ms):
+    """Component costs from graph differences + MFU/roofline notes."""
+    full = ms["full"]
+    per_body = (full - ms["body_d1"]) / 2  # depth 3 -> 1 removes 2 convs
+    per_body2 = full - ms["body_d2"]       # cross-check: removes 1
+    stem_delta = full - ms["stem_1x1"]     # 5x5 minus 1x1 residual
+    head_delta = full - ms["head_1x1"]
+    front_mat = full - ms["front_nomat"]
+    chroma_up = ms["front_nomat"] - ms["front_luma"]
+    tail_vs_bq = full - ms["backbone_q"]
+    tail_win = ms["tail_naive"] - full
+
+    comp = {
+        "body_conv_ms_each": round(per_body, 2),
+        "body_conv_ms_each_crosscheck": round(per_body2, 2),
+        "body_total_ms": round(3 * per_body, 2),
+        "stem_5x5_minus_1x1_ms": round(stem_delta, 2),
+        "head_3x3_minus_1x1_ms": round(head_delta, 2),
+        "front_colorspace_matmul_ms": round(front_mat, 2),
+        "front_chroma_upsample_ms": round(chroma_up, 2),
+        "tail_minus_h12_quantize_ms": round(tail_vs_bq, 2),
+        "tail_fused_vs_naive_win_ms": round(tail_win, 2),
+    }
+
+    # conv-component MFU at the measured per-component times
+    flops = {
+        "body": conv_flops(3, 3, F, F),
+        "stem": conv_flops(5, 5, 3, F),
+        "head": conv_flops(3, 3, F, 12),
+    }
+    mfu = {}
+    if per_body > 0:
+        mfu["body_conv_mfu"] = round(
+            flops["body"] / (per_body / 1e3) / 1e12 / PEAK_TFLOPS, 3)
+    if stem_delta > 0:
+        mfu["stem_mfu_upper"] = round(
+            flops["stem"] / (stem_delta / 1e3) / 1e12 / PEAK_TFLOPS, 3)
+    if head_delta > 0:
+        mfu["head_mfu_upper"] = round(
+            (flops["head"] * 8 / 9)  # 3x3 minus 1x1 of the same channels
+            / (head_delta / 1e3) / 1e12 / PEAK_TFLOPS, 3)
+
+    # HBM roofline context: one full-tensor f32 pass at 720p x3 chan
+    bytes_720p3_f32 = B * H * W * 3 * 4
+    bytes_720p128_bf16 = B * H * W * F * 2
+    roofline = {
+        "pass_720p_rgb_f32_ms": round(
+            2 * bytes_720p3_f32 / (HBM_GBPS * 1e9) * 1e3, 2),
+        "pass_720p_f128_bf16_ms": round(
+            2 * bytes_720p128_bf16 / (HBM_GBPS * 1e9) * 1e3, 2),
+        "body_conv_flops_bound_ms": round(
+            flops["body"] / (PEAK_TFLOPS * 1e12) * 1e3, 2),
+        "stem_flops_bound_ms": round(
+            flops["stem"] / (PEAK_TFLOPS * 1e12) * 1e3, 2),
+        "head_flops_bound_ms": round(
+            flops["head"] / (PEAK_TFLOPS * 1e12) * 1e3, 2),
+    }
+
+    accounted = (3 * per_body + stem_delta + head_delta + front_mat
+                 + chroma_up + tail_vs_bq)
+    comp["accounted_ms"] = round(accounted, 2)
+    comp["full_ms"] = round(full, 2)
+    comp["unattributed_ms"] = round(full - accounted, 2)
+    return comp, mfu, roofline
+
+
+# each group fits one compile window; `full` is in every group so all
+# differences are same-group, same-drift
+GROUPS = {
+    "1": ["full", "body_d1", "body_d2", "stem_1x1", "head_1x1"],
+    "2": ["full", "front_nomat", "front_luma", "tail_naive", "backbone_q"],
+    "3": ["full", "head_s2d"],
+}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "budget"
+    rounds = 2 if which == "budget-quick" else 5
+    out = {"experiment": which, "backend": jax.default_backend(),
+           "device": jax.devices()[0].device_kind,
+           "shape": [B, H, W]}
+    variants = make_variants()
+    group = os.environ.get("MFU_R4_GROUP")
+    if group in GROUPS:
+        variants = {k: variants[k] for k in GROUPS[group]}
+        out["group"] = group
+    ms = time_variants(variants, rounds=rounds)
+    out["variants_ms"] = {k: round(v, 2) for k, v in ms.items()}
+    if group is None:
+        comp, mfu, roofline = derive_budget(ms)
+        out["budget"] = comp
+        out["mfu"] = mfu
+        out["roofline"] = roofline
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,31 @@ async def test_fs_put_object_orphan_is_reclaimed(tmp_path):
     assert not orphan.exists()
 
 
+async def test_fs_put_reclaims_orphans_in_its_directory(tmp_path):
+    """Write-only workloads (no list walks) still reclaim: every put
+    sweeps provably-stale temps in its destination directory
+    (review r4)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    root = tmp_path / "objects"
+    fs = FilesystemObjectStore(str(root))
+    await fs.make_bucket("b")
+    await fs.put_object("b", "dir/seed", b"x")  # create the dir
+    child = subprocess.Popen([sys.executable, "-c", ""])
+    child.wait()
+    orphan = root / "b" / "dir" / f"old.bin.tmp.{child.pid}.9"
+    orphan.write_bytes(b"orphaned partial")
+    aged = time.time() - 600
+    os.utime(orphan, (aged, aged))
+
+    await fs.put_object("b", "dir/fresh", b"y")
+    assert not orphan.exists()
+    assert (await fs.get_object("b", "dir/fresh")) == b"y"
+
+
 # -- filesystem backend: hardlink ingest fast path ----------------------
 
 
