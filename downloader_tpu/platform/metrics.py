@@ -85,6 +85,19 @@ class Metrics:
             "Video frames run through the upscale stage's TPU model",
             registry=self.registry,
         )
+        self.transcode_bytes_in = Counter(
+            f"{ns}_transcode_bytes_in_total",
+            "Source bytes (container or raw y4m) consumed by the "
+            "upscale stage's transcode",
+            registry=self.registry,
+        )
+        self.transcode_bytes_out = Counter(
+            f"{ns}_transcode_bytes_out_total",
+            "Output bytes (container or raw y4m) written by the upscale "
+            "stage's transcode — out/in quantifies the staging size "
+            "effect of the encode back-end",
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",

@@ -212,6 +212,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     ctx.metrics, "frames_upscaled"
                 ):
                     ctx.metrics.frames_upscaled.inc(frames)
+                # separate guard: the duck-typing contract protects the
+                # attributes actually used (an embedder's metrics object
+                # may predate these counters)
+                if ctx.metrics is not None and hasattr(
+                    ctx.metrics, "transcode_bytes_in"
+                ):
+                    ctx.metrics.transcode_bytes_in.inc(
+                        os.path.getsize(path))
+                    ctx.metrics.transcode_bytes_out.inc(
+                        os.path.getsize(dst))
                 out_files.append(dst)
 
         return {"files": out_files, "downloadPath": download_path}
