@@ -215,9 +215,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # separate guard: the duck-typing contract protects the
                 # attributes actually used (an embedder's metrics object
                 # may predate these counters)
-                if ctx.metrics is not None and hasattr(
-                    ctx.metrics, "transcode_bytes_in"
-                ):
+                if (ctx.metrics is not None
+                        and hasattr(ctx.metrics, "transcode_bytes_in")
+                        and hasattr(ctx.metrics, "transcode_bytes_out")):
                     ctx.metrics.transcode_bytes_in.inc(
                         os.path.getsize(path))
                     ctx.metrics.transcode_bytes_out.inc(

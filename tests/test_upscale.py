@@ -878,10 +878,12 @@ async def test_pipeline_end_to_end_with_upscale(tmp_path):
         await media_srv.cleanup()
 
 
-async def test_transcode_metrics_count_bytes(tmp_path):
-    """The production graph's metrics quantify the transcode: bytes in
-    (source media) and bytes out (what upload will stage) — out/in is
-    the encode back-end's staging-size effect, visible on /metrics."""
+async def test_pipeline_end_to_end_with_encode(tmp_path):
+    """download -> upscale -> ENCODE -> upload: the staged object is the
+    encoder's compressed container, closing the loop the reference's
+    pipeline expects (compressed media in staging, lib/process.js:15-20).
+    Runs through build_service so the production metrics are asserted in
+    the same pass (transcode bytes in/out = the staging-size effect)."""
     from downloader_tpu.app import build_service
     from downloader_tpu.mq import InMemoryBroker
     from downloader_tpu.store import InMemoryObjectStore
@@ -896,49 +898,6 @@ async def test_transcode_metrics_count_bytes(tmp_path):
     orchestrator, metrics, _telemetry = build_service(
         _upscale_config(tmp_path, encode=True, encoder=stub),
         broker, store,
-    )
-    await orchestrator.start()
-    try:
-        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(
-            schemas.Download(media=schemas.Media(
-                id="m-1", creator_id="c1",
-                type=schemas.MediaType.Value("MOVIE"),
-                source=schemas.SourceType.Value("HTTP"),
-                source_uri=f"{base}/clip.y4m"))))
-        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
-
-        assert metrics.transcode_bytes_in._value.get() == len(clip)
-        name = "m-1/original/" + base64.b64encode(b"clip.y4m.2x.mkv").decode()
-        staged = await store.get_object("triton-staging", name)
-        assert metrics.transcode_bytes_out._value.get() == len(staged)
-        assert metrics.frames_upscaled._value.get() == 4
-    finally:
-        await orchestrator.shutdown(grace_seconds=5)
-        await media_srv.cleanup()
-
-
-async def test_pipeline_end_to_end_with_encode(tmp_path):
-    """download -> upscale -> ENCODE -> upload: the staged object is the
-    encoder's compressed container, closing the loop the reference's
-    pipeline expects (compressed media in staging, lib/process.js:15-20)."""
-    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
-    from downloader_tpu.orchestrator import Orchestrator
-    from downloader_tpu.platform.logging import NullLogger
-    from downloader_tpu.store import InMemoryObjectStore
-
-    from helpers import start_media_server
-
-    stub = _write_stub_encoder(tmp_path)
-    clip = make_y4m(16, 12, frames=4)
-    media_srv, base = await start_media_server(clip, path="/clip.y4m")
-    broker = InMemoryBroker()
-    store = InMemoryObjectStore()
-    orchestrator = Orchestrator(
-        config=_upscale_config(tmp_path, encode=True, encoder=stub),
-        mq=MemoryQueue(broker),
-        store=store,
-        logger=NullLogger(),
-        stages=["download", "process", "upscale", "upload"],
     )
     await orchestrator.start()
     try:
@@ -964,6 +923,11 @@ async def test_pipeline_end_to_end_with_encode(tmp_path):
         assert reader.header.width == 32 and reader.header.height == 24
         assert len(list(reader)) == 4
         await store.get_object("triton-staging", "enc-1/original/done")
+
+        # production metrics quantify the transcode (visible on /metrics)
+        assert metrics.transcode_bytes_in._value.get() == len(clip)
+        assert metrics.transcode_bytes_out._value.get() == len(staged)
+        assert metrics.frames_upscaled._value.get() == 4
     finally:
         await orchestrator.shutdown(grace_seconds=5)
         await media_srv.cleanup()
