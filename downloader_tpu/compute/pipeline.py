@@ -41,6 +41,13 @@ from .video import Y4MReader, Y4MWriter
 class FrameUpscaler:
     """Holds params + compiled geometry-keyed upscale functions."""
 
+    # Per-device pixel budget per dispatch: the conv activations are
+    # H*W*features*2 bytes each with several alive, so frames-per-batch
+    # must shrink as resolution grows.  Measured on a 16 GB v5e: 8x1080p
+    # (16.6 M px) compiles and runs, 4x4K (33 M px) fails at compile.
+    # 8 x 1080p exactly — the largest measured-good per-device load.
+    PIXEL_BUDGET = 8 * 1920 * 1080
+
     def __init__(
         self,
         config: UpscalerConfig = UpscalerConfig(),
@@ -145,6 +152,17 @@ class FrameUpscaler:
 
         return jax.jit(fn)
 
+    def batch_for(self, height: int, width: int) -> int:
+        """Resolution-aware dispatch size: the configured batch, capped
+        so per-device pixels stay inside :data:`PIXEL_BUDGET` (a 4K
+        stream at the default batch 8 would otherwise fail XLA
+        compilation on a 16 GB chip), kept a multiple of the data-axis
+        size so every device gets equal shards."""
+        per_device = max(1, self.PIXEL_BUDGET // (height * width))
+        # both operands are positive multiples of n_devices (__init__
+        # rounds self.batch up), so the min is too
+        return min(self.batch, per_device * self.n_devices)
+
     def _place(self, arr: np.ndarray):
         if self._plane_sharding is not None:
             return self._make_global(arr, self._plane_sharding)
@@ -161,7 +179,7 @@ class FrameUpscaler:
         round-trip — works.  :meth:`_fetch` materializes the result.
         """
         n = y.shape[0]
-        pad = self.batch - n
+        pad = self.batch_for(y.shape[1], y.shape[2]) - n
         if pad:
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], np.uint8)])
             cb = np.concatenate([cb, np.zeros((pad,) + cb.shape[1:], np.uint8)])
@@ -197,11 +215,32 @@ class FrameUpscaler:
         sub_h: int,
         sub_w: int,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Upscale (n, H, W)/(n, ch, cw) uint8 planes; n <= self.batch.
+        """Upscale (n, H, W)/(n, ch, cw) uint8 planes, any n.
 
-        Pads n up to the static batch, runs the compiled fn, slices back.
+        Pads n up to the dispatch batch (resolution-capped — see
+        :meth:`batch_for`), runs the compiled fn, slices back; n beyond
+        the cap is pipelined through capped chunks (dispatch runs ahead
+        of fetch, like :meth:`upscale_to`, so chunked 4K batches keep
+        the async d2h overlap instead of paying serial round trips).
         """
-        return self._fetch(self._dispatch(y, cb, cr, sub_h, sub_w))
+        from collections import deque
+
+        eff = self.batch_for(y.shape[1], y.shape[2])
+        if y.shape[0] <= eff:
+            return self._fetch(self._dispatch(y, cb, cr, sub_h, sub_w))
+        inflight: deque = deque()
+        parts = []
+        for i in range(0, y.shape[0], eff):
+            inflight.append(self._dispatch(
+                y[i:i + eff], cb[i:i + eff], cr[i:i + eff], sub_h, sub_w))
+            if len(inflight) >= 3:
+                parts.append(self._fetch(inflight.popleft()))
+        while inflight:
+            parts.append(self._fetch(inflight.popleft()))
+        return tuple(
+            np.concatenate([part[plane] for part in parts])
+            for plane in range(3)
+        )
 
     def upscale_y4m(self, src_path: str, dst_path: str) -> int:
         """Upscale a Y4M file; returns the number of frames written."""
@@ -241,7 +280,10 @@ class FrameUpscaler:
                 writer.write_frame(y2[i], cb2[i], cr2[i])
             frames += y2.shape[0]
 
-        for y, cb, cr in _batched(iter(reader), self.batch):
+        # resolution-capped batch: a 4K stream must not blow HBM just
+        # because the configured batch suits 720p (see batch_for)
+        batch = self.batch_for(hdr.height, hdr.width)
+        for y, cb, cr in _batched(iter(reader), batch):
             inflight.append(self._dispatch(y, cb, cr, sub_h, sub_w))
             if len(inflight) >= depth:
                 drain_one()

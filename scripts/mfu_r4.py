@@ -34,7 +34,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-B, H, W = 8, 720, 1280
+# override via MFU_R4_SHAPE="B,H,W" (e.g. "4,1080,1920" for the 1080p
+# datapoint — halve the batch to keep the step inside the same memory)
+B, H, W = map(int, os.environ.get("MFU_R4_SHAPE", "8,720,1280").split(","))
 F = 128
 SCALE = 2
 

@@ -236,6 +236,67 @@ def test_fused_subpixel_tail_matches_naive():
         assert (diff == 0).mean() > 0.97
 
 
+def test_batch_for_caps_by_resolution():
+    """The dispatch batch shrinks as resolution grows: a 4K stream at
+    the default batch 8 exceeds the measured per-device activation
+    budget and fails XLA compilation on a 16 GB chip (hardware-probed
+    r4) — the cap keeps every geometry compilable."""
+    from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    engine = FrameUpscaler(
+        config=UpscalerConfig(features=8, depth=2), batch=8, use_mesh=False
+    )
+    assert engine.batch_for(720, 1280) == 8       # default shape: uncapped
+    assert engine.batch_for(1080, 1920) == 8      # the budget boundary
+    assert engine.batch_for(2160, 3840) == 2      # 4K: measured-good size
+    assert engine.batch_for(16, 16) == 8          # tiny frames: uncapped
+    # never below one frame per device
+    engine.PIXEL_BUDGET = 1
+    assert engine.batch_for(2160, 3840) == engine.n_devices
+
+
+def test_upscale_stream_and_batch_respect_pixel_budget(tmp_path):
+    """With the budget shrunk, the stream dispatches capped batches and
+    upscale_batch chunks oversize inputs — outputs stay identical."""
+    from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    engine = FrameUpscaler(
+        config=UpscalerConfig(features=8, depth=2), batch=4, use_mesh=False
+    )
+    rng = np.random.default_rng(9)
+    y = rng.integers(0, 256, (4, 16, 16), np.uint8)
+    cb = rng.integers(0, 256, (4, 8, 8), np.uint8)
+    cr = rng.integers(0, 256, (4, 8, 8), np.uint8)
+    full = engine.upscale_batch(y, cb, cr, 2, 2)
+
+    engine.PIXEL_BUDGET = 2 * 16 * 16  # force cap: 2 frames per dispatch
+    assert engine.batch_for(16, 16) == 2
+    dispatched = []
+    original = engine._dispatch
+
+    def spy(y, cb, cr, sub_h, sub_w):
+        dispatched.append(y.shape[0])
+        return original(y, cb, cr, sub_h, sub_w)
+
+    engine._dispatch = spy
+    chunked = engine.upscale_batch(y, cb, cr, 2, 2)
+    assert dispatched == [2, 2]
+    for a, b in zip(full, chunked):
+        np.testing.assert_array_equal(a, b)
+
+    src = tmp_path / "clip.y4m"
+    src.write_bytes(make_y4m(16, 16, frames=5))
+    dst = tmp_path / "clip.2x.y4m"
+    dispatched.clear()
+    frames = engine.upscale_y4m(str(src), str(dst))
+    assert frames == 5
+    assert dispatched == [2, 2, 1]  # capped batches, short tail
+    header = sniff_y4m(str(dst))
+    assert header.width == 32 and header.height == 32
+
+
 def test_s2d_head_matches_plain_head():
     """The stride-2 packed head computes exactly the plain SAME 3x3 head
     conv, relaid: out3x3[b, 2i+di, 2j+dj, c] == packed[b, i, j,

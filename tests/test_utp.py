@@ -302,6 +302,45 @@ async def test_zero_window_probe_is_minimal(monkeypatch):
         server.close()
 
 
+async def test_take_chunk_matches_bytearray_reference():
+    """The zero-memmove send queue must hand out exactly the bytes a
+    plain bytearray buffer would, across random interleavings of writes
+    and arbitrary-size takes (the r4 rewrite's byte accounting).
+    Async so UtpConnection's asyncio primitives see a running loop."""
+    import random as stdlib_random
+
+    from downloader_tpu.torrent.utp import UtpConnection, UtpEndpoint
+
+    rng = stdlib_random.Random(0x5EED)
+    for _ in range(50):
+        conn = UtpConnection(UtpEndpoint(), ("127.0.0.1", 1),
+                             recv_id=1, send_id=2, seq=1)
+        reference = bytearray()
+        stream = bytearray()
+        taken = bytearray()
+        for _ in range(rng.randrange(2, 30)):
+            if rng.random() < 0.6 or not conn._send_q_len:
+                blob = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(0, 2000)))
+                stream += blob
+                reference += blob
+                if blob:  # _write would flush; append directly instead
+                    conn._send_q.append(blob)
+                    conn._send_q_len += len(blob)
+            else:
+                want = rng.randrange(1, 1500)
+                size = min(want, conn._send_q_len)
+                chunk = conn._take_chunk(size)
+                assert chunk == bytes(reference[:size])
+                del reference[:size]
+                taken += chunk
+        while conn._send_q_len:
+            size = min(777, conn._send_q_len)
+            taken += conn._take_chunk(size)
+        assert bytes(taken) == bytes(stream)
+        assert conn._send_q_len == 0 and not conn._send_q
+
+
 async def test_delayed_acks_halve_ack_rate():
     """On a clean in-order bulk transfer the receiver acks every Nth
     data packet (cumulative ack_nr makes this protocol-legal), so
