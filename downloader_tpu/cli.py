@@ -430,46 +430,23 @@ def _upscale(args) -> int:
     upscaler = FrameUpscaler(
         batch=args.batch, checkpoint_dir=args.checkpoint_dir
     )
-    # snapshot dst BEFORE running: failure cleanup must only remove
-    # output THIS run wrote (created or truncated), never a pre-existing
-    # file from an earlier successful run that an early usage error
-    # (e.g. missing src) never touched
-    try:
-        pre = os.stat(args.dst)
-    except OSError:
-        pre = None
     try:
         from .compute.transcode import DEFAULT_ENCODE_ARGS, transcode
 
+        # transcode owns partial-dst cleanup: it removes dst on failure
+        # exactly when THIS run created/truncated it, so a pre-existing
+        # output from an earlier run survives usage errors (and no stat
+        # heuristic is needed — coarse-mtime filesystems defeat those)
         frames = transcode(
             upscaler, args.src, args.dst,
             decoder=decoder, encoder=encoder,
             encode_args=(args.encode_args if getattr(args, "encode_args", None)
                          else DEFAULT_ENCODE_ARGS),
         )
-    except BaseException as err:
-        # match the stage: NOTHING may leave a partial output behind to
-        # be mistaken for valid media (the y4m/container dst is created
-        # before the first byte parses) — but only if this run touched it
-        try:
-            cur = os.stat(args.dst)
-        except OSError:
-            cur = None
-        touched = cur is not None and (
-            pre is None
-            or (cur.st_ino, cur.st_mtime_ns, cur.st_size)
-            != (pre.st_ino, pre.st_mtime_ns, pre.st_size)
-        )
-        if touched:
-            try:
-                os.unlink(args.dst)
-            except OSError:
-                pass
-        if isinstance(err, RuntimeError):
-            # clean operator error instead of a traceback
-            print(f"transcode failed: {err}", file=sys.stderr)
-            return 1
-        raise
+    except RuntimeError as err:
+        # clean operator error instead of a traceback
+        print(f"transcode failed: {err}", file=sys.stderr)
+        return 1
     print(f"upscaled {frames} frames -> {args.dst}")
     return 0
 

@@ -33,6 +33,7 @@ Subprocess hygiene, shared by both ends:
 
 from __future__ import annotations
 
+import os
 import subprocess
 import tempfile
 from typing import Optional, Sequence
@@ -69,13 +70,38 @@ def transcode(
     encoder: Optional[str] = None,
     encode_args: Sequence[str] = DEFAULT_ENCODE_ARGS,
     depth: int = 3,
+    cleanup_dst_on_error: bool = True,
 ) -> int:
     """Run ``src`` through (decode ->) upscale (-> encode) into ``dst``.
 
-    Returns the number of frames processed.  Raises ``RuntimeError`` with
-    the failing codec's stderr tail on subprocess failure; callers own
-    partial-``dst`` cleanup (the stage and CLI both unlink on error).
+    Returns the number of frames processed.  Raises ``RuntimeError``
+    with the failing codec's stderr tail on subprocess failure.  The
+    output is written to a same-directory temp name (extension
+    preserved — encoders infer the muxer from it) and renamed onto
+    ``dst`` only after every process exited cleanly: a pre-existing
+    ``dst`` survives ANY failure untouched, no partial output is ever
+    visible under the final name, and no stat heuristics are needed
+    (coarse-mtime filesystems made the old caller-side ones
+    false-negative; review r4).
     """
+    ext = os.path.splitext(dst)[1]
+    tmp_dst = f"{dst}.part-{os.getpid()}{ext}"
+    try:
+        frames = _transcode(engine, src, tmp_dst, decoder, encoder,
+                            encode_args, depth)
+        os.replace(tmp_dst, dst)
+        return frames
+    except BaseException:
+        if cleanup_dst_on_error:
+            try:
+                os.unlink(tmp_dst)
+            except OSError:
+                pass
+        raise
+
+
+def _transcode(engine, src, dst, decoder, encoder, encode_args,
+               depth) -> int:
     from .video import Y4MError
 
     dec = enc = None

@@ -52,7 +52,10 @@ def _is_stale_tmp(filename: str, path: str) -> bool:
         os.kill(int(match.group(1)), 0)
     except ProcessLookupError:
         return True
-    except OSError:
+    except (OSError, OverflowError):
+        # EPERM (pid under another uid, possibly recycled) or a pid
+        # field beyond the C pid_t range (foreign/corrupt file —
+        # OverflowError must not wedge every list/put in the directory)
         return age > _STALE_MAX_AGE_S  # inconclusive probe
     return False  # provably live local writer
 
@@ -84,13 +87,41 @@ class FilesystemObjectStore(ObjectStore):
     alias the stored object.  Objects themselves are always replaced
     atomically, never edited in place, so linking never aliases
     store-side writes.  Cross-device sources (or filesystems without
-    hardlinks) transparently fall back to a copy."""
+    hardlinks) transparently fall back to a copy.
+
+    Object keys whose final segment matches the ingest-temp pattern
+    (``*.tmp.<digits>.<digits>``) are a reserved namespace: rejected on
+    write, filtered from listings, and reclaimable by the orphan sweep.
+    The pipeline itself never produces such names (staged objects are
+    ``<id>/original/<base64>`` plus ``done``); a FOREIGN store carrying
+    such keys from before this scheme should rename them before
+    pointing this driver at it."""
 
     def __init__(self, root: str, link_puts: bool = True):
         self.root = os.path.abspath(root)
         self.link_puts = link_puts
         self._tmp_seq = itertools.count()
+        # per-directory sweep clocks: the per-put orphan reclaim is
+        # rate-limited so a bulk ingest into one big directory pays
+        # O(listdir) once per grace period, not per put (review r4)
+        self._swept: dict = {}
         os.makedirs(self.root, exist_ok=True)
+
+    def _should_sweep(self, path: str) -> bool:
+        dirpath = os.path.dirname(path)
+        now = time.monotonic()
+        if now - self._swept.get(dirpath, -_STALE_GRACE_S) < _STALE_GRACE_S:
+            return False
+        if len(self._swept) >= 1024:
+            # the ingest layout mints a directory per object id, so the
+            # clock dict would grow forever in a long-lived process —
+            # evict expired entries (their absence just means one extra
+            # sweep later)
+            cutoff = now - _STALE_GRACE_S
+            self._swept = {d: t for d, t in self._swept.items()
+                           if t > cutoff}
+        self._swept[dirpath] = now
+        return True
 
     def _bucket_path(self, bucket: str) -> str:
         (part,) = _safe_parts(bucket) or [""]
@@ -120,6 +151,7 @@ class FilesystemObjectStore(ObjectStore):
         await asyncio.to_thread(
             _write_file_atomic, path, data,
             f"{os.getpid()}.{next(self._tmp_seq)}",
+            self._should_sweep(path),
         )
 
     async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
@@ -139,6 +171,7 @@ class FilesystemObjectStore(ObjectStore):
             # process must not share a tmp name (unlink/link/replace
             # would race and one put would die with FileNotFoundError)
             f"{os.getpid()}.{next(self._tmp_seq)}",
+            self._should_sweep(dst),
         )
 
     async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
@@ -210,9 +243,11 @@ def _reclaim_dir(dirpath: str) -> None:
                     pass
 
 
-def _write_file_atomic(path: str, data: bytes, suffix: str) -> None:
+def _write_file_atomic(path: str, data: bytes, suffix: str,
+                       sweep: bool = True) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    _reclaim_dir(os.path.dirname(path))
+    if sweep:
+        _reclaim_dir(os.path.dirname(path))
     tmp = f"{path}.tmp.{suffix}"
     try:
         with open(tmp, "wb") as fh:
@@ -226,9 +261,11 @@ def _write_file_atomic(path: str, data: bytes, suffix: str) -> None:
         raise
 
 
-def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str) -> None:
+def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str,
+                        sweep: bool = True) -> None:
     os.makedirs(os.path.dirname(dst), exist_ok=True)
-    _reclaim_dir(os.path.dirname(dst))
+    if sweep:
+        _reclaim_dir(os.path.dirname(dst))
     tmp = f"{dst}.tmp.{suffix}"
     try:
         if link_ok:

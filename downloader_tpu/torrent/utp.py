@@ -484,6 +484,7 @@ class UtpConnection:
             if len(self._ooo) < MAX_OOO:
                 self._ooo.setdefault(seq, (ptype, payload))
             return False
+        filled_gap = bool(self._ooo)
         self._deliver(ptype, payload)
         self._ack = seq
         # drain any now-in-order packets
@@ -494,7 +495,11 @@ class UtpConnection:
                 break
             self._deliver(entry[0], entry[1])
             self._ack = nxt
-        return not self._ooo
+        # a retransmission that fills a reordering gap must be acked NOW
+        # (the cumulative ack jumps past the sacked range; delaying it
+        # would hold the sender's flight bytes for up to the timer tick),
+        # as must anything leaving further gaps behind
+        return not filled_gap and not self._ooo
 
     def _deliver(self, ptype: int, payload: bytes) -> None:
         if ptype == ST_FIN:

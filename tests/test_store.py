@@ -184,9 +184,17 @@ async def test_fs_put_reclaims_orphans_in_its_directory(tmp_path):
     aged = time.time() - 600
     os.utime(orphan, (aged, aged))
 
+    fs._swept.clear()  # the per-dir sweep is rate-limited; force it due
     await fs.put_object("b", "dir/fresh", b"y")
     assert not orphan.exists()
     assert (await fs.get_object("b", "dir/fresh")) == b"y"
+
+    # rate limiting: within the grace period the put does NOT listdir
+    orphan2 = root / "b" / "dir" / f"old2.bin.tmp.{child.pid}.10"
+    orphan2.write_bytes(b"another orphan")
+    os.utime(orphan2, (aged, aged))
+    await fs.put_object("b", "dir/fresh2", b"z")
+    assert orphan2.exists()  # swept only after the per-dir clock expires
 
 
 # -- filesystem backend: hardlink ingest fast path ----------------------

@@ -240,6 +240,27 @@ def test_cli_upscale_direct_failure_leaves_no_partial(tmp_path):
     assert not dst.exists()
 
 
+def test_cli_upscale_midfailure_preserves_existing_dst(tmp_path):
+    """Transcode writes through a temp name and renames on success, so a
+    pre-existing dst survives even a MID-transcode failure with its
+    original bytes (review r4: the old truncate-in-place lost them),
+    and no .part temp is left behind."""
+    import os
+    import pytest as pytest_mod
+
+    from downloader_tpu.cli import main
+    from downloader_tpu.compute.video import Y4MError
+
+    dst = tmp_path / "out.y4m"
+    dst.write_bytes(b"good output from an earlier run")
+    src = tmp_path / "corrupt.y4m"
+    src.write_bytes(make_y4m(16, 12, frames=2)[:-10])
+    with pytest_mod.raises(Y4MError):
+        main(["upscale", str(src), str(dst), "--batch", "2"])
+    assert dst.read_bytes() == b"good output from an earlier run"
+    assert not [p for p in os.listdir(tmp_path) if ".part-" in p]
+
+
 def test_cli_upscale_usage_error_preserves_existing_dst(tmp_path):
     """A failure BEFORE this run ever opens dst (missing src here) must
     not delete a pre-existing output from an earlier successful run

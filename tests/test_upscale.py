@@ -444,74 +444,18 @@ def test_upscale_stream_pipelines_io_and_compute():
     exercising the path (the tunneled-chip pipeline bench) cannot
     distinguish broken pipelining from a slow link (VERDICT r3 weak #1).
     """
-    import time
-
     from downloader_tpu.compute.models.upscaler import UpscalerConfig
+    from downloader_tpu.compute.overlap_probe import measure_overlap
     from downloader_tpu.compute.pipeline import FrameUpscaler
 
     engine = FrameUpscaler(
         config=UpscalerConfig(features=16, depth=2), batch=4, use_mesh=False
     )
-    height, width = 96, 160
-    batches = 12
-    frame_interval = 0.0125  # 50 ms of blocking "IO" per 4-frame batch
-
-    rng = np.random.default_rng(0)
-    frames = [
-        (rng.integers(0, 256, (height, width), np.uint8),
-         rng.integers(0, 256, (height // 2, width // 2), np.uint8),
-         rng.integers(0, 256, (height // 2, width // 2), np.uint8))
-        for _ in range(4)
-    ]
-    y = np.stack([f[0] for f in frames])
-    cb = np.stack([f[1] for f in frames])
-    cr = np.stack([f[2] for f in frames])
-    engine.upscale_batch(y, cb, cr, 2, 2)  # compile outside the timings
-
-    # pure-compute reference: dispatch+fetch with inputs pre-read
-    start = time.monotonic()
-    for _ in range(batches):
-        engine.upscale_batch(y, cb, cr, 2, 2)
-    t_comp = time.monotonic() - start
-
-    buf = io.BytesIO()
-    writer = Y4MWriter(buf, Y4MHeader(width=width, height=height))
-    for i in range(batches * 4):
-        writer.write_frame(*frames[i % 4])
-    data = buf.getvalue()
-
-    class PacedSource:
-        """Y4M source that blocks like a rate-limited decoder pipe."""
-
-        def __init__(self):
-            self._buf = io.BytesIO(data)
-
-        def readline(self, n=-1):
-            return self._buf.readline(n)
-
-        def read(self, n=-1):
-            time.sleep(frame_interval)
-            return self._buf.read(n)
-
-    t_io = batches * 4 * frame_interval
-    walls = {}
-    for depth in (1, 3):  # 1 = drain-after-every-dispatch serial bound
-        with open(os.devnull, "wb") as sink:
-            start = time.monotonic()
-            n = engine.upscale_to(PacedSource(), sink, depth=depth)
-        walls[depth] = time.monotonic() - start
-        assert n == batches * 4
-
-    hideable = min(t_io, t_comp)
-    overlap = (walls[1] - walls[3]) / hideable
+    result = measure_overlap(engine)  # the bench runs the SAME harness
     # measured ~1.2 on this host (writes overlap too); 0.5 is the
     # broken-pipelining alarm threshold with ample noise margin
-    assert overlap >= 0.5, (
-        f"pipelining hid only {overlap:.0%} of the hideable time "
-        f"(serial {walls[1]:.3f}s, pipelined {walls[3]:.3f}s, "
-        f"io {t_io:.3f}s, compute {t_comp:.3f}s)"
-    )
-    assert walls[3] <= walls[1] * 0.85
+    assert result["overlap"] >= 0.5, result
+    assert result["pipelined_s"] <= result["serial_s"] * 0.85, result
 
 
 # -------------------------------------------------------------------- stage
