@@ -631,24 +631,22 @@ def bench_compressed_pipeline(timeout_s: float = 900.0) -> dict:
         return {"compressed_pipeline_error": f"bad output {proc.stdout[:200]!r}"}
 
 
-async def bench_torrent(mib: int = 32) -> dict:
+async def bench_torrent(mib: int = 32, reps: int = 2) -> dict:
     """Secondary: loopback swarm throughput (seeder -> leeching client,
     real peer wire protocol, SHA-1 verification, disk on both ends).
 
     All three transports move the SAME payload size so their fixed costs
     amortize identically (r2 used 64/32/16 MiB, which biased exactly the
-    comparison the table invites)."""
+    comparison the table invites).  ``reps`` interleaved rounds: each
+    transport reports its best, and ``utp_vs_tcp`` is the best SAME-ROUND
+    pair ratio (cross-round ratios would mix host states — the ratio is
+    the noise-robust comparator on this shared host, BASELINE.md r4)."""
     import tempfile
 
     from downloader_tpu.torrent import Seeder, TorrentClient, make_metainfo
     from downloader_tpu.torrent.tracker import Peer
 
-    out = {}
-    for crypto, transport, label, size in (
-        ("plaintext", "tcp", "torrent_swarm_mbps", mib),
-        ("require", "tcp", "torrent_swarm_encrypted_mbps", mib),
-        ("plaintext", "utp", "torrent_swarm_utp_mbps", mib),
-    ):
+    async def one(crypto: str, transport: str, size: int) -> float:
         with tempfile.TemporaryDirectory() as tmp:
             src_dir = os.path.join(tmp, "seed", "payload")
             os.makedirs(src_dir)
@@ -669,7 +667,28 @@ async def bench_torrent(mib: int = 32) -> dict:
             )
             elapsed = time.monotonic() - started
             await seeder.stop()
-        out[label] = round(size * (1 << 20) / 1e6 / elapsed, 1)
+        return size * (1 << 20) / 1e6 / elapsed
+
+    configs = (
+        ("plaintext", "tcp", "torrent_swarm_mbps"),
+        ("require", "tcp", "torrent_swarm_encrypted_mbps"),
+        ("plaintext", "utp", "torrent_swarm_utp_mbps"),
+    )
+    best = {label: 0.0 for _c, _t, label in configs}
+    best_ratio = 0.0
+    for _ in range(reps):
+        round_rates = {}
+        for crypto, transport, label in configs:
+            rate = await one(crypto, transport, mib)
+            round_rates[label] = rate
+            best[label] = max(best[label], rate)
+        best_ratio = max(
+            best_ratio,
+            round_rates["torrent_swarm_utp_mbps"]
+            / round_rates["torrent_swarm_mbps"],
+        )
+    out = {label: round(rate, 1) for label, rate in best.items()}
+    out["utp_vs_tcp"] = round(best_ratio, 3)
     return out
 
 

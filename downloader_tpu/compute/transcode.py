@@ -33,10 +33,19 @@ Subprocess hygiene, shared by both ends:
 
 from __future__ import annotations
 
+import glob
+import itertools
 import os
+import re
 import subprocess
 import tempfile
 from typing import Optional, Sequence
+
+# per-call-unique temp suffix: two concurrent transcodes to the same dst
+# in one process must not interleave into one temp (same lesson as the
+# fs store's ingest temps)
+_PART_SEQ = itertools.count()
+_PART_RE = re.compile(r"\.part-(\d+)\.\d+(\.[^.]+)?$")
 
 # x264 in a matroska container: the downstream converter's own deliverable
 # class (reference pipeline containers, lib/process.js:15-20).  CRF 18 is
@@ -70,34 +79,55 @@ def transcode(
     encoder: Optional[str] = None,
     encode_args: Sequence[str] = DEFAULT_ENCODE_ARGS,
     depth: int = 3,
-    cleanup_dst_on_error: bool = True,
 ) -> int:
     """Run ``src`` through (decode ->) upscale (-> encode) into ``dst``.
 
     Returns the number of frames processed.  Raises ``RuntimeError``
     with the failing codec's stderr tail on subprocess failure.  The
-    output is written to a same-directory temp name (extension
-    preserved — encoders infer the muxer from it) and renamed onto
-    ``dst`` only after every process exited cleanly: a pre-existing
-    ``dst`` survives ANY failure untouched, no partial output is ever
-    visible under the final name, and no stat heuristics are needed
-    (coarse-mtime filesystems made the old caller-side ones
-    false-negative; review r4).
+    output is written to a per-call-unique same-directory temp name
+    (extension preserved — encoders infer the muxer from it) and
+    renamed onto ``dst`` only after every process exited cleanly: a
+    pre-existing ``dst`` survives ANY failure untouched, no partial
+    output is ever visible under the final name, and no stat heuristics
+    are needed (coarse-mtime filesystems made the old caller-side ones
+    false-negative; review r4).  Temps orphaned by SIGKILL (they carry
+    media extensions a redelivered job's media walk would ingest) are
+    reclaimed on the next transcode to the same ``dst`` when their
+    writer pid is dead.
     """
+    _reclaim_stale_parts(dst)
     ext = os.path.splitext(dst)[1]
-    tmp_dst = f"{dst}.part-{os.getpid()}{ext}"
+    tmp_dst = f"{dst}.part-{os.getpid()}.{next(_PART_SEQ)}{ext}"
     try:
         frames = _transcode(engine, src, tmp_dst, decoder, encoder,
                             encode_args, depth)
         os.replace(tmp_dst, dst)
         return frames
     except BaseException:
-        if cleanup_dst_on_error:
+        try:
+            os.unlink(tmp_dst)
+        except OSError:
+            pass
+        raise
+
+
+def _reclaim_stale_parts(dst: str) -> None:
+    """Unlink ``dst``'s temp outputs whose writer process is gone; a
+    LIVE pid may be a concurrent transcode racing for the same dst —
+    leave its temp alone (its rename decides the race)."""
+    for path in glob.glob(glob.escape(dst) + ".part-*"):
+        match = _PART_RE.search(path)
+        if match is None:
+            continue
+        try:
+            os.kill(int(match.group(1)), 0)
+        except ProcessLookupError:
             try:
-                os.unlink(tmp_dst)
+                os.unlink(path)
             except OSError:
                 pass
-        raise
+        except (OSError, OverflowError):
+            pass  # inconclusive probe: leave it
 
 
 def _transcode(engine, src, dst, decoder, encoder, encode_args,

@@ -188,23 +188,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     decoded=decoder is not None,
                     encoded=encoder is not None,
                 )
-                try:
-                    # the device work holds the GIL only between dispatches;
-                    # running in a thread keeps heartbeats/telemetry flowing
-                    frames = await asyncio.to_thread(
-                        transcode, engine, path, dst,
-                        decoder=decoder, encoder=encoder,
-                        encode_args=opts["encode_args"],
-                    )
-                except BaseException:
-                    # a partial output (y4m OR half-written container)
-                    # would be picked up as media by the redelivered
-                    # job's process walk — remove it
-                    try:
-                        os.unlink(dst)
-                    except OSError:
-                        pass
-                    raise
+                # the device work holds the GIL only between dispatches;
+                # running in a thread keeps heartbeats/telemetry flowing.
+                # No cleanup here: transcode writes through a temp and
+                # renames on success, so on failure dst either doesn't
+                # exist or is a COMPLETE output from a prior attempt —
+                # which a redelivered job should keep, not delete.
+                frames = await asyncio.to_thread(
+                    transcode, engine, path, dst,
+                    decoder=decoder, encoder=encoder,
+                    encode_args=opts["encode_args"],
+                )
                 logger.info(
                     "upscaled", path=os.path.basename(dst), frames=frames
                 )

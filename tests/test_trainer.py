@@ -261,6 +261,34 @@ def test_cli_upscale_midfailure_preserves_existing_dst(tmp_path):
     assert not [p for p in os.listdir(tmp_path) if ".part-" in p]
 
 
+def test_transcode_reclaims_stale_part_temps(tmp_path):
+    """A .part temp orphaned by SIGKILL carries a media extension the
+    redelivered job's media walk would ingest — the next transcode to
+    the same dst reclaims dead-pid temps and leaves live-pid ones (a
+    concurrent run racing for the same dst) alone."""
+    import os
+    import subprocess
+    import sys
+
+    from downloader_tpu.cli import main
+
+    src = tmp_path / "clip.y4m"
+    src.write_bytes(make_y4m(16, 12, frames=2))
+    dst = tmp_path / "out.y4m"
+    child = subprocess.Popen([sys.executable, "-c", ""])
+    child.wait()
+    stale = tmp_path / f"out.y4m.part-{child.pid}.0.y4m"
+    stale.write_bytes(b"orphaned partial")
+    live = tmp_path / f"out.y4m.part-{os.getpid()}.99.y4m"
+    live.write_bytes(b"concurrent run in flight")
+
+    rc = main(["upscale", str(src), str(dst), "--batch", "2"])
+    assert rc == 0
+    assert not stale.exists()
+    assert live.exists()
+    live.unlink()
+
+
 def test_cli_upscale_usage_error_preserves_existing_dst(tmp_path):
     """A failure BEFORE this run ever opens dst (missing src here) must
     not delete a pre-existing output from an earlier successful run
