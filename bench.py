@@ -155,7 +155,9 @@ async def bench_pipeline():
     med = statistics.median(elapsed)
     # CPU-seconds per staged GB: the host-noise-immune secondary — wall
     # time on this shared VM swings ±20%, but cycles spent per byte do
-    # not depend on how much the neighbors are stealing
+    # not depend on how much the neighbors are stealing.  Contention
+    # still INFLATES cycles (cache/TLB pressure), so the best rep is
+    # the cleanest floor; the median stays the regression basis.
     cpu_s_per_gb = statistics.median(cpu) / (total_mb / 1e3)
     return {
         "mbps": total_mb / med,
@@ -164,6 +166,7 @@ async def bench_pipeline():
                         round(total_mb / min(elapsed), 1)],
         "reps": REPS,
         "cpu_s_per_gb": round(cpu_s_per_gb, 3),
+        "cpu_s_per_gb_best": round(min(cpu) / (total_mb / 1e3), 3),
         "jobs_per_min": JOBS / med * 60,
         "elapsed_s": med,
     }
@@ -709,6 +712,7 @@ def main() -> None:
         "mbps_spread": pipeline["mbps_spread"],
         "reps": pipeline["reps"],
         "cpu_s_per_gb": pipeline["cpu_s_per_gb"],
+        "cpu_s_per_gb_best": pipeline["cpu_s_per_gb_best"],
         "jobs_per_min": round(pipeline["jobs_per_min"], 1),
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,

@@ -433,10 +433,11 @@ def _upscale(args) -> int:
     try:
         from .compute.transcode import DEFAULT_ENCODE_ARGS, transcode
 
-        # transcode owns partial-dst cleanup: it removes dst on failure
-        # exactly when THIS run created/truncated it, so a pre-existing
-        # output from an earlier run survives usage errors (and no stat
-        # heuristic is needed — coarse-mtime filesystems defeat those)
+        # transcode writes through a private temp and renames onto dst
+        # only on success: it NEVER touches dst on failure, so a
+        # pre-existing output from an earlier run survives any error
+        # (no caller-side stat heuristics — coarse-mtime filesystems
+        # defeat those)
         frames = transcode(
             upscaler, args.src, args.dst,
             decoder=decoder, encoder=encoder,

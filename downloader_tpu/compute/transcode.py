@@ -45,7 +45,9 @@ from typing import Optional, Sequence
 # in one process must not interleave into one temp (same lesson as the
 # fs store's ingest temps)
 _PART_SEQ = itertools.count()
-_PART_RE = re.compile(r"\.part-(\d+)\.\d+(\.[^.]+)?$")
+# the seq group is optional so temps from the short-lived earlier
+# naming (.part-<pid><ext>, no counter) are still reclaimable
+_PART_RE = re.compile(r"\.part-(\d+)(?:\.\d+)?(\.[^.]+)?$")
 
 # x264 in a matroska container: the downstream converter's own deliverable
 # class (reference pipeline containers, lib/process.js:15-20).  CRF 18 is

@@ -863,21 +863,38 @@ class UtpEndpoint:
         if remote_addr is not None:
             infos = await loop.getaddrinfo(
                 remote_addr[0], remote_addr[1], type=_socket.SOCK_DGRAM)
-            family, stype, proto, _cn, target = infos[0]
         else:
             infos = await loop.getaddrinfo(
                 host, port, type=_socket.SOCK_DGRAM,
                 flags=_socket.AI_PASSIVE)
-            family, stype, proto, _cn, target = infos[0]
-        sock = _socket.socket(family, stype, proto)
+        # try every addrinfo entry (create_datagram_endpoint's family
+        # fallback: an IPv6-first resolution on an IPv6-disabled host
+        # must fall through to AF_INET, not fail the endpoint)
+        last_exc: Optional[OSError] = None
+        for family, stype, proto, _cn, target in infos:
+            try:
+                sock = _socket.socket(family, stype, proto)
+            except OSError as exc:
+                last_exc = exc
+                continue
+            try:
+                sock.setblocking(False)
+                if remote_addr is not None:
+                    # UDP connect: instant, enables fast ICMP errors
+                    sock.connect(target)
+                    self._remote = remote_addr
+                else:
+                    sock.bind(target)
+            except OSError as exc:
+                # failure must not leak the fd (the old
+                # create_datagram_endpoint closed it for us)
+                sock.close()
+                last_exc = exc
+                continue
+            break
+        else:
+            raise last_exc or OSError("getaddrinfo returned no usable address")
         try:
-            sock.setblocking(False)
-            if remote_addr is not None:
-                # UDP connect: instant, enables fast ICMP errors
-                sock.connect(target)
-                self._remote = remote_addr
-            else:
-                sock.bind(target)
             # default UDP buffers (~208 KiB) overflow under window-sized
             # bursts — the kernel drops the excess silently, which reads
             # as pathological "loss" even on loopback.  The kernel caps
@@ -891,8 +908,6 @@ class UtpEndpoint:
                 loop, sock, self.datagram_received, self.error_received)
             self.local_addr = sock.getsockname()[:2]
         except BaseException:
-            # bind/connect failure must not leak the fd (the old
-            # create_datagram_endpoint closed it for us)
             sock.close()
             raise
         return self
