@@ -49,7 +49,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  human-readable headline value; the old best-rep-vs-v2-freeze ratio is
 #  kept in extra as ``mbps_vs_v2_freeze``.  New in extra: stream-overlap
 #  proof numbers and the compressed-path pipeline metric.
-HARNESS_VERSION = 5
+# v6 (late r4): ONLY the compressed-path fixture changed — bounded noise
+#  added so the container compresses ~9x like typical lossy media
+#  instead of ~85x (which made container-byte MB/s meaningless).
+#  compressed_pipeline_* numbers are not comparable to v5's; staging,
+#  compute, torrent, and overlap measurements are identical to v5 and
+#  vs_baseline's basis is unchanged.
+HARNESS_VERSION = 6
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -513,15 +519,19 @@ async def main():
                  "downloader_tpu.codec \\"$@\\"\\n" % (repo, sys.executable))
     os.chmod(shim, 0o755)
 
-    # natural-ish frames (smooth gradients + noise) so the codec
-    # genuinely compresses; pure noise would inflate container size
+    # natural-ish frames: moving gradients + moderate noise.  Pure
+    # gradients compress ~85x (which shrinks container-bytes MB/s to a
+    # meaningless number) and pure noise barely compresses; the mix
+    # lands in the ~15-30x range of typical lossy-encoded media, so the
+    # container-byte rate is representative.
     raw = os.path.join(tmp, "clip.y4m")
     rng = np.random.default_rng(0)
     yy, xx = np.mgrid[0:h, 0:w]
     with open(raw, "wb") as fh:
         writer = Y4MWriter(fh, Y4MHeader(width=w, height=h))
         for i in range(frames):
-            base = ((yy + xx + 3 * i) % 256).astype(np.uint8)
+            base = ((yy + xx + 3 * i) % 232
+                    + rng.integers(0, 24, (h, w))).astype(np.uint8)
             writer.write_frame(
                 base,
                 np.full((h // 2, w // 2), (64 + i) % 256, np.uint8),
