@@ -50,6 +50,13 @@ _FOURCC = {
 _VALUE_FLAGS = {"-i", "-f", "-pix_fmt", "-loglevel", "-c:v", "-preset",
                 "-crf", "-r"}
 _BARE_FLAGS = {"-y", "-nostdin"}
+# accepted for command-line compatibility with the transcode module's
+# ffmpeg invocations but not implemented by the OpenCV backend (cv2's
+# VideoWriter exposes no rate-control or speed knobs): announced on
+# stderr (unless -loglevel error or below) so operators comparing
+# against real ffmpeg output know the requested rate/quality behavior
+# was not applied (advisor r4)
+_IGNORED_VALUE_FLAGS = {"-preset", "-crf", "-r"}
 
 
 class CodecError(RuntimeError):
@@ -179,6 +186,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
         opts = _parse(argv)
+        ignored = sorted(_IGNORED_VALUE_FLAGS & opts["flags"].keys())
+        # the notice is informational, so it honors -loglevel the way
+        # ffmpeg's own banner/warnings do: anything at or below "error"
+        # silences it (the transcode module always passes -loglevel
+        # error, keeping its captured-stderr failure tails clean)
+        quiet = opts["flags"].get("-loglevel") in (
+            "quiet", "panic", "fatal", "error")
+        if ignored and not quiet:
+            print("downloader-tpu-codec: note: accepted but not "
+                  "implemented by the OpenCV backend (no effect): "
+                  + " ".join(f"{f} {opts['flags'][f]}" for f in ignored),
+                  file=sys.stderr)
         src = opts["flags"]["-i"]
         out = opts["output"]
         if out == "-":

@@ -36,18 +36,18 @@ from __future__ import annotations
 import glob
 import itertools
 import os
-import re
 import subprocess
 import tempfile
 from typing import Optional, Sequence
 
+from ..utils.stale import PART_TEMP_RE as _PART_RE
+from ..utils.stale import probe_stale
+
 # per-call-unique temp suffix: two concurrent transcodes to the same dst
 # in one process must not interleave into one temp (same lesson as the
-# fs store's ingest temps)
+# fs store's ingest temps); naming pattern + reclaim policy are shared
+# with the fs store in utils/stale.py
 _PART_SEQ = itertools.count()
-# the seq group is optional so temps from the short-lived earlier
-# naming (.part-<pid><ext>, no counter) are still reclaimable
-_PART_RE = re.compile(r"\.part-(\d+)(?:\.\d+)?(\.[^.]+)?$")
 
 # x264 in a matroska container: the downstream converter's own deliverable
 # class (reference pipeline containers, lib/process.js:15-20).  CRF 18 is
@@ -92,10 +92,12 @@ def transcode(
     pre-existing ``dst`` survives ANY failure untouched, no partial
     output is ever visible under the final name, and no stat heuristics
     are needed (coarse-mtime filesystems made the old caller-side ones
-    false-negative; review r4).  Temps orphaned by SIGKILL (they carry
-    media extensions a redelivered job's media walk would ingest) are
-    reclaimed on the next transcode to the same ``dst`` when their
-    writer pid is dead.
+    false-negative; review r4).  Temps orphaned by SIGKILL are reclaimed
+    on the next transcode to the same ``dst`` once their writer pid is
+    dead AND a cross-host grace period has passed (the pid probe is
+    host-local — see :func:`..utils.stale.probe_stale`); within the
+    grace window a redelivered job is still safe because the media walk
+    skips part-temp names outright (``stages/process.py``).
     """
     _reclaim_stale_parts(dst)
     ext = os.path.splitext(dst)[1]
@@ -121,15 +123,12 @@ def _reclaim_stale_parts(dst: str) -> None:
         match = _PART_RE.search(path)
         if match is None:
             continue
-        try:
-            os.kill(int(match.group(1)), 0)
-        except ProcessLookupError:
+        stale, _age = probe_stale(path, int(match.group(1)))
+        if stale:
             try:
                 os.unlink(path)
             except OSError:
                 pass
-        except (OSError, OverflowError):
-            pass  # inconclusive probe: leave it
 
 
 def _transcode(engine, src, dst, decoder, encoder, encode_args,

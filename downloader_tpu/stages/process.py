@@ -22,6 +22,7 @@ import re
 from typing import List
 
 from .. import schemas
+from ..utils.stale import PART_TEMP_STRICT_RE as _PART_TEMP_RE
 from .base import Job, StageContext, StageFn
 
 # (reference lib/process.js:15-20)
@@ -100,7 +101,16 @@ def find_media_files(root: str, media: schemas.Media, logger,
                     logger.warn(f"skipping directory '{rel}'")
             else:
                 ext = os.path.splitext(entry.name)[1]
-                if ext in exts:
+                if _PART_TEMP_RE.search(entry.name):
+                    # an in-flight or SIGKILL-orphaned transcode temp
+                    # (<dst>.part-<pid>.<seq><ext>) carries a media
+                    # extension but is never content — ingesting a
+                    # corrupt partial on redelivery is worse than the
+                    # reference's behavior, which has no such temps.
+                    # Strict two-number form only, so real content like
+                    # "Movie.part-2.mkv" is never swallowed (review r5)
+                    logger.warn(f"skipping transcode temp '{rel}'")
+                elif ext in exts:
                     logger.info(f"including file '{rel}'")
                     files.append(entry.path)
                 else:

@@ -17,47 +17,49 @@ import time
 from typing import AsyncIterator
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
+from ..utils.stale import STALE_GRACE_S as _STALE_GRACE_S
+from ..utils.stale import STALE_MAX_AGE_S as _STALE_MAX_AGE_S
+from ..utils.stale import probe_stale
 
 # in-flight ingest temp name: <dst>.tmp.<pid>.<counter> (fput_object)
 _TMP_RE = re.compile(r"\.tmp\.(\d+)\.\d+$")
-
-# reclaim grace periods: a dead-pid temp younger than the short grace
-# may belong to a DIFFERENT host sharing the root (NFS — the pid probe
-# is host-local); any temp older than the long bound is junk even if
-# its pid number was recycled by some unrelated long-lived process
-_STALE_GRACE_S = 300.0
-_STALE_MAX_AGE_S = 24 * 3600.0
 
 
 def _is_stale_tmp(filename: str, path: str) -> bool:
     """True for an ingest temp whose writer is provably gone.
 
     A put interrupted by SIGKILL/power loss leaves its per-call-unique
-    temp behind with nothing to reclaim it.  Dead embedded pid + a
-    5-minute age (cross-host writers have no pid here) marks it stale.
-    A pid the probe confirms LIVE is never reclaimed — a local writer
-    mid-put must not lose its temp no matter how slow (review r4); the
-    day-scale max age applies only when the probe is inconclusive
-    (EPERM: the pid exists under another uid, possibly recycled)."""
+    temp behind with nothing to reclaim it.  Policy (grace for cross-
+    host NFS writers, live-pid immunity, day-scale bound on
+    inconclusive probes) is shared with the transcoder's part-files —
+    see :func:`downloader_tpu.utils.stale.probe_stale`."""
     match = _TMP_RE.search(filename)
     if match is None:
         return False
-    try:
-        age = time.time() - os.stat(path).st_mtime
-    except OSError:
-        return False  # gone already (concurrent replace/reclaim)
-    if age < _STALE_GRACE_S:
-        return False
-    try:
-        os.kill(int(match.group(1)), 0)
-    except ProcessLookupError:
-        return True
-    except (OSError, OverflowError):
-        # EPERM (pid under another uid, possibly recycled) or a pid
-        # field beyond the C pid_t range (foreign/corrupt file —
-        # OverflowError must not wedge every list/put in the directory)
-        return age > _STALE_MAX_AGE_S  # inconclusive probe
-    return False  # provably live local writer
+    stale, _age = probe_stale(path, int(match.group(1)))
+    return stale
+
+
+_warned_foreign: set = set()
+
+
+def _warn_foreign_key(path: str, age: float) -> None:
+    """A temp-patterned file the sweep will never reclaim (its pid field
+    probes live, so it never goes stale) yet far older than any real
+    ingest could run is almost certainly a foreign object key from a
+    store predating the reserved-suffix scheme.  It is hidden from
+    listings and unreachable by get/put — surface it once per process so
+    operators know to migrate it (advisor r4)."""
+    if path in _warned_foreign:
+        return
+    _warned_foreign.add(path)
+    from ..platform.logging import get_logger
+
+    get_logger("store.fs").warn(
+        "ignoring temp-suffixed file that looks like a foreign object "
+        "key (hidden from listings; rename to migrate)",
+        path=path, age_s=round(age),
+    )
 
 
 def _safe_parts(name: str) -> list:
@@ -182,16 +184,21 @@ class FilesystemObjectStore(ObjectStore):
             for dirpath, _dirnames, filenames in os.walk(bucket_path):
                 for filename in filenames:
                     full = os.path.join(dirpath, filename)
-                    if _TMP_RE.search(filename):
+                    match = _TMP_RE.search(filename)
+                    if match:
                         # in-flight/orphaned ingest temp, never an
                         # object; reclaim orphans opportunistically —
                         # piggybacking on this walk keeps the sweep
                         # free (no constructor-time full-tree scan)
-                        if _is_stale_tmp(filename, full):
+                        stale, age = probe_stale(full, int(match.group(1)))
+                        if stale:
                             try:
                                 os.unlink(full)
                             except OSError:
                                 pass
+                        elif age is not None and age > _STALE_MAX_AGE_S:
+                            # live-probing pid + ancient: foreign key
+                            _warn_foreign_key(full, age)
                         continue
                     key = os.path.relpath(full, bucket_path).replace(os.sep, "/")
                     if key.startswith(prefix):

@@ -41,7 +41,7 @@ import random
 import struct
 import time
 from collections import deque
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple, Union
 
 ST_DATA = 0
 ST_FIN = 1
@@ -833,6 +833,22 @@ class _RawUdpTransport:
         self._sock.close()
 
 
+class _FallbackDatagramProtocol(asyncio.DatagramProtocol):
+    """Adapter used when the event loop has no ``add_reader`` (Windows'
+    default ProactorEventLoop): routes asyncio's one-datagram-per-wakeup
+    transport callbacks into the endpoint.  Slower than the draining
+    raw transport, but the stack stays functional on every loop."""
+
+    def __init__(self, endpoint: "UtpEndpoint"):
+        self._endpoint = endpoint
+
+    def datagram_received(self, data, addr) -> None:
+        self._endpoint.datagram_received(data, addr)
+
+    def error_received(self, exc) -> None:
+        self._endpoint.error_received(exc)
+
+
 class UtpEndpoint:
     """A UDP socket multiplexing uTP connections.
 
@@ -845,7 +861,15 @@ class UtpEndpoint:
     def __init__(self, accept_cb: Optional[Callable] = None):
         self.accept_cb = accept_cb
         self._conns: Dict[Tuple[Tuple[str, int], int], UtpConnection] = {}
-        self._transport: Optional[_RawUdpTransport] = None
+        # _RawUdpTransport normally; asyncio's DatagramTransport on
+        # loops without add_reader — only the shared sendto/close/
+        # is_closing/get_extra_info subset may be called on it
+        self._transport: Union[_RawUdpTransport,
+                               asyncio.DatagramTransport, None] = None
+        # set ONLY on the fallback transport of a connected socket: the
+        # stock transports need an explicit sockaddr there (proactor's
+        # WSASendTo rejects addr=None; _RawUdpTransport uses send())
+        self._fallback_peer: Optional[tuple] = None
         self._remote: Optional[Tuple[str, int]] = None
         self.local_addr: Optional[Tuple[str, int]] = None
         self._accept_tasks: set = set()
@@ -904,8 +928,21 @@ class UtpEndpoint:
                     sock.setsockopt(_socket.SOL_SOCKET, opt, 4 << 20)
                 except OSError:
                     pass
-            self._transport = _RawUdpTransport(
-                loop, sock, self.datagram_received, self.error_received)
+            try:
+                self._transport = _RawUdpTransport(
+                    loop, sock, self.datagram_received, self.error_received)
+            except NotImplementedError:
+                # Proactor loops have no add_reader: fall back to the
+                # stock datagram transport (correct, just unbatched).
+                # sock= alone leaves the transport's _address unset, so
+                # a connected socket must still pass an explicit peer
+                # on every sendto (proactor's WSASendTo cannot take
+                # addr=None; review r5)
+                if remote_addr is not None:
+                    self._fallback_peer = sock.getpeername()
+                transport, _proto = await loop.create_datagram_endpoint(
+                    lambda: _FallbackDatagramProtocol(self), sock=sock)
+                self._transport = transport
             self.local_addr = sock.getsockname()[:2]
         except BaseException:
             sock.close()
@@ -985,7 +1022,9 @@ class UtpEndpoint:
         if self._transport is None or self._transport.is_closing():
             return
         if self._remote is not None:
-            self._transport.sendto(data)
+            # connected socket: no addr for the raw transport (it uses
+            # send()); the fallback transports need the explicit peer
+            self._transport.sendto(data, self._fallback_peer)
         else:
             self._transport.sendto(data, addr)
 

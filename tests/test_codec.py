@@ -87,6 +87,30 @@ def test_decode_missing_file_fails_cleanly(capsys):
     assert "cannot open" in capsys.readouterr().err
 
 
+def test_ignored_rate_flags_are_announced(capsys):
+    """-preset/-crf/-r are accepted (ffmpeg command-line compatibility)
+    but the OpenCV backend cannot honor them — a stderr notice must say
+    so, so operators comparing output against real ffmpeg aren't
+    surprised by different rate/quality behavior (advisor r4)."""
+    from downloader_tpu.codec import main
+
+    rc = main(["-i", "/nonexistent/clip.mkv", "-f", "yuv4mpegpipe",
+               "-pix_fmt", "yuv420p", "-crf", "18", "-preset",
+               "veryfast", "-"])
+    assert rc == 1  # input is missing; the notice still precedes that
+    err = capsys.readouterr().err
+    assert "not" in err and "-crf 18" in err and "-preset veryfast" in err
+    # flags outside the ignored set produce no notice
+    main(["-i", "/nonexistent/clip.mkv", "-f", "yuv4mpegpipe", "-"])
+    assert "note:" not in capsys.readouterr().err
+    # informational, so it honors -loglevel like ffmpeg's banner does —
+    # the transcode module's invocations (-loglevel error) stay clean
+    # and their captured failure tails aren't polluted (review r5)
+    main(["-i", "/nonexistent/clip.mkv", "-f", "yuv4mpegpipe",
+          "-loglevel", "error", "-crf", "18", "-"])
+    assert "note:" not in capsys.readouterr().err
+
+
 def test_container_roundtrip_preserves_geometry(codec_bin, tmp_path):
     """y4m -> mpeg4/mkv -> y4m keeps dims, frame count, and fps; the
     container is genuinely compressed (gradient frames compress well)."""

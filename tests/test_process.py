@@ -65,6 +65,27 @@ async def test_movie_mode_keeps_all_directories(process):
     ]
 
 
+async def test_walk_skips_transcode_part_temps(process, tmp_path):
+    """A SIGKILL-orphaned transcode temp (<dst>.part-<pid>.<seq><ext>)
+    carries a media extension but is corrupt partial output — the walk
+    must never ingest it, even within the stale-reclaim grace window
+    where the sweep leaves it on disk (review r5)."""
+    root = tmp_path / "Movie Dir"
+    root.mkdir()
+    (root / "The Film.mkv").write_bytes(b"real content")
+    (root / "The Film.mkv.part-12345.0.mkv").write_bytes(b"partial")
+    (root / f"Other.mkv.part-{os.getpid()}.3.mkv").write_bytes(b"live")
+    # NOT a temp: single-number ".part-2" is a legitimate content name
+    # (multi-part releases) — the skip requires the transcoder's full
+    # two-number .part-<pid>.<seq> form (review r5)
+    (root / "Movie.part-2.mkv").write_bytes(b"real part two")
+    res = await process(
+        Job(media=make_media("MOVIE"), last_stage={"path": str(tmp_path)})
+    )
+    assert sorted(res["files"]) == [str(root / "Movie.part-2.mkv"),
+                                    str(root / "The Film.mkv")]
+
+
 async def test_sole_top_level_dir_always_traversed(process):
     # TV mode + a single top-level dir with no season-ish name
     # (reference test/process/filter_dirs.js:63-81)

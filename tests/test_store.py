@@ -125,6 +125,37 @@ async def test_fs_stale_tmp_swept_and_filtered(tmp_path):
     assert (await fs.get_object("b", "dir/obj")) == b"real"
 
 
+async def test_fs_foreign_temp_key_is_surfaced(tmp_path, capsys):
+    """A temp-patterned file with a live-probing pid that is ALSO far
+    older than any real ingest (a foreign object key from a store
+    predating the reserved-suffix scheme) is hidden forever — the list
+    walk must log it once instead of silently filtering, so operators
+    know to migrate it (advisor r4)."""
+    import os
+    import time
+
+    from downloader_tpu.store import fs as fs_mod
+
+    root = tmp_path / "objects"
+    fs = FilesystemObjectStore(str(root))
+    await fs.make_bucket("b")
+    await fs.put_object("b", "obj", b"real")
+    foreign = root / "b" / f"backup.tmp.{os.getpid()}.0"
+    foreign.write_bytes(b"a foreign store's object")
+    ancient = time.time() - 3 * 24 * 3600
+    os.utime(foreign, (ancient, ancient))
+
+    names = [info.name async for info in fs.list_objects("b")]
+    assert names == ["obj"]
+    assert foreign.exists()  # never reclaimed: pid probes live
+    err = capsys.readouterr().err
+    assert "foreign object key" in err and foreign.name in err
+    # once per process: a second walk stays quiet
+    _ = [info async for info in fs.list_objects("b")]
+    assert "foreign object key" not in capsys.readouterr().err
+    fs_mod._warned_foreign.clear()
+
+
 async def test_fs_reserved_tmp_suffix_rejected(tmp_path):
     """A user key matching the ingest-temp pattern would be invisible to
     list and reclaimable by the sweep — reject it up front instead of

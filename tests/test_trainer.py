@@ -272,20 +272,31 @@ def test_transcode_reclaims_stale_part_temps(tmp_path):
 
     from downloader_tpu.cli import main
 
+    import time as time_mod
+
     src = tmp_path / "clip.y4m"
     src.write_bytes(make_y4m(16, 12, frames=2))
     dst = tmp_path / "out.y4m"
     child = subprocess.Popen([sys.executable, "-c", ""])
     child.wait()
+    old = time_mod.time() - 3600  # past the cross-host grace
     stale = tmp_path / f"out.y4m.part-{child.pid}.0.y4m"
     stale.write_bytes(b"orphaned partial")
+    os.utime(stale, (old, old))
+    # dead pid but FRESH mtime: over NFS the pid probe is host-local,
+    # so this may be a sibling host's in-flight writer — must survive
+    young = tmp_path / f"out.y4m.part-{child.pid}.1.y4m"
+    young.write_bytes(b"possibly a sibling host's writer")
     live = tmp_path / f"out.y4m.part-{os.getpid()}.99.y4m"
     live.write_bytes(b"concurrent run in flight")
+    os.utime(live, (old, old))
 
     rc = main(["upscale", str(src), str(dst), "--batch", "2"])
     assert rc == 0
     assert not stale.exists()
+    assert young.exists()
     assert live.exists()
+    young.unlink()
     live.unlink()
 
 

@@ -165,6 +165,47 @@ async def test_transfer_integrity():
     assert digest == hashlib.sha1(payload).digest()
 
 
+async def test_proactor_fallback_transport(monkeypatch):
+    """Loops without ``add_reader`` (Windows' ProactorEventLoop) must
+    fall back to asyncio's stock datagram transport instead of failing
+    endpoint creation (advisor r4).  Simulated by making the public
+    add_reader raise; the selector loop's own datagram plumbing uses the
+    private registration path, so the fallback still works here."""
+    from downloader_tpu.torrent.utp import _RawUdpTransport
+
+    loop = asyncio.get_running_loop()
+
+    def _no_add_reader(*a, **kw):
+        raise NotImplementedError
+
+    monkeypatch.setattr(loop, "add_reader", _no_add_reader,
+                        raising=False)
+    payload = os.urandom(256 << 10)
+    async with asyncio.timeout(30):
+
+        async def handler(reader, writer):
+            data = await reader.readexactly(len(payload))
+            writer.write(hashlib.sha1(data).digest())
+            await writer.drain()
+            writer.close()
+            await writer.wait_closed()
+
+        server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+        assert not isinstance(server._transport, _RawUdpTransport)
+        try:
+            reader, writer = await open_utp_connection(*server.local_addr)
+            assert not isinstance(
+                writer._conn.endpoint._transport, _RawUdpTransport)
+            writer.write(payload)
+            await writer.drain()
+            reply = await reader.readexactly(20)
+            writer.close()
+            await writer.wait_closed()
+        finally:
+            server.close()
+    assert reply == hashlib.sha1(payload).digest()
+
+
 @pytest.mark.parametrize("drop,swap", [(0, 5), (17, 0), (13, 7)])
 async def test_transfer_survives_loss_and_reorder(drop, swap):
     payload = os.urandom(512 << 10)
