@@ -305,7 +305,7 @@ class TorrentClient:
             # share swarm.done by reference: the serve side's availability
             # tracks verified pieces with no extra bookkeeping
             server = Seeder(meta, storage=storage, have=swarm.done,
-                            peer_id=self.peer_id)
+                            peer_id=self.peer_id, crypto=self.crypto)
             try:
                 swarm.listen_port = await server.start(host=listen_host)
                 self._log("serving swarm", port=swarm.listen_port)

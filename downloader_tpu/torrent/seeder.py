@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import struct
 from typing import Dict, Optional, Set, Tuple
 
@@ -37,7 +38,11 @@ class Seeder:
     def __init__(self, meta: Metainfo, root: Optional[str] = None,
                  peer_id: Optional[bytes] = None,
                  storage: Optional[TorrentStorage] = None,
-                 have: Optional[Set[int]] = None):
+                 have: Optional[Set[int]] = None,
+                 unchoke_slots: int = 4,
+                 rotate_interval: float = 10.0,
+                 optimistic_interval: float = 30.0,
+                 crypto: str = "prefer"):
         if storage is None:
             if root is None:
                 raise ValueError("need root or storage")
@@ -55,6 +60,26 @@ class Seeder:
         self._peers: Set[wire.PeerWire] = set()
         # peers that advertised a listen port: PeerWire -> (host, port)
         self._listen_addrs: Dict[wire.PeerWire, Tuple[str, int]] = {}
+        # -- choking (tit-for-tat + optimistic, like webtorrent's engine;
+        # /root/reference/lib/download.js:9,19 — its torrent-stream core
+        # slot-limits uploads so one peer cannot monopolize a seeder).
+        # Regular slots go to the interested peers we served the most
+        # bytes in the last rotation window (a seed reciprocates to the
+        # peers actually draining it); one extra optimistic slot rotates
+        # through the remaining interested peers so newcomers get a
+        # chance to prove themselves.
+        self.unchoke_slots = unchoke_slots
+        self.rotate_interval = rotate_interval
+        self.optimistic_interval = optimistic_interval
+        # MSE acceptor policy: "require" = RC4-only payload; anything
+        # else selects plaintext-after-handshake when the initiator
+        # allows it (mse.accept docstring)
+        self.crypto = crypto
+        self._interested: Set[wire.PeerWire] = set()
+        self._unchoked: Set[wire.PeerWire] = set()
+        self._optimistic: Optional[wire.PeerWire] = None
+        self._served_window: Dict[wire.PeerWire, int] = {}
+        self._choker_task: Optional[asyncio.Task] = None
 
     def _available(self, index: int) -> bool:
         return self.have is None or index in self.have
@@ -78,9 +103,17 @@ class Seeder:
                     host, self.port, accept_cb=self._on_connect)
             except OSError:
                 self._utp = None  # UDP port taken: TCP-only is still fine
+        self._choker_task = asyncio.create_task(self._choke_loop())
         return self.port
 
     async def stop(self) -> None:
+        if self._choker_task is not None:
+            self._choker_task.cancel()
+            try:
+                await self._choker_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._choker_task = None
         if self._utp is not None:
             self._utp.close()
             self._utp = None
@@ -106,32 +139,91 @@ class Seeder:
         if self.have is not None:
             self.have.add(index)
         for peer in list(self._peers):
-            task = asyncio.create_task(self._send_have(peer, index))
+            task = asyncio.create_task(self._quiet_send(peer.send_have(index)))
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
 
     @staticmethod
-    async def _send_have(peer: wire.PeerWire, index: int) -> None:
+    async def _quiet_send(coro) -> None:
+        """Await a peer send, swallowing death-of-connection errors —
+        the peer's own serve loop does the cleanup."""
         try:
-            await peer.send_have(index)
+            await coro
         except (ConnectionError, OSError, wire.WireError):
-            pass  # dying connection: its serve loop will clean up
+            pass
+
+    # -- choking --------------------------------------------------------
+    def is_unchoked(self, peer: wire.PeerWire) -> bool:
+        return peer in self._unchoked
+
+    async def _choke_loop(self) -> None:
+        """Periodic tit-for-tat recompute; every ``optimistic_interval``
+        the optimistic slot moves to a different interested-but-choked
+        peer (the classic 10 s / 30 s cadence at the defaults)."""
+        loop = asyncio.get_running_loop()
+        next_optimistic = loop.time()  # first pass seats an optimistic
+        while True:
+            await asyncio.sleep(self.rotate_interval)
+            rotate = loop.time() >= next_optimistic
+            if rotate:
+                next_optimistic = loop.time() + self.optimistic_interval
+            await self._recompute_chokes(rotate_optimistic=rotate)
+
+    async def _recompute_chokes(self, rotate_optimistic: bool = False) -> None:
+        interested = [p for p in self._peers if p in self._interested]
+        # reciprocate to the peers that actually drained us last window;
+        # ties (fresh swarm) keep whoever is already unchoked seated so
+        # the steady state doesn't churn
+        ranked = sorted(
+            interested,
+            key=lambda p: (self._served_window.get(p, 0),
+                           p in self._unchoked),
+            reverse=True,
+        )
+        regular = set(ranked[:self.unchoke_slots])
+        if (rotate_optimistic or self._optimistic not in interested
+                or self._optimistic in regular):
+            candidates = [p for p in interested
+                          if p not in regular and p is not self._optimistic]
+            if candidates:
+                self._optimistic = random.choice(candidates)
+            elif (self._optimistic not in interested
+                    or self._optimistic in regular):
+                self._optimistic = None
+        target = set(regular)
+        if self._optimistic is not None:
+            target.add(self._optimistic)
+        for peer in list(self._unchoked - target):
+            self._unchoked.discard(peer)
+            await self._quiet_send(peer.send_message(wire.MSG_CHOKE))
+        for peer in list(target - self._unchoked):
+            self._unchoked.add(peer)
+            await self._quiet_send(peer.send_message(wire.MSG_UNCHOKE))
+        self._served_window = {}
 
     async def _maybe_decrypt(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
         """Sniff the first bytes: plaintext BT handshake passes through
         (with the consumed prefix replayed), anything else must complete
-        the MSE accept handshake."""
+        the MSE accept handshake.  ``crypto="require"`` refuses the
+        plaintext path entirely (libtorrent's require posture: drop
+        unencrypted inbound, review r5) and forces RC4 in the MSE
+        negotiation."""
         first = b""
         verdict = None
         async with asyncio.timeout(mse.HANDSHAKE_TIMEOUT):
             while verdict is None:
                 first += await reader.readexactly(1)
                 verdict = mse.looks_like_plaintext_bt(first)
+        require_rc4 = self.crypto == "require"
         if verdict:
+            if require_rc4:
+                raise mse.MSEError("plaintext peer refused (crypto=require)")
             return mse.MSEReader(reader, None, plain_prefix=first), writer
         enc_reader, enc_writer, _method = await mse.accept(
-            reader, writer, self.meta.info_hash, first_bytes=first
+            reader, writer, self.meta.info_hash, first_bytes=first,
+            allow_plaintext=not require_rc4,
+            prefer_plaintext=not require_rc4,
         )
         return enc_reader, enc_writer
 
@@ -182,6 +274,19 @@ class Seeder:
         finally:
             self._peers.discard(peer)
             self._listen_addrs.pop(peer, None)
+            self._interested.discard(peer)
+            freed = peer in self._unchoked
+            self._unchoked.discard(peer)
+            self._served_window.pop(peer, None)
+            if peer is self._optimistic:
+                self._optimistic = None
+            if freed and self._interested:
+                # departure freed a seat: promote a waiting peer now
+                # (background — this connection's teardown must not
+                # block on other peers' writes)
+                task = asyncio.create_task(self._recompute_chokes())
+                self._conn_tasks.add(task)
+                task.add_done_callback(self._conn_tasks.discard)
             await peer.close()
 
     async def _serve(self, peer: wire.PeerWire) -> None:
@@ -190,7 +295,23 @@ class Seeder:
             if msg_id is None:
                 continue
             if msg_id == wire.MSG_INTERESTED:
-                await peer.send_message(wire.MSG_UNCHOKE)
+                self._interested.add(peer)
+                # a free slot (regular or the optimistic seat) unchokes
+                # immediately — small swarms never wait for a rotation
+                if len(self._unchoked) < self.unchoke_slots + 1:
+                    self._unchoked.add(peer)
+                    await peer.send_message(wire.MSG_UNCHOKE)
+            elif msg_id == wire.MSG_NOT_INTERESTED:
+                self._interested.discard(peer)
+                if peer is self._optimistic:
+                    self._optimistic = None
+                if peer in self._unchoked:
+                    # a freed seat promotes a waiting peer NOW — idling
+                    # capacity until the next rotation wastes up to
+                    # rotate_interval of upload time (review r5); the
+                    # recompute also chokes this no-longer-interested
+                    # peer via the target diff
+                    await self._recompute_chokes()
             elif msg_id == wire.MSG_REQUEST:
                 index, begin, length = struct.unpack(">III", payload)
                 if (index >= self.meta.num_pieces or length > (1 << 17)
@@ -199,6 +320,15 @@ class Seeder:
                     # peer — fast extension or not, disconnect (a polite
                     # reject would let a hostile peer spin forever)
                     raise wire.WireError("bad request")
+                if peer not in self._unchoked:
+                    # choked peers receive NO blocks (BEP 3: a choke
+                    # voids the request queue; a peer requesting anyway
+                    # is either racing our choke or abusive) — fast
+                    # peers get an explicit reject, legacy peers are
+                    # ignored per spec
+                    if getattr(peer, "supports_fast", False):
+                        await peer.send_reject_request(index, begin, length)
+                    continue
                 if not self._available(index):
                     # valid request for a piece we haven't advertised
                     # (or a race against an in-flight HAVE): BEP 6 lets
@@ -213,6 +343,8 @@ class Seeder:
                 )
                 await peer.send_piece(index, begin, data)
                 self.bytes_served += len(data)
+                self._served_window[peer] = (
+                    self._served_window.get(peer, 0) + len(data))
             elif msg_id == wire.MSG_EXTENDED:
                 await self._serve_extended(peer, payload)
             # choke/have/bitfield/cancel from a leech need no reply here

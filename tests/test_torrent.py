@@ -955,6 +955,11 @@ async def test_partial_seeder_rejects_politely_with_fast(tmp_path):
         while msg_id not in (w.MSG_HAVE_NONE, w.MSG_BITFIELD):
             msg_id, _ = await asyncio.wait_for(peer.recv_message(), 5)
         assert msg_id == w.MSG_HAVE_NONE  # empty + fast -> HAVE_NONE
+        # get unchoked first so the availability check (not the choke
+        # guard) is what answers the bad request
+        await peer.send_message(w.MSG_INTERESTED)
+        msg_id, _ = await asyncio.wait_for(peer.recv_message(), 5)
+        assert msg_id == w.MSG_UNCHOKE
         await peer.send_request(0, 0, 1 << 14)
         msg_id, payload = await asyncio.wait_for(peer.recv_message(), 5)
         assert msg_id == w.MSG_REJECT_REQUEST
@@ -964,11 +969,107 @@ async def test_partial_seeder_rejects_politely_with_fast(tmp_path):
         await peer.close()
 
         legacy = await _raw_peer(port, meta.info_hash, fast=False)
+        await legacy.send_message(w.MSG_INTERESTED)
+        msg_id, _ = await asyncio.wait_for(legacy.recv_message(), 5)
+        while msg_id != w.MSG_UNCHOKE:
+            msg_id, _ = await asyncio.wait_for(legacy.recv_message(), 5)
         await legacy.send_request(0, 0, 1 << 14)
         with pytest.raises((asyncio.IncompleteReadError, ConnectionError,
                             TimeoutError)):
             while True:
                 await asyncio.wait_for(legacy.recv_message(), 5)
+    finally:
+        await seeder.stop()
+
+
+# -- choking (tit-for-tat + optimistic unchoke) -------------------------
+async def _make_seeder(tmp_path, **kwargs):
+    from downloader_tpu.torrent import Seeder
+
+    src, files = make_payload_dir(tmp_path, [4 * (1 << 14)])
+    meta = make_metainfo(str(src), piece_length=1 << 14)
+    seeder = Seeder(meta, str(src.parent), **kwargs)
+    port = await seeder.start()
+    return seeder, meta, port, files
+
+
+async def test_choked_peer_receives_no_blocks(tmp_path):
+    """With every slot taken, a later interested peer stays choked: its
+    requests get REJECT_REQUEST (fast) or silence (legacy), never a
+    PIECE (seeder.py previously unchoked everyone unconditionally)."""
+    from downloader_tpu.torrent import wire as w
+
+    # one total seat (0 regular + the optimistic), no rotation in-test
+    seeder, meta, port, _files = await _make_seeder(
+        tmp_path, unchoke_slots=0, rotate_interval=3600,
+        optimistic_interval=3600)
+    try:
+        first = await _raw_peer(port, meta.info_hash)
+        await first.send_message(w.MSG_INTERESTED)
+        msg_id, _ = await asyncio.wait_for(first.recv_message(), 5)
+        while msg_id != w.MSG_UNCHOKE:
+            msg_id, _ = await asyncio.wait_for(first.recv_message(), 5)
+        await first.send_request(0, 0, 1 << 14)
+        msg_id, _ = await asyncio.wait_for(first.recv_message(), 5)
+        while msg_id != w.MSG_PIECE:
+            msg_id, _ = await asyncio.wait_for(first.recv_message(), 5)
+
+        # the seat is taken: the second peer must stay choked
+        second = await _raw_peer(port, meta.info_hash)
+        await second.send_message(w.MSG_INTERESTED)
+        await second.send_request(0, 0, 1 << 14)
+        got = []
+        with pytest.raises(TimeoutError):
+            while True:
+                msg_id, _ = await asyncio.wait_for(second.recv_message(), 1)
+                if msg_id is not None:
+                    got.append(msg_id)
+        assert w.MSG_PIECE not in got
+        assert w.MSG_UNCHOKE not in got
+        assert w.MSG_REJECT_REQUEST in got  # fast peer: explicit reject
+        assert len(seeder._unchoked) == 1  # exactly one seat occupied
+        await first.close()
+        await second.close()
+    finally:
+        await seeder.stop()
+
+
+async def test_optimistic_unchoke_rotates(tmp_path):
+    """The optimistic seat moves between interested-but-choked peers:
+    over a few fast rotations every peer gets unchoked at least once,
+    and a peer losing the seat receives an explicit CHOKE."""
+    from downloader_tpu.torrent import wire as w
+
+    seeder, meta, port, _files = await _make_seeder(
+        tmp_path, unchoke_slots=0, rotate_interval=0.05,
+        optimistic_interval=0.05)
+    try:
+        peers = [await _raw_peer(port, meta.info_hash) for _ in range(2)]
+        seen: list = [set(), set()]
+
+        async def watch(i):
+            await peers[i].send_message(w.MSG_INTERESTED)
+            while True:
+                msg_id, _ = await peers[i].recv_message()
+                if msg_id in (w.MSG_CHOKE, w.MSG_UNCHOKE):
+                    seen[i].add(msg_id)
+                if all(len(s) == 2 for s in seen):
+                    return
+
+        async with asyncio.timeout(15):
+            done, pending = await asyncio.wait(
+                [asyncio.create_task(watch(0)),
+                 asyncio.create_task(watch(1))],
+                return_when=asyncio.FIRST_COMPLETED)
+            for t in pending:
+                t.cancel()
+        # both peers were unchoked at some point, and at least one was
+        # re-choked when it lost the seat (with 2 candidates and one
+        # seat, rotation implies both)
+        assert all(w.MSG_UNCHOKE in s for s in seen)
+        assert any(w.MSG_CHOKE in s for s in seen)
+        for p in peers:
+            await p.close()
     finally:
         await seeder.stop()
 
