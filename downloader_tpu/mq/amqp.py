@@ -82,27 +82,31 @@ class _PendingPublish:
     relies on for publish reliability.
     """
 
-    __slots__ = ("queue", "body", "fut", "exchange")
+    __slots__ = ("queue", "body", "fut", "exchange", "headers")
 
     def __init__(self, queue: str, body: bytes, fut: asyncio.Future,
-                 exchange: str = ""):
+                 exchange: str = "", headers: Optional[dict] = None):
         self.queue = queue          # routing key when exchange is ""
         self.body = body
         self.fut = fut
         self.exchange = exchange    # fanout exchange name, "" = default
+        self.headers = headers      # application headers (traceparent)
 
 
 class _AmqpDelivery(Delivery):
-    __slots__ = ("_client", "_tag", "_epoch", "_body", "_redelivered", "_settled")
+    __slots__ = ("_client", "_tag", "_epoch", "_body", "_redelivered",
+                 "_settled", "_headers")
 
     def __init__(self, client: "AmqpQueue", tag: int, epoch: int,
-                 body: bytes, redelivered: bool):
+                 body: bytes, redelivered: bool,
+                 headers: Optional[dict] = None):
         self._client = client
         self._tag = tag
         self._epoch = epoch
         self._body = body
         self._redelivered = redelivered
         self._settled = False
+        self._headers = headers or {}
 
     @property
     def body(self) -> bytes:
@@ -111,6 +115,10 @@ class _AmqpDelivery(Delivery):
     @property
     def redelivered(self) -> bool:
         return self._redelivered
+
+    @property
+    def headers(self) -> dict:
+        return self._headers
 
     async def ack(self) -> None:
         if self._settled:
@@ -189,6 +197,7 @@ class AmqpQueue(MessageQueue):
         self._pending_deliver: Optional[Tuple[str, int, bool]] = None
         self._pending_size = 0
         self._pending_chunks: List[bytes] = []
+        self._pending_props: Optional[dict] = None
 
     # -- connection lifecycle -------------------------------------------
 
@@ -332,6 +341,7 @@ class AmqpQueue(MessageQueue):
             self._pending_rpc = None
         self._pending_deliver = None
         self._pending_chunks = []
+        self._pending_props = None
         # stale per-connection confirm tags; the entries themselves stay in
         # _pending_publishes and are resent once reconnected
         self._unconfirmed.clear()
@@ -435,6 +445,7 @@ class AmqpQueue(MessageQueue):
                 elif ftype == wire.FRAME_HEADER:
                     _size, _props = wire.decode_content_header(payload)
                     self._pending_size = _size
+                    self._pending_props = _props
                     self._pending_chunks = []
                     if _size == 0:
                         self._dispatch_delivery()
@@ -481,15 +492,18 @@ class AmqpQueue(MessageQueue):
             return
         consumer_tag, delivery_tag, redelivered = self._pending_deliver
         body = b"".join(self._pending_chunks)
+        props = self._pending_props or {}
         self._pending_deliver = None
         self._pending_chunks = []
+        self._pending_props = None
         sub = self._subscriptions.get(consumer_tag)
         if sub is None:
             # delivery for a cancelled consumer: requeue it
             asyncio.ensure_future(
                 self._settle(delivery_tag, self._epoch, ack=False, requeue=True))
             return
-        delivery = _AmqpDelivery(self, delivery_tag, self._epoch, body, redelivered)
+        delivery = _AmqpDelivery(self, delivery_tag, self._epoch, body,
+                                 redelivered, headers=props.get("headers"))
 
         async def _run() -> None:
             try:
@@ -626,12 +640,15 @@ class AmqpQueue(MessageQueue):
             await self._ensure_exchange(entry.exchange)
         else:
             await self._ensure_queue(entry.queue)
+        props: dict = {"delivery_mode": 2}
+        if entry.headers:
+            props["headers"] = entry.headers
         frames = [
             wire.encode_method(
                 self.CHANNEL, wire.BASIC_PUBLISH,
                 0, entry.exchange, entry.queue, False, False),
             wire.encode_content_header(
-                self.CHANNEL, len(entry.body), {"delivery_mode": 2}),
+                self.CHANNEL, len(entry.body), props),
         ]
         frames.extend(
             wire.encode_body_frames(self.CHANNEL, entry.body, self._frame_max))
@@ -664,15 +681,19 @@ class AmqpQueue(MessageQueue):
             raise
         await entry.fut
 
-    async def publish(self, queue: str, body: bytes) -> None:
+    async def publish(self, queue: str, body: bytes,
+                      headers: Optional[dict] = None) -> None:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._publish_entry(_PendingPublish(queue, body, fut))
+        await self._publish_entry(
+            _PendingPublish(queue, body, fut, headers=headers))
 
-    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+    async def publish_exchange(self, exchange: str, body: bytes,
+                               headers: Optional[dict] = None) -> None:
         """Publish to a fanout exchange: every bound queue gets a copy."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._publish_entry(
-            _PendingPublish("", body, fut, exchange=exchange)
+            _PendingPublish("", body, fut, exchange=exchange,
+                            headers=headers)
         )
 
     async def listen(self, queue: str, handler: Handler, prefetch: int = 1) -> None:

@@ -11,7 +11,7 @@ Delivery is at-least-once: a nacked message is redelivered.
 from __future__ import annotations
 
 import abc
-from typing import Awaitable, Callable
+from typing import Awaitable, Callable, Optional
 
 Handler = Callable[["Delivery"], Awaitable[None]]
 
@@ -34,6 +34,16 @@ class Delivery(abc.ABC):
     @abc.abstractmethod
     def redelivered(self) -> bool:
         """True if this message was previously delivered and nacked."""
+
+    @property
+    def headers(self) -> dict:
+        """Application headers published with the message (AMQP basic
+        properties ``headers`` table).  The pipeline uses them to carry
+        W3C trace context (``traceparent``) across queue hops — the
+        cross-service propagation triton's design provides for
+        (/root/reference/lib/main.js:20 imports the tracer's serialize/
+        unserialize) but the reference never wired up."""
+        return {}
 
     @abc.abstractmethod
     async def ack(self) -> None:
@@ -64,8 +74,10 @@ class MessageQueue(abc.ABC):
         handlers mid-stage."""
 
     @abc.abstractmethod
-    async def publish(self, queue: str, body: bytes) -> None:
-        """Enqueue ``body`` onto ``queue`` (reference lib/main.js:164)."""
+    async def publish(self, queue: str, body: bytes,
+                      headers: Optional[dict] = None) -> None:
+        """Enqueue ``body`` onto ``queue`` (reference lib/main.js:164),
+        optionally with application headers (e.g. ``traceparent``)."""
 
     @abc.abstractmethod
     async def listen(self, queue: str, handler: Handler, prefetch: int = 1) -> None:
@@ -82,7 +94,8 @@ class MessageQueue(abc.ABC):
     # expose fanout exchanges: publish_exchange copies to all bound
     # queues; bind_queue attaches a (possibly exclusive/transient) queue.
 
-    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+    async def publish_exchange(self, exchange: str, body: bytes,
+                               headers: Optional[dict] = None) -> None:
         raise NotImplementedError(
             f"{type(self).__name__} does not support fanout exchanges"
         )

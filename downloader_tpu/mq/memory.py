@@ -20,12 +20,17 @@ from .base import Delivery, Handler, MessageQueue
 
 
 class _Message:
-    __slots__ = ("body", "redelivered", "deliveries")
+    __slots__ = ("body", "redelivered", "deliveries", "headers")
 
-    def __init__(self, body: bytes):
+    def __init__(self, body: bytes, headers: Optional[dict] = None):
         self.body = body
         self.redelivered = False
         self.deliveries = 0
+        # copy: fanout shares the caller's dict across messages, and a
+        # consumer mutating its delivery's headers must not bleed into
+        # siblings/redeliveries (the AMQP backend isolates via the wire
+        # codec; match it — review r5)
+        self.headers = dict(headers) if headers else {}
 
 
 class _MemoryDelivery(Delivery):
@@ -46,6 +51,10 @@ class _MemoryDelivery(Delivery):
     @property
     def redelivered(self) -> bool:
         return self._msg.redelivered
+
+    @property
+    def headers(self) -> dict:
+        return self._msg.headers
 
     def _settle(self) -> bool:
         if self._settled:
@@ -125,17 +134,19 @@ class InMemoryBroker:
     def _settled(self, queue: str) -> None:
         self._unsettled[queue] -= 1
 
-    def publish(self, queue: str, body: bytes) -> None:
+    def publish(self, queue: str, body: bytes,
+                headers: Optional[dict] = None) -> None:
         self._published[queue].append(body)
-        self._push(queue, _Message(body))
+        self._push(queue, _Message(body, headers))
 
     def bind(self, queue: str, exchange: str) -> None:
         self._exchanges[exchange][queue] = None
 
-    def publish_exchange(self, exchange: str, body: bytes) -> None:
+    def publish_exchange(self, exchange: str, body: bytes,
+                         headers: Optional[dict] = None) -> None:
         """Fanout: every bound queue gets its own copy."""
         for queue in self._exchanges[exchange]:
-            self.publish(queue, body)
+            self.publish(queue, body, headers)
 
     async def pop(self, queue: str) -> _Message:
         q = self._queues[queue]
@@ -183,15 +194,17 @@ class MemoryQueue(MessageQueue):
                 pass
         self._handlers.clear()
 
-    async def publish(self, queue: str, body: bytes) -> None:
+    async def publish(self, queue: str, body: bytes,
+                      headers: Optional[dict] = None) -> None:
         if not self._connected:
             raise RuntimeError("publish on closed queue connection")
-        self._broker.publish(queue, body)
+        self._broker.publish(queue, body, headers)
 
-    async def publish_exchange(self, exchange: str, body: bytes) -> None:
+    async def publish_exchange(self, exchange: str, body: bytes,
+                               headers: Optional[dict] = None) -> None:
         if not self._connected:
             raise RuntimeError("publish on closed queue connection")
-        self._broker.publish_exchange(exchange, body)
+        self._broker.publish_exchange(exchange, body, headers)
 
     async def bind_queue(self, queue: str, exchange: str,
                          exclusive: bool = False) -> None:

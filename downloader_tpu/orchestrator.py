@@ -35,7 +35,8 @@ from .mq.base import Delivery, MessageQueue
 from .platform.logging import Logger, get_logger
 from .platform.metrics import Metrics
 from .platform.telemetry import NullTelemetry, Telemetry
-from .platform.tracing import NullTracer, Tracer
+from .platform.tracing import (NullTracer, Tracer, format_traceparent,
+                               parse_traceparent)
 from .stages.base import STAGES, Job, StageContext, load_stages
 from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
@@ -183,7 +184,12 @@ class Orchestrator:
             await self.telemetry.emit_status(
                 job_id, schemas.TelemetryStatus.Value("DOWNLOADING")
             )
-            with self.tracer.span("job", jobId=job_id, fileId=file_id):
+            # parent the job span to the submitter's span when the
+            # message carries W3C trace context (triton's design intent,
+            # /root/reference/lib/main.js:20 — unused there; live here)
+            remote = parse_traceparent(delivery.headers.get("traceparent"))
+            with self.tracer.span("job", remote_parent=remote,
+                                  jobId=job_id, fileId=file_id):
                 await self._run_job(msg, delivery, child, emitter)
         finally:
             # remove the finished job (fixes reference lib/main.js:169,
@@ -300,13 +306,19 @@ class Orchestrator:
         # (reference lib/main.js:153-167)
         payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
         try:
+            # carry the job span's context to the downstream converter so
+            # its spans join this trace (submit -> job -> convert)
+            tp = format_traceparent()
+            headers = {"traceparent": tp} if tp else None
             if getattr(self, "_convert_fanout", False):
                 await self.mq.publish_exchange(
-                    schemas.CONVERT_EXCHANGE, schemas.encode(payload)
+                    schemas.CONVERT_EXCHANGE, schemas.encode(payload),
+                    headers=headers,
                 )
             else:
                 await self.mq.publish(
-                    schemas.CONVERT_QUEUE, schemas.encode(payload)
+                    schemas.CONVERT_QUEUE, schemas.encode(payload),
+                    headers=headers,
                 )
             if self.metrics is not None:
                 self.metrics.messages_published.labels(

@@ -22,6 +22,7 @@ import contextvars
 import json
 import os
 import queue
+import re
 import threading
 import time
 import urllib.error
@@ -34,6 +35,58 @@ _current_span: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
+class RemoteSpanContext:
+    """A parent carried over the wire (W3C traceparent) rather than the
+    contextvar: just the two ids a child span needs.  The reference
+    imports serialize/unserialize for exactly this cross-service carry
+    (/root/reference/lib/main.js:20) and never uses them — here the
+    context actually rides queue message headers."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def format_traceparent(span: Optional["Span"] = None) -> Optional[str]:
+    """W3C trace-context header for ``span`` (default: the current one);
+    None when there is nothing to propagate."""
+    span = span or _current_span.get()
+    if span is None:
+        return None
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+_HEX32 = re.compile(r"[0-9a-f]{32}")
+_HEX16 = re.compile(r"[0-9a-f]{16}")
+
+
+def parse_traceparent(value: Any) -> Optional[RemoteSpanContext]:
+    """Parse a W3C traceparent header; None for anything malformed
+    (wire headers are untrusted — never raise)."""
+    if isinstance(value, bytes):
+        try:
+            value = value.decode("ascii")
+        except UnicodeDecodeError:
+            return None
+    if not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    # strict lowercase hex (int(x, 16) would admit signs/underscores/
+    # uppercase, and a malformed id poisons the whole OTLP batch it is
+    # exported with — review r5)
+    if version != "00" or not _HEX32.fullmatch(trace_id) \
+            or not _HEX16.fullmatch(span_id):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # the spec's all-zero ids mean "no trace"
+    return RemoteSpanContext(trace_id, span_id)
+
+
 class Span:
     __slots__ = (
         "tracer", "name", "trace_id", "span_id", "parent_id",
@@ -41,7 +94,8 @@ class Span:
     )
 
     def __init__(self, tracer: "Tracer", name: str,
-                 parent: Optional["Span"] = None, **tags: Any):
+                 parent: "Optional[Span | RemoteSpanContext]" = None,
+                 **tags: Any):
         self.tracer = tracer
         self.name = name
         # W3C/OTLP sizes: 16-byte trace id, 8-byte span id (hex)
@@ -209,8 +263,9 @@ class Tracer:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def span(self, name: str, **tags: Any):
-        parent = _current_span.get()
+    def span(self, name: str, remote_parent: Optional[RemoteSpanContext] = None,
+             **tags: Any):
+        parent = remote_parent or _current_span.get()
         span = Span(self, name, parent, **tags)
         token = _current_span.set(span)
         try:

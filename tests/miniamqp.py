@@ -26,11 +26,14 @@ FRAME_MAX = 131072
 
 
 class _Msg:
-    __slots__ = ("body", "redelivered")
+    __slots__ = ("body", "redelivered", "props")
 
-    def __init__(self, body: bytes):
+    def __init__(self, body: bytes, props: Optional[dict] = None):
         self.body = body
         self.redelivered = False
+        # publisher's basic properties (headers table etc.), replayed
+        # verbatim on delivery like a real broker
+        self.props = props or {"delivery_mode": 2}
 
 
 class _Conn:
@@ -64,7 +67,7 @@ class _Conn:
             wire.encode_method(
                 1, wire.BASIC_DELIVER, consumer_tag, tag, msg.redelivered,
                 "", queue),
-            wire.encode_content_header(1, len(msg.body), {"delivery_mode": 2}),
+            wire.encode_content_header(1, len(msg.body), msg.props),
         ]
         frames.extend(wire.encode_body_frames(1, msg.body, FRAME_MAX))
         self.send(b"".join(frames))
@@ -138,22 +141,23 @@ class MiniAmqpServer:
 
     # -- broker core -----------------------------------------------------
 
-    def _publish(self, queue: str, body: bytes) -> None:
+    def _publish(self, queue: str, body: bytes,
+                 props: Optional[dict] = None) -> None:
         self._published[queue].append(body)
-        self._queues[queue].append(_Msg(body))
+        self._queues[queue].append(_Msg(body, props))
         self._pump(queue)
 
     def _finish_publish(self, conn: _Conn, exchange: str, routing_key: str,
-                        body: bytes) -> None:
+                        body: bytes, props: Optional[dict] = None) -> None:
         """Route a completed publish and confirm it if the channel asked.
 
         A named exchange fans the body out to every bound queue; the
         default exchange ("") routes straight to the routing-key queue."""
         if exchange:
             for queue in self._exchanges.get(exchange, {}):
-                self._publish(queue, body)
+                self._publish(queue, body, props)
         else:
-            self._publish(routing_key, body)
+            self._publish(routing_key, body, props)
         conn.publish_seq += 1
         if conn.confirm_mode:
             conn.send(wire.encode_method(
@@ -252,6 +256,7 @@ class MiniAmqpServer:
     async def _frame_loop(self, conn: _Conn) -> None:
         pending_publish: "Optional[Tuple[str, str]]" = None
         pending_size = 0
+        pending_props: Optional[dict] = None
         chunks: List[bytes] = []
         while True:
             ftype, channel, payload = await wire.read_frame(conn.reader)
@@ -260,10 +265,11 @@ class MiniAmqpServer:
                 await conn.writer.drain()
                 continue
             if ftype == wire.FRAME_HEADER:
-                pending_size, _props = wire.decode_content_header(payload)
+                pending_size, pending_props = wire.decode_content_header(payload)
                 chunks = []
                 if pending_size == 0 and pending_publish is not None:
-                    self._finish_publish(conn, *pending_publish, b"")
+                    self._finish_publish(conn, *pending_publish, b"",
+                                         pending_props)
                     pending_publish = None
                     await conn.writer.drain()
                 continue
@@ -271,7 +277,8 @@ class MiniAmqpServer:
                 chunks.append(payload)
                 if (pending_publish is not None
                         and sum(map(len, chunks)) >= pending_size):
-                    self._finish_publish(conn, *pending_publish, b"".join(chunks))
+                    self._finish_publish(conn, *pending_publish,
+                                         b"".join(chunks), pending_props)
                     pending_publish = None
                     chunks = []
                     await conn.writer.drain()
