@@ -307,8 +307,11 @@ class Orchestrator:
         payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
         try:
             # carry the job span's context to the downstream converter so
-            # its spans join this trace (submit -> job -> convert)
-            tp = format_traceparent()
+            # its spans join this trace (submit -> job -> convert); a
+            # NullTracer records nothing, so propagating its span ids
+            # would hand the converter parents that exist nowhere
+            tp = (None if isinstance(self.tracer, NullTracer)
+                  else format_traceparent())
             headers = {"traceparent": tp} if tp else None
             if getattr(self, "_convert_fanout", False):
                 await self.mq.publish_exchange(

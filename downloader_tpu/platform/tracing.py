@@ -60,6 +60,7 @@ def format_traceparent(span: Optional["Span"] = None) -> Optional[str]:
 
 _HEX32 = re.compile(r"[0-9a-f]{32}")
 _HEX16 = re.compile(r"[0-9a-f]{16}")
+_HEX2 = re.compile(r"[0-9a-f]{2}")
 
 
 def parse_traceparent(value: Any) -> Optional[RemoteSpanContext]:
@@ -75,12 +76,12 @@ def parse_traceparent(value: Any) -> Optional[RemoteSpanContext]:
     parts = value.split("-")
     if len(parts) != 4:
         return None
-    version, trace_id, span_id, _flags = parts
+    version, trace_id, span_id, flags = parts
     # strict lowercase hex (int(x, 16) would admit signs/underscores/
     # uppercase, and a malformed id poisons the whole OTLP batch it is
     # exported with — review r5)
     if version != "00" or not _HEX32.fullmatch(trace_id) \
-            or not _HEX16.fullmatch(span_id):
+            or not _HEX16.fullmatch(span_id) or not _HEX2.fullmatch(flags):
         return None
     if trace_id == "0" * 32 or span_id == "0" * 16:
         return None  # the spec's all-zero ids mean "no trace"

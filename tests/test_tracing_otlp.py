@@ -164,7 +164,10 @@ def test_traceparent_codec():
     # untrusted wire values never raise
     for junk in (None, "", "00-zz-zz-01", "01-" + "a" * 32 + "-" + "b" * 16,
                  "00-" + "0" * 32 + "-" + "b" * 16 + "-01", b"\xff\xfe", 7,
-                 "00-" + "a" * 32 + "-" + "b" * 16):
+                 "00-" + "a" * 32 + "-" + "b" * 16,
+                 "00-" + "a" * 32 + "-" + "b" * 16 + "-zz",
+                 "00-+" + "a" * 31 + "-" + "b" * 16 + "-01",
+                 "00-" + "A" * 32 + "-" + "b" * 16 + "-01"):
         assert parse_traceparent(junk) is None
     assert format_traceparent() is None  # no current span
 
