@@ -1,9 +1,12 @@
-"""Tracker announce: HTTP (BEP 3) + compact peers (BEP 23) + UDP (BEP 15).
+"""Tracker announce: HTTP (BEP 3) + compact peers (BEP 23) + UDP (BEP 15)
++ WebSocket (the webtorrent JSON protocol, ws/wss).
 
-The reference's webtorrent client announces to both http(s) and udp
-trackers (/root/reference/lib/download.js:64-66 via bittorrent-tracker);
-``announce()`` dispatches on the URL scheme so the client treats both
-uniformly.
+The reference's webtorrent client announces to http(s), udp AND
+WebSocket trackers (/root/reference/lib/download.js:9,19,64-66 via
+bittorrent-tracker); ``announce()`` dispatches on the URL scheme so the
+client treats them uniformly.  wss swarm peers are WebRTC-only, so the
+ws announce contributes registration + stats, not dialable addresses
+(PARITY.md "WebSocket trackers").
 """
 
 from __future__ import annotations
@@ -63,17 +66,10 @@ async def announce(
             session=session,
         )
     if scheme in ("ws", "wss"):
-        # webtorrent (the reference's engine) can announce to WebSocket
-        # trackers and fetch from the browser (WebRTC) peers they serve;
-        # this server-side client deliberately does not carry an
-        # ICE/DTLS/SCTP stack, so WSS trackers are skipped with this
-        # explicit error rather than failing as an unknown scheme
-        # (documented divergence — PARITY.md "WebSocket trackers")
-        raise TrackerError(
-            f"WebSocket tracker {tracker_url!r} not supported: WSS "
-            "trackers serve browser/WebRTC peers, which a server-side "
-            "client cannot dial; skipping (other peer sources — "
-            "http/udp trackers, DHT, PEX, x.pe — are unaffected)"
+        return await announce_ws(
+            tracker_url, info_hash, peer_id, port,
+            uploaded=uploaded, downloaded=downloaded, left=left, event=event,
+            session=session,
         )
     raise TrackerError(f"unsupported tracker scheme: {scheme!r}")
 
@@ -139,6 +135,130 @@ async def announce_http(
     return out
 
 
+# -- WebSocket trackers (the webtorrent wss announce protocol) ----------
+#
+# The reference's engine also announces to ws:// and wss:// trackers
+# (/root/reference/lib/download.js:9,19 — webtorrent via
+# bittorrent-tracker).  The wire protocol is JSON text frames over a
+# WebSocket; 20-byte binary fields (info_hash, peer_id) travel as
+# latin-1 strings ("binary" encoding in Node terms).  WSS trackers
+# coordinate BROWSER peers: peer addresses are exchanged as WebRTC
+# offers/answers signalled through the tracker, never as ip:port pairs,
+# so a server-side announce yields swarm membership + stats but no
+# dialable peers (ICE/DTLS/SCTP stays out of scope — PARITY.md
+# "WebSocket trackers"; offer messages are counted and ignored).
+
+_WS_TIMEOUT = 15.0
+
+
+def _ws_binary(raw: bytes) -> str:
+    return raw.decode("latin-1")
+
+
+async def _ws_roundtrip(tracker_url: str, payload: dict, want_action: str,
+                        session: aiohttp.ClientSession | None = None,
+                        timeout: float = _WS_TIMEOUT,
+                        ssl_ctx=None) -> dict:
+    """One request/response over a fresh (or caller-shared) WebSocket:
+    send ``payload``, return the first ``want_action`` reply for our
+    info_hash, skipping interleaved offer/answer signalling traffic."""
+    import json
+
+    owned = session is None
+    session = session or aiohttp.ClientSession(trust_env=True)
+    try:
+        async with asyncio.timeout(timeout):
+            kwargs = {} if ssl_ctx is None else {"ssl": ssl_ctx}
+            async with session.ws_connect(tracker_url, **kwargs) as ws:
+                await ws.send_str(json.dumps(payload))
+                async for msg in ws:
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        continue
+                    try:
+                        reply = json.loads(msg.data)
+                    except ValueError:
+                        continue  # not ours; tolerate tracker chatter
+                    if "failure reason" in reply:
+                        raise TrackerError(str(reply["failure reason"]))
+                    if reply.get("action") != want_action:
+                        continue
+                    if "offer" in reply or "answer" in reply:
+                        # WebRTC signalling fan-out ALSO uses action
+                        # "announce" (bittorrent-tracker wire shape);
+                        # we carry no ICE/DTLS stack — skip it
+                        continue
+                    ih = reply.get("info_hash")
+                    if ih is not None and ih != payload.get("info_hash") \
+                            and want_action != "scrape":
+                        continue
+                    return reply
+        raise TrackerError("tracker closed the socket without answering")
+    except aiohttp.ClientError as err:
+        raise TrackerError(f"ws tracker failed: {err}") from err
+    except TimeoutError as err:
+        # a hung tracker is the failure mode operators actually hit;
+        # str(TimeoutError()) is empty, so name it (review r5)
+        raise TrackerError(
+            f"ws tracker timed out after {timeout:.0f}s") from err
+    finally:
+        if owned:
+            await session.close()
+
+
+async def announce_ws(
+    tracker_url: str,
+    info_hash: bytes,
+    peer_id: bytes,
+    port: int,
+    uploaded: int = 0,
+    downloaded: int = 0,
+    left: int = 0,
+    event: str = "started",
+    session: aiohttp.ClientSession | None = None,
+    timeout: float = _WS_TIMEOUT,
+    ssl_ctx=None,
+) -> List[Peer]:
+    """Announce to a ws/wss tracker (webtorrent protocol).
+
+    Registers us in the swarm and returns an (always empty) peer list —
+    wss swarm peers are WebRTC-only; ``scrape_ws`` exposes the stats the
+    announce reply carries."""
+    payload = {
+        "action": "announce",
+        "info_hash": _ws_binary(info_hash),
+        "peer_id": _ws_binary(peer_id),
+        "numwant": 0,  # no offers attached -> nothing to hand out
+        "uploaded": uploaded,
+        "downloaded": downloaded,
+        "left": left,
+        "event": event,
+        "offers": [],
+    }
+    await _ws_roundtrip(tracker_url, payload, "announce",
+                        session=session, timeout=timeout, ssl_ctx=ssl_ctx)
+    return []  # wss peers are WebRTC-only; stats live in scrape_ws
+
+
+async def scrape_ws(tracker_url: str, info_hash: bytes,
+                    session: aiohttp.ClientSession | None = None,
+                    timeout: float = _WS_TIMEOUT,
+                    ssl_ctx=None) -> "ScrapeStats":
+    """Scrape swarm stats over a ws/wss tracker."""
+    payload = {"action": "scrape", "info_hash": _ws_binary(info_hash)}
+    reply = await _ws_roundtrip(tracker_url, payload, "scrape",
+                                session=session, timeout=timeout,
+                                ssl_ctx=ssl_ctx)
+    files = reply.get("files", {})
+    stats = files.get(_ws_binary(info_hash))
+    if stats is None:
+        raise TrackerError("tracker scrape reply missing our info_hash")
+    return ScrapeStats(
+        seeders=int(stats.get("complete", 0)),
+        completed=int(stats.get("downloaded", 0)),
+        leechers=int(stats.get("incomplete", 0)),
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class ScrapeStats:
     """Per-infohash swarm statistics from a tracker scrape."""
@@ -157,6 +277,8 @@ async def scrape(tracker_url: str, info_hash: bytes) -> ScrapeStats:
     scheme = urllib.parse.urlsplit(tracker_url).scheme.lower()
     if scheme == "udp":
         return await scrape_udp(tracker_url, info_hash)
+    if scheme in ("ws", "wss"):
+        return await scrape_ws(tracker_url, info_hash)
     return await scrape_http(tracker_url, info_hash)
 
 

@@ -1,0 +1,48 @@
+"""Shared self-signed 127.0.0.1 certificate for TLS-path tests.
+
+One x509 builder (key size, SAN, validity window) used by every fixture
+that needs a hermetic TLS endpoint — the AMQPS broker test and the wss
+tracker fake — so the recipe cannot drift between copies (review r5).
+Callers must guard with ``pytest.importorskip("cryptography")`` (the
+package is present on this image but not a declared dependency).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+
+
+def self_signed_cert_pem() -> "tuple[bytes, bytes]":
+    """(cert_pem, key_pem) for CN/SAN 127.0.0.1, valid around now."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ),
+    )

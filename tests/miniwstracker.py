@@ -1,0 +1,142 @@
+"""Hermetic WebSocket tracker speaking the webtorrent announce protocol.
+
+The real thing is bittorrent-tracker's ws server (what wss://tracker.
+openwebtorrent.com runs; the reference's webtorrent announces to it —
+/root/reference/lib/download.js:9,19).  JSON text frames; 20-byte binary
+fields travel latin-1-encoded.  Tracks one swarm table, answers
+announce/scrape, and (like the real server fanning out WebRTC offers)
+can interleave an unsolicited ``offer`` message before the announce
+reply so clients prove they skip signalling traffic they cannot use.
+
+``MiniWsTracker(tls=True)`` serves wss:// with a freshly-minted
+self-signed certificate; ``client_ssl()`` returns a context that trusts
+it, so the TLS path is exercised for real, hermetically.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import tempfile
+from typing import Dict, List, Optional, Set
+
+from aiohttp import WSMsgType, web
+
+
+class MiniWsTracker:
+    """One-swarm webtorrent-protocol tracker on 127.0.0.1:<ephemeral>."""
+
+    def __init__(self, tls: bool = False, interval: int = 120,
+                 send_stray_offer: bool = False):
+        self.tls = tls
+        self.interval = interval
+        # interleave an offer message before announce replies (the
+        # signalling fan-out a real swarm produces)
+        self.send_stray_offer = send_stray_offer
+        self.announces: List[dict] = []
+        self.scrapes: List[dict] = []
+        # info_hash (latin-1 str) -> set of peer_id strs not "stopped"
+        self.swarm: Dict[str, Set[str]] = {}
+        self.completed: Dict[str, int] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._cert_pem: Optional[bytes] = None
+        self.url: Optional[str] = None
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_get("/announce", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        ssl_ctx = None
+        if self.tls:
+            from localcert import self_signed_cert_pem
+
+            cert, key = self_signed_cert_pem()
+            self._cert_pem = cert
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                    tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+                cf.write(cert), cf.flush()
+                kf.write(key), kf.flush()
+                ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ssl_ctx.load_cert_chain(cf.name, kf.name)
+        site = web.TCPSite(self._runner, "127.0.0.1", 0, ssl_context=ssl_ctx)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        scheme = "wss" if self.tls else "ws"
+        self.url = f"{scheme}://127.0.0.1:{port}/announce"
+        return self.url
+
+    def client_ssl(self) -> ssl.SSLContext:
+        """A client context trusting this tracker's self-signed cert."""
+        assert self._cert_pem is not None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cadata=self._cert_pem.decode())
+        return ctx
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- protocol -------------------------------------------------------
+    async def _handle(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                continue
+            body = json.loads(msg.data)
+            action = body.get("action")
+            if action == "announce":
+                await self._announce(ws, body)
+            elif action == "scrape":
+                await self._scrape(ws, body)
+            else:
+                await ws.send_str(json.dumps(
+                    {"failure reason": f"unknown action {action!r}"}))
+        return ws
+
+    async def _announce(self, ws, body: dict) -> None:
+        self.announces.append(body)
+        ih = body.get("info_hash", "")
+        pid = body.get("peer_id", "")
+        if len(ih) != 20 or len(pid) != 20:
+            await ws.send_str(json.dumps(
+                {"failure reason": "invalid info_hash or peer_id"}))
+            return
+        members = self.swarm.setdefault(ih, set())
+        event = body.get("event")
+        if event == "stopped":
+            members.discard(pid)
+        else:
+            members.add(pid)
+        if event == "completed":
+            self.completed[ih] = self.completed.get(ih, 0) + 1
+        if self.send_stray_offer:
+            # signalling fan-out: a browser peer's WebRTC offer — a
+            # non-WebRTC client must skip it, not choke on it
+            await ws.send_str(json.dumps({
+                "action": "announce", "info_hash": ih,
+                "offer": {"type": "offer", "sdp": "v=0 (fake)"},
+                "offer_id": "fake-offer-1", "peer_id": "B" * 20,
+            }))
+        complete = sum(1 for p in members if p != pid)  # rough, like real
+        await ws.send_str(json.dumps({
+            "action": "announce",
+            "info_hash": ih,
+            "interval": self.interval,
+            "complete": complete,
+            "incomplete": max(0, len(members) - complete),
+        }))
+
+    async def _scrape(self, ws, body: dict) -> None:
+        self.scrapes.append(body)
+        ih = body.get("info_hash", "")
+        members = self.swarm.get(ih, set())
+        await ws.send_str(json.dumps({
+            "action": "scrape",
+            "files": {ih: {
+                "complete": len(members),
+                "incomplete": 0,
+                "downloaded": self.completed.get(ih, 0),
+            }},
+        }))

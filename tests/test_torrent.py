@@ -162,24 +162,89 @@ async def test_download_from_magnet_fetches_metadata(swarm, tmp_path):
     assert swarm.tracker.announces[0]["info_hash"] == swarm.meta.info_hash
 
 
-async def test_wss_tracker_announce_rejected():
-    """WebSocket trackers serve browser/WebRTC peers this server-side
-    client cannot dial — the announce fails with an explicit, documented
-    error instead of a generic unknown-scheme one (PARITY.md)."""
-    from downloader_tpu.torrent.tracker import TrackerError, announce
+async def test_ws_tracker_announce_and_scrape():
+    """The webtorrent wss announce protocol (VERDICT r4 missing-item 1):
+    announce registers us in the swarm (binary fields latin-1-encoded in
+    JSON), interleaved WebRTC offer signalling is skipped rather than
+    mistaken for the reply, scrape reports the swarm, and completed/
+    stopped events update it.  Peers are WebRTC-only so the announce
+    returns none — other sources (http/udp/DHT/PEX/x.pe) supply them."""
+    from downloader_tpu.torrent.tracker import announce, scrape
+    from miniwstracker import MiniWsTracker
 
-    with pytest.raises(TrackerError, match="WebSocket tracker"):
-        await announce("wss://tracker.example/announce", b"\x01" * 20,
-                       b"-DT0001-123456789012", port=0)
+    tracker = MiniWsTracker(send_stray_offer=True)
+    url = await tracker.start()
+    info_hash = bytes(range(236, 256)) # high bytes: latin-1 round-trip
+    try:
+        peers = await announce(url, info_hash, b"-DT0001-aaaaaaaaaaaa",
+                               port=0, left=100)
+        assert peers == []
+        sent = tracker.announces[0]
+        assert sent["info_hash"] == info_hash.decode("latin-1")
+        assert sent["event"] == "started" and sent["offers"] == []
+
+        await announce(url, info_hash, b"-DT0001-bbbbbbbbbbbb",
+                       port=0, left=0)
+        stats = await scrape(url, info_hash)
+        assert stats.seeders == 2 and stats.completed == 0
+
+        await announce(url, info_hash, b"-DT0001-bbbbbbbbbbbb",
+                       port=0, left=0, event="completed")
+        await announce(url, info_hash, b"-DT0001-aaaaaaaaaaaa",
+                       port=0, event="stopped")
+        stats = await scrape(url, info_hash)
+        assert stats.seeders == 1 and stats.completed == 1
+    finally:
+        await tracker.stop()
+
+
+async def test_wss_tracker_announce_over_tls():
+    """wss:// — the actual TLS WebSocket path, against a hermetic
+    tracker with a freshly-minted self-signed certificate."""
+    pytest.importorskip("cryptography")
+    from downloader_tpu.torrent.tracker import announce_ws, scrape_ws
+    from miniwstracker import MiniWsTracker
+
+    tracker = MiniWsTracker(tls=True)
+    url = await tracker.start()
+    assert url.startswith("wss://")
+    info_hash = b"\x02" * 20
+    try:
+        ctx = tracker.client_ssl()
+        peers = await announce_ws(url, info_hash, b"-DT0001-tlstlstlstls",
+                                  port=0, left=5, ssl_ctx=ctx)
+        assert peers == []
+        stats = await scrape_ws(url, info_hash, ssl_ctx=ctx)
+        assert stats.seeders == 1
+    finally:
+        await tracker.stop()
+
+
+async def test_ws_tracker_failure_reason_raises():
+    from downloader_tpu.torrent.tracker import TrackerError, announce
+    from miniwstracker import MiniWsTracker
+
+    tracker = MiniWsTracker()
+    url = await tracker.start()
+    try:
+        with pytest.raises(TrackerError, match="invalid info_hash"):
+            await announce(url, b"\x03" * 7, b"-DT0001-cccccccccccc",
+                           port=0)
+    finally:
+        await tracker.stop()
 
 
 async def test_magnet_with_only_wss_trackers_uses_other_sources(
         swarm, tmp_path):
-    """A magnet whose only trackers are WSS must not fail the download:
-    the WSS announce is skipped with a log and the remaining peer
-    sources (here the magnet's own x.pe hint) carry the job."""
+    """A magnet whose only tracker is an unreachable WSS one must not
+    fail the download: the announce error is logged and skipped, and
+    the remaining peer sources (here the magnet's own x.pe hint) carry
+    the job."""
+    # a guaranteed-closed LOCAL port: no DNS, no egress, fails fast on
+    # any network (review r5 — tracker.example could hang on captive
+    # resolvers now that the wss branch really dials)
     uri = (make_magnet(swarm.meta.info_hash, swarm.meta.name,
-                       ["wss://tracker.example/announce"])
+                       ["wss://127.0.0.1:1/announce"])
            + f"&x.pe=127.0.0.1:{swarm.seeder.port}")
     dest = str(tmp_path / "dl-wss")
     meta = await TorrentClient().download(uri, dest)
