@@ -340,6 +340,9 @@ class TorrentClient:
             await asyncio.to_thread(
                 resume_mod.save_resume, storage.root, meta, set(swarm.done)
             )
+            # release cached file handles; a lingering background seeder
+            # sharing this storage just reopens lazily
+            storage.close()
 
         if on_progress is not None:
             await on_progress(1.0)

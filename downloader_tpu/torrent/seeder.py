@@ -127,6 +127,7 @@ class Seeder:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
+        self.storage.close()  # drop cached fds (reopen-on-use if shared)
 
     async def add_piece(self, index: int) -> None:
         """Record a newly available piece and HAVE-broadcast it (BEP 3).

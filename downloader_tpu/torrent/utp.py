@@ -74,6 +74,13 @@ RECV_WINDOW = 1 << 20  # advertised receive window
 MIN_RTO = 0.5
 MAX_RETRANSMITS = 6  # ~0.5+1+2+4+8+16 s of backoff before giving up
 FIN_LINGER = 3.0
+# TIME_WAIT-style courtesy after our side retires with the peer's FIN
+# still unseen: stay registered (acking the peer's data/FIN) so THEIR
+# close completes in one round trip instead of retransmitting into
+# silence until FIN_LINGER aborts — profiled r5: this stall made a
+# loopback transfer of 32 MiB read 11 MB/s end-to-end while the data
+# phase alone ran at ~1 GB/s
+LAST_ACK_LINGER = 1.0
 
 # acceptor-side state bounds: a SYN flood must not mint unbounded
 # connection objects/timers, and a silent peer must not pin its slot
@@ -274,6 +281,7 @@ class UtpConnection:
         self._last_ack_seen = -1
 
         self._ack_scheduled = False
+        self._flush_scheduled = False  # write-coalescing (one loop turn)
         self._pending_acks = 0  # in-order data packets not yet acked
         self._ack_deadline = 0.0
         self._quenched_peer = False  # we advertised < one packet of room
@@ -285,6 +293,7 @@ class UtpConnection:
         self._fin_seq: Optional[int] = None
         self._done = asyncio.Event()
         self._timer: Optional[asyncio.Task] = None
+        self._drain_timer = None  # LAST_ACK courtesy window (TimerHandle)
         self._syn_packet: Optional[bytes] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -306,6 +315,9 @@ class UtpConnection:
         self._done.set()
         if self._timer is not None:
             self._timer.cancel()
+        if self._drain_timer is not None:
+            self._drain_timer.cancel()
+            self._drain_timer = None
         self.endpoint._unregister(self)
 
     async def _timeout_loop(self) -> None:
@@ -332,6 +344,23 @@ class UtpConnection:
             return
         oldest = min(self._inflight.values(), key=lambda p: p.sent_at)
         if now - oldest.sent_at < self._rto:
+            # tail-loss probe (TCP TLP style): a lost LAST packet of a
+            # burst generates no dup-acks, so without this the only
+            # recovery is the full MIN_RTO (500 ms) — a massive stall
+            # against sub-ms loopback RTTs (r5: occasional swarm runs
+            # lost ~30% throughput to exactly these).  After a quiet
+            # period of ~2 RTT (floored well above ack-coalescing
+            # delays), re-send the NEWEST unacked packet once; if the
+            # tail was lost the ack (or dup-ack chain) restarts
+            # recovery, and a spurious probe costs one duplicate the
+            # receiver discards.
+            newest = max(self._inflight.values(), key=lambda p: p.sent_at)
+            quiet = max(2 * self._rtt + 4 * self._rtt_var,
+                        2 * DELAYED_ACK_TIMEOUT)
+            if (now - newest.sent_at > quiet
+                    and now - self._last_recv > quiet
+                    and newest.transmissions == 1):
+                self._transmit(newest)
             return
         if oldest.transmissions > MAX_RETRANSMITS:
             self.abort(ConnectionResetError("uTP retransmit limit"))
@@ -420,6 +449,15 @@ class UtpConnection:
         exactly once, for routing and for us — r3 decoded twice)."""
         (ptype, _cid, ts, ts_diff, wnd, seq, ack, sack, payload) = packet
         if self._closed:
+            # draining (LAST_ACK courtesy): keep acking the peer's
+            # remaining in-order data/FIN — payloads are discarded (our
+            # reader is gone), the cumulative ack is what lets the
+            # peer's own close finish without retransmit stalls
+            if ptype in (ST_DATA, ST_FIN) and self._drain_timer is not None:
+                self._reply_micro = (_now_us() - ts) & 0xFFFFFFFF
+                if seq == ((self._ack + 1) & 0xFFFF):
+                    self._ack = seq
+                self._send_ack()
             return
         self._last_recv = time.monotonic()
         self._reply_micro = (_now_us() - ts) & 0xFFFFFFFF
@@ -460,7 +498,11 @@ class UtpConnection:
 
     def _flush_ack(self) -> None:
         self._ack_scheduled = False
-        if not self._closed and self._pending_acks:
+        # draining counts as alive for acking: a FIN's ack scheduled
+        # just before our own retire must still go out, or the peer
+        # retransmits into silence (r5)
+        if self._pending_acks and (not self._closed
+                                   or self._drain_timer is not None):
             self._send_ack()
 
     def _handle_data(self, ptype: int, seq: int, payload: bytes) -> bool:
@@ -606,7 +648,21 @@ class UtpConnection:
             self._send_q_len += len(data)
         if not self._send_buf_low():
             self._send_lo.clear()
-        self._flush()
+        # packetize one loop turn later, not per write: a pipelined
+        # serve loop writes many 16 KiB blocks back-to-back in one turn,
+        # and flushing each immediately emitted one UNDERSIZED datagram
+        # per block (~2.7k packets per 32 MiB instead of ~560 at the
+        # 60 KiB loopback payload — r5 profile).  Deferring lets the
+        # burst coalesce into full datagrams; ack-clocked refills
+        # (_handle_ack -> _flush) stay immediate.
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._deferred_flush)
+
+    def _deferred_flush(self) -> None:
+        self._flush_scheduled = False
+        if not self._closed:
+            self._flush()
 
     def _send_buf_low(self) -> bool:
         return self._send_q_len < RECV_WINDOW // 2
@@ -742,6 +798,20 @@ class UtpConnection:
         self._done.set()
         if self._timer is not None:
             self._timer.cancel()
+        if self._eof_seq is not None:
+            # both directions finished: fully gone
+            self.endpoint._unregister(self)
+        else:
+            # the peer hasn't closed its direction yet: drain — stay
+            # registered to ack its remaining data/FIN (on_datagram's
+            # closed-branch) so its close completes promptly, then
+            # unregister after the courtesy window
+            loop = asyncio.get_running_loop()
+            self._drain_timer = loop.call_later(
+                LAST_ACK_LINGER, self._unregister_after_drain)
+
+    def _unregister_after_drain(self) -> None:
+        self._drain_timer = None
         self.endpoint._unregister(self)
 
     async def _wait_closed(self) -> None:
@@ -1057,7 +1127,12 @@ class _OwningWriter(UtpWriter):
 
     async def wait_closed(self) -> None:
         await super().wait_closed()
-        self._endpoint.close()
+        if self._conn._drain_timer is None:
+            self._endpoint.close()
+        # else: the LAST_ACK drain window owns the endpoint now — its
+        # expiry unregisters the connection, which retires the
+        # single-connection socket; closing here would slam the socket
+        # shut before the peer's FIN can be acked (r5)
 
 
 async def open_utp_connection(host: str, port: int, *,

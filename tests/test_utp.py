@@ -165,6 +165,73 @@ async def test_transfer_integrity():
     assert digest == hashlib.sha1(payload).digest()
 
 
+async def test_utp_vs_tcp_ratio_floor():
+    """Paired loopback stream transfer: uTP must hold >= 0.7x TCP's
+    throughput measured in the same process, interleaved (VERDICT r4
+    item 3 — nothing previously failed if the ratio regressed).  The
+    ratio, not absolute MB/s, is asserted: host contention moves both
+    lanes together.  Best-of-2 interleaved rounds for CI safety."""
+    import time
+
+    payload = os.urandom(12 << 20)
+
+    async def measure(start_server, open_conn, stop_server) -> float:
+        """One timed send of ``payload`` incl. both closes; the SAME
+        code body measures both transports so they can never diverge."""
+        done = asyncio.Event()
+
+        async def handler(reader, writer):
+            n = 0
+            while n < len(payload):
+                chunk = await reader.read(1 << 20)
+                if not chunk:
+                    break
+                n += len(chunk)
+            writer.close()
+            await writer.wait_closed()
+            done.set()
+
+        server = await start_server(handler)
+        reader, writer = await open_conn(server)
+        t0 = time.monotonic()
+        writer.write(payload)
+        await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+        await done.wait()
+        dt = time.monotonic() - t0
+        await stop_server(server)
+        return len(payload) / dt
+
+    async def tcp_start(handler):
+        return await asyncio.start_server(handler, "127.0.0.1", 0)
+
+    async def tcp_open(server):
+        return await asyncio.open_connection(
+            "127.0.0.1", server.sockets[0].getsockname()[1])
+
+    async def tcp_stop(server):
+        server.close()
+        await server.wait_closed()
+
+    async def utp_start(handler):
+        return await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+
+    async def utp_open(server):
+        return await open_utp_connection(*server.local_addr)
+
+    async def utp_stop(server):
+        server.close()
+
+    best = 0.0
+    async with asyncio.timeout(120):
+        for _ in range(2):
+            tcp_rate = await measure(tcp_start, tcp_open, tcp_stop)
+            utp_rate = await measure(utp_start, utp_open, utp_stop)
+            best = max(best, utp_rate / tcp_rate)
+    assert best >= 0.7, f"utp/tcp ratio {best:.3f} below the 0.7 floor"
+
+
 async def test_proactor_fallback_transport(monkeypatch):
     """Loops without ``add_reader`` (Windows' ProactorEventLoop) must
     fall back to asyncio's stock datagram transport instead of failing
