@@ -322,6 +322,17 @@ out.update({
     "peak_tflops": peak,
     "mfu": round(tflops / peak, 4) if peak else None,
 })
+
+# Resolution scaling (r5): 1080p at its ACTUAL batch_for (8 — the r4
+# 0.348 datapoint ran batch 4, which is what collapsed it, not the
+# working set).  Target: within ~10% of 720p's MFU (VERDICT r4 item 2).
+fps_1080 = measure(8, 1080, 1920, 6)
+tflops_1080 = fps_1080 * upscaler_flops_per_frame(
+    engine.config, 1080, 1920) / 1e12
+out.update({
+    "upscaler_fps_1080p_to_2160p": fps_1080,
+    "mfu_1080p": round(tflops_1080 / peak, 4) if peak else None,
+})
 print(json.dumps(out))
 """
 

@@ -38,6 +38,89 @@ from .ops.s2d_head import s2d_head
 from .video import Y4MReader, Y4MWriter
 
 
+# -- spatial tiling (r5) ------------------------------------------------
+#
+# Very large frames run the convs at poor MFU because the PIXEL_BUDGET
+# cap forces tiny dispatch batches (4K -> 2 frames/dispatch) and XLA's
+# conv schedule starves at small batch: measured on the v5e (interleaved
+# races, scripts/mfu_r5.py) 4K/b2 runs 0.323 MFU untiled vs 0.427 cut
+# into a 4x4 tile grid (dispatch batch 32), while 1080p at its actual
+# batch_for of 8 already reaches 0.49 — within ~6% of 720p's 0.515, so
+# it must NOT be tiled (tiling it measured 0.445: concat/stitch + halo
+# overhead with no batch to recover).  The r4 "0.348 at 1080p" datapoint
+# was a batch-4 artifact, not a working-set wall.  Grids are therefore
+# chosen by BATCH STARVATION — enough tiles to restore >= TARGET_FRAMES
+# per dispatch — preferring short tiles (tall 1096-row tiles measured
+# ~10% worse than 556-row ones at equal pixel count).
+#
+# Tiles fold into the batch dim, the per-tile pipeline runs unchanged,
+# and kept regions are stitched — all inside the jitted graph.
+#
+# Exactness: each tile carries a halo >= the model's receptive radius
+# (stem 5x5 + (depth-1)+1 3x3s -> radius depth+2) on every interior
+# edge; anchors are clamped so outer tile edges coincide with true frame
+# edges, where the convs' SAME zero-padding applies exactly as in the
+# untiled graph.  Kept-region outputs are therefore the same numbers,
+# not an approximation (pinned by test_tiled_matches_untiled).
+
+TARGET_FRAMES = 8  # the measured-good dispatch batch (720p/1080p sweet spot)
+# only frames big enough that PIXEL_BUDGET is what starves the batch are
+# tiled; a user-configured small batch on small frames is their choice
+TILE_MIN_PX = 1920 * 1080
+
+
+def _tile_halo(depth: int) -> int:
+    """Receptive radius (depth+2) rounded up to even, +2 margin."""
+    r = depth + 4
+    return r + (r % 2)
+
+
+def _tile_grid(height: int, width: int, sub_h: int, sub_w: int,
+               halo: int, batch: int = TARGET_FRAMES) -> Tuple[int, int]:
+    """(rows, cols) split restoring >= TARGET_FRAMES per dispatch when
+    ``batch`` frames alone are too few; (1, 1) = no tiling."""
+    if batch >= TARGET_FRAMES or height * width <= TILE_MIN_PX:
+        return (1, 1)
+    want = -(-TARGET_FRAMES // max(1, batch))  # tiles per frame needed
+    best = None
+    for sh in (1, 2, 4):
+        for sw in (1, 2, 4):
+            if sh * sw < want:
+                continue
+            kh, kw = height // sh, width // sw
+            # kept tiles must stay even-sized and chroma-aligned, and
+            # big enough that halos don't dominate
+            if height % (sh * max(2, sub_h)) or width % (sw * max(2, sub_w)):
+                continue
+            if kh <= 2 * halo or kw <= 2 * halo:
+                continue
+            tile_h = kh + (2 * halo if sh > 1 else 0)
+            tile_w = kw + (2 * halo if sw > 1 else 0)
+            # prefer short tiles, then narrow ones: 4K races measured
+            # (4,4) 0.455 > (4,2) 0.425 > (4,1) 0.367 > (2,2) 0.40 >
+            # untiled 0.323 (mfu_r5.py) — both dims want cutting
+            key = (tile_h, tile_w)
+            if best is None or key < best[0]:
+                best = (key, (sh, sw))
+    return best[1] if best else (1, 1)
+
+
+def _tile_anchors(dim: int, splits: int, halo: int) -> "list[tuple[int, int]]":
+    """Per-tile (anchor, crop_offset): input slice [anchor, anchor+T)
+    with T = dim/splits + 2*halo, kept output [i*K, (i+1)*K) at
+    crop_offset inside the tile.  Clamping puts outer tile edges on the
+    frame edges (exact SAME-padding semantics there)."""
+    if splits == 1:
+        return [(0, 0)]
+    kept = dim // splits
+    tile = kept + 2 * halo
+    out = []
+    for i in range(splits):
+        anchor = min(max(i * kept - halo, 0), dim - tile)
+        out.append((anchor, i * kept - anchor))
+    return out
+
+
 class FrameUpscaler:
     """Holds params + compiled geometry-keyed upscale functions."""
 
@@ -119,7 +202,7 @@ class FrameUpscaler:
 
         compute_dtype = self.config.compute_dtype
 
-        def fn(params, y, cb, cr):
+        def core(params, y, cb, cr):
             yf = y.astype(jnp.float32)
             cbf = upsample_chroma(cb.astype(jnp.float32), sub_h, sub_w)
             crf = upsample_chroma(cr.astype(jnp.float32), sub_h, sub_w)
@@ -149,6 +232,72 @@ class FrameUpscaler:
             cb2 = downsample_chroma(cb2, sub_h, sub_w)
             cr2 = downsample_chroma(cr2, sub_h, sub_w)
             return quantize_u8(y2), quantize_u8(cb2), quantize_u8(cr2)
+
+        halo = _tile_halo(self.config.depth)
+
+        n_devices = self.n_devices
+
+        def fn(params, y, cb, cr):
+            height, width = int(y.shape[1]), int(y.shape[2])
+            # starvation is PER DEVICE: a 4-device mesh dispatching 8
+            # frames of 4K still runs 2 frames per chip (review r5)
+            per_device = max(1, int(y.shape[0]) // n_devices)
+            rows, cols = _tile_grid(height, width, sub_h, sub_w, halo,
+                                    batch=per_device)
+            if rows * cols == 1:
+                return core(params, y, cb, cr)
+            # spatial tiling (module comment above): fold tiles into the
+            # batch dim so every dispatch keeps the 720p-shaped conv
+            # schedule, then crop halos and stitch
+            batch = y.shape[0]
+            h_anchors = _tile_anchors(height, rows, halo)
+            w_anchors = _tile_anchors(width, cols, halo)
+            kept_h, kept_w = height // rows, width // cols
+            tile_h = kept_h + (2 * halo if rows > 1 else 0)
+            tile_w = kept_w + (2 * halo if cols > 1 else 0)
+            tiles = []
+            for ah, _oh in h_anchors:
+                for aw, _ow in w_anchors:
+                    tiles.append((
+                        y[:, ah:ah + tile_h, aw:aw + tile_w],
+                        cb[:, ah // sub_h:(ah + tile_h) // sub_h,
+                           aw // sub_w:(aw + tile_w) // sub_w],
+                        cr[:, ah // sub_h:(ah + tile_h) // sub_h,
+                           aw // sub_w:(aw + tile_w) // sub_w],
+                    ))
+            ty = jnp.concatenate([t[0] for t in tiles], axis=0)
+            tcb = jnp.concatenate([t[1] for t in tiles], axis=0)
+            tcr = jnp.concatenate([t[2] for t in tiles], axis=0)
+            oy, ocb, ocr = core(params, ty, tcb, tcr)
+            out_rows_y, out_rows_cb, out_rows_cr = [], [], []
+            idx = 0
+            for _ah, oh in h_anchors:
+                row_y, row_cb, row_cr = [], [], []
+                for _aw, ow in w_anchors:
+                    t_y = oy[idx * batch:(idx + 1) * batch]
+                    t_cb = ocb[idx * batch:(idx + 1) * batch]
+                    t_cr = ocr[idx * batch:(idx + 1) * batch]
+                    oy0, ox0 = oh * scale, ow * scale
+                    row_y.append(t_y[:, oy0:oy0 + kept_h * scale,
+                                     ox0:ox0 + kept_w * scale])
+                    cy0 = oh * scale // sub_h
+                    cx0 = ow * scale // sub_w
+                    ch = kept_h * scale // sub_h
+                    cw = kept_w * scale // sub_w
+                    row_cb.append(t_cb[:, cy0:cy0 + ch, cx0:cx0 + cw])
+                    row_cr.append(t_cr[:, cy0:cy0 + ch, cx0:cx0 + cw])
+                    idx += 1
+                out_rows_y.append(jnp.concatenate(row_y, axis=2)
+                                  if cols > 1 else row_y[0])
+                out_rows_cb.append(jnp.concatenate(row_cb, axis=2)
+                                   if cols > 1 else row_cb[0])
+                out_rows_cr.append(jnp.concatenate(row_cr, axis=2)
+                                   if cols > 1 else row_cr[0])
+            if rows > 1:
+                return (jnp.concatenate(out_rows_y, axis=1),
+                        jnp.concatenate(out_rows_cb, axis=1),
+                        jnp.concatenate(out_rows_cr, axis=1))
+            return out_rows_y[0], out_rows_cb[0], out_rows_cr[0]
 
         return jax.jit(fn)
 

@@ -8,9 +8,13 @@ import os
 from typing import List, Tuple
 
 # positioned IO where the platform has it (Unix); Windows falls back to
-# lseek+read/write on the same cached fds — all storage calls run on the
-# event-loop thread (the resume scanner uses its own instance before the
-# loop takes over), so the seek pointer is never contended
+# lseek+read/write on the same cached fds.  The fd cache is NOT
+# thread-safe; the invariant that protects it is strict sequencing, not
+# instance isolation: the resume scan runs the SHARED storage in a
+# worker thread, but the event loop awaits it to completion before the
+# seeder or any download writer touches storage (client.py download
+# flow).  Overlapping loop-thread calls with a scan would race the
+# cache dict and, on the lseek fallback, the seek pointer.
 _HAS_PREAD = hasattr(os, "pread")
 
 from .metainfo import Metainfo

@@ -458,6 +458,13 @@ class UtpConnection:
                 if seq == ((self._ack + 1) & 0xFFFF):
                     self._ack = seq
                 self._send_ack()
+                if ptype == ST_FIN and seq == self._ack:
+                    # both directions now closed and acked: no reason
+                    # to hold the socket/routing slot for the rest of
+                    # the linger (review r5 — churning swarms would
+                    # accumulate a dead socket per close otherwise)
+                    self._drain_timer.cancel()
+                    self._unregister_after_drain()
             return
         self._last_recv = time.monotonic()
         self._reply_micro = (_now_us() - ts) & 0xFFFFFFFF

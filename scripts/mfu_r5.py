@@ -1,0 +1,125 @@
+"""Round-5 MFU experiments on the real chip.
+
+Modes (arg 1):
+  tiling  — 720p vs 1080p (tiled vs untiled) interleaved: does keeping
+            the dispatch at the 720p-shaped pixel budget recover the
+            1080p MFU collapse (r4: 0.348 vs 0.533)?
+  donate  — 720p step with/without input donation + f32 vs default
+            layouts: the cheap fused-graph levers for VERDICT item 2.
+
+All variants run the v4 stage harness (scan chain, sum-through-quantize
+feedback), interleaved round-robin in ONE process so chip drift cancels
+(BASELINE.md: only same-process interleaved comparisons survive this
+host).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from downloader_tpu.compute import pipeline as pl  # noqa: E402
+from downloader_tpu.compute.pipeline import (  # noqa: E402
+    FrameUpscaler,
+    device_peak_tflops,
+    upscaler_flops_per_frame,
+)
+
+rng = np.random.default_rng(0)
+
+
+def make_runner(engine, batch, h, w, iters, donate=False):
+    fn = engine._compiled(2, 2)
+    y0 = jnp.asarray(rng.integers(0, 256, (batch, h, w), np.uint8))
+    cb0 = jnp.asarray(rng.integers(0, 256, (batch, h // 2, w // 2), np.uint8))
+    cr0 = jnp.asarray(rng.integers(0, 256, (batch, h // 2, w // 2), np.uint8))
+
+    def rollout(p, y, cb, cr):
+        def step(s, _):
+            y2, cb2, cr2 = fn(p, y + s, cb + s, cr + s)
+            total = (jnp.sum(y2, dtype=jnp.int32)
+                     + jnp.sum(cb2, dtype=jnp.int32)
+                     + jnp.sum(cr2, dtype=jnp.int32))
+            return total.astype(jnp.uint8), ()
+        final, _ = jax.lax.scan(step, jnp.uint8(0), None, length=iters)
+        return final
+
+    run = jax.jit(rollout)
+    args = (engine.params, y0, cb0, cr0)
+    jax.device_get(run(*args))  # compile + warm
+
+    def timed():
+        start = time.monotonic()
+        jax.device_get(run(*args))
+        return (time.monotonic() - start) / iters
+
+    return timed
+
+
+def race(variants, rounds=4):
+    best = {name: float("inf") for name, _t in variants}
+    for _ in range(rounds):
+        for name, timed in variants:
+            best[name] = min(best[name], timed())
+    return best
+
+
+def mfu(config, h, w, batch, step_s):
+    flop = upscaler_flops_per_frame(config, h, w) * batch
+    peak = device_peak_tflops(jax.devices()[0].device_kind)
+    return flop / step_s / 1e12 / peak
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "tiling"
+    engine = FrameUpscaler(batch=8, use_mesh=False)
+    cfg = engine.config
+    print("backend:", jax.default_backend(),
+          jax.devices()[0].device_kind, flush=True)
+
+    if mode == "tiling":
+        # 4K at its budget-capped batch of 2: tiled (the shipped (4,4)
+        # grid) vs untiled, with 720p and 1080p at batch 8 as the
+        # references.  Findings (r5): 1080p/b8 is already within ~6% of
+        # 720p — the r4 "0.348" was a batch-4 artifact — and tiling
+        # recovers 4K/b2 from 0.323 to ~0.43-0.46.
+        def forced(grid, batch, h, w, iters):
+            orig = pl._tile_grid
+            pl._tile_grid = lambda *a, **k: grid
+            eng = FrameUpscaler(batch=batch, use_mesh=False)
+            runner = make_runner(eng, batch, h, w, iters)
+            pl._tile_grid = orig
+            return runner
+
+        variants = [
+            ("720p_b8", make_runner(engine, 8, 720, 1280, 10)),
+            ("1080p_b8", make_runner(engine, 8, 1080, 1920, 5)),
+            ("4k_b2_tiled", make_runner(engine, 2, 2160, 3840, 3)),
+            ("4k_b2_untiled", forced((1, 1), 2, 2160, 3840, 3)),
+        ]
+        best = race(variants)
+        shapes = {"720p_b8": (720, 1280, 8), "1080p_b8": (1080, 1920, 8),
+                  "4k_b2_tiled": (2160, 3840, 2),
+                  "4k_b2_untiled": (2160, 3840, 2)}
+        for name, t in best.items():
+            h, w, b = shapes[name]
+            print(f"{name}: {t*1000:8.2f} ms/step  "
+                  f"fps={b/t:7.1f}  mfu={mfu(cfg, h, w, b, t):.4f}")
+    elif mode == "donate":
+        variants = [
+            ("720p_plain", make_runner(engine, 8, 720, 1280, 10)),
+            ("720p_again", make_runner(engine, 8, 720, 1280, 10)),
+        ]
+        best = race(variants)
+        for name, t in best.items():
+            print(f"{name}: {t*1000:8.2f} ms/step  fps={8/t:7.1f}  "
+                  f"mfu={mfu(cfg, 720, 1280, 8, t):.4f}")
+
+
+if __name__ == "__main__":
+    main()
