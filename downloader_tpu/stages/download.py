@@ -70,6 +70,18 @@ SEG_MIN_SIZE = 8 << 20
 SEG_STATE_INTERVAL = 2.0
 
 
+def _write_all(fd: int, view, pos: "int | None") -> None:
+    """Write a full buffer at ``pos`` (None = the fd's own offset)."""
+    view = memoryview(view)
+    while view:
+        if pos is None:
+            n = os.write(fd, view)
+        else:
+            n = os.pwrite(fd, view, pos)
+            pos += n
+        view = view[n:]
+
+
 def _spliceable(resp) -> bool:
     """True when this response's body can land via kernel splice."""
     if not SPLICE_OK or os.environ.get(_SPLICE_DISABLED_ENV):
@@ -94,15 +106,18 @@ def _spliceable(resp) -> bool:
 
 def _splice_slice_blocking(sock_fd: int, pipe_r: int, pipe_w: int,
                            out_fd: int, want: int, timeout: float,
-                           abort_fd: int) -> int:
+                           abort_fd: int,
+                           out_offset: "int | None" = None) -> int:
     """Move up to ``want`` bytes socket -> pipe -> file in the kernel.
 
     Runs in a worker thread.  The socket stays nonblocking; readiness
     comes from select, which also watches ``abort_fd`` so the event-loop
     side can interrupt instantly (a cancelled to_thread otherwise leaves
     this thread selecting on fds the caller is about to close — an fd
-    recycling hazard).  Returns bytes moved; 0 means EOF before any
-    byte of this slice.
+    recycling hazard).  ``out_offset`` writes at an explicit file
+    position (segmented downloads share one fd across concurrent
+    segments); None uses — and advances — the fd's own offset.
+    Returns bytes moved; 0 means EOF before any byte of this slice.
     """
     import select as select_mod
 
@@ -130,7 +145,12 @@ def _splice_slice_blocking(sock_fd: int, pipe_r: int, pipe_w: int,
             return moved  # EOF
         left = n
         while left:
-            left -= os.splice(pipe_r, out_fd, left)
+            if out_offset is None:
+                left -= os.splice(pipe_r, out_fd, left)
+            else:
+                got = os.splice(pipe_r, out_fd, left,
+                                offset_dst=out_offset + moved + (n - left))
+                left -= got
         moved += n
     return moved
 
@@ -490,10 +510,20 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         fetched = [0]  # cumulative across resume rounds, for the watchdog
 
-        async def _splice_body(resp, fh) -> int:
+        async def _splice_body(resp, out_fd, offset=None, limit=None,
+                               strict=True) -> int:
             """Kernel-path body landing: socket -> pipe -> file, no
             userspace copies (see SPLICE_OK).  ~70% of staging CPU per
-            byte was the two memcpys this skips (profiled r5)."""
+            byte was the two memcpys this skips (profiled r5).
+
+            ``offset`` None writes at (and advances) the fd's own
+            position; an int uses positioned writes — the segmented
+            path shares ONE fd across concurrent segments.  ``limit``
+            caps landed bytes (a segment must never write past its
+            end; surplus response bytes die with the connection).
+            ``strict`` raises on early EOF; the segmented caller
+            instead returns short and lets its range loop re-request.
+            Returns bytes landed."""
             import fcntl
 
             transport = resp.connection.transport
@@ -506,16 +536,19 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             transport.pause_reading()
             head = resp.content.read_nowait(-1)
             transport.pause_reading()
+            # the worker writes through a PRIVATE dup of the output fd,
+            # owned (like the pipes) by the cleanup below: a
+            # double-cancel can leave the worker inside os.splice after
+            # the caller's fd is closed and its NUMBER recycled — with
+            # a dup, the write lands in the right file description no
+            # matter what the caller closed (review r5).  For
+            # offset=None the dup shares the file offset, so
+            # positionless writes still advance the caller's handle.
+            out_dup = os.dup(out_fd)
             total = 0
-            if head:
-                fh.write(head)
-                total = len(head)
-                fetched[0] += len(head)
-                watchdog.feed(fetched[0])
-                if limiter is not None:
-                    await limiter.consume(len(head))
-            remaining = resp.content_length - total
-            sock_fd = transport.get_extra_info("socket").fileno()
+            resp_left = resp.content_length - len(head)
+            cap = (limit if limit is not None
+                   else len(head) + max(resp_left, 0))
             pipe_r, pipe_w = os.pipe()
             abort_r, abort_w = os.pipe()
             cleaned = [False]
@@ -527,7 +560,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if cleaned[0]:
                     return
                 cleaned[0] = True
-                for fd in (pipe_r, pipe_w, abort_r, abort_w):
+                for fd in (pipe_r, pipe_w, abort_r, abort_w, out_dup):
                     os.close(fd)
                 # body bytes were consumed behind aiohttp's parser: this
                 # connection must never return to the pool
@@ -535,6 +568,24 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
             fut = None
             try:
+                if head:
+                    landed = min(len(head), cap)
+                    if offset is None:
+                        _write_all(out_dup, memoryview(head)[:cap], None)
+                    else:
+                        # positioned head writes go to a worker like the
+                        # streaming fallback's pwrites: a contended
+                        # volume must not stall the event loop (r5)
+                        await asyncio.to_thread(
+                            _write_all, out_dup, memoryview(head)[:cap],
+                            offset)
+                    total = landed
+                    fetched[0] += landed
+                    watchdog.feed(fetched[0])
+                    if limiter is not None:
+                        await limiter.consume(landed)
+                remaining = min(cap - total, resp_left)
+                sock_fd = transport.get_extra_info("socket").fileno()
                 try:
                     fcntl.fcntl(pipe_w, fcntl.F_SETPIPE_SZ,
                                 _SPLICE_PIPE_SIZE)
@@ -543,8 +594,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 while remaining > 0:
                     fut = asyncio.ensure_future(asyncio.to_thread(
                         _splice_slice_blocking, sock_fd, pipe_r, pipe_w,
-                        fh.fileno(), min(remaining, _SPLICE_SLICE),
+                        out_dup, min(remaining, _SPLICE_SLICE),
                         STALL_TIMEOUT_SECONDS, abort_r,
+                        None if offset is None else offset + total,
                     ))
                     try:
                         moved = await asyncio.shield(fut)
@@ -561,6 +613,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             pass
                         raise
                     if moved == 0:
+                        if not strict:
+                            break  # segment range loop re-requests
                         raise aiohttp.ClientPayloadError(
                             f"connection closed {remaining} bytes early "
                             "during splice")
@@ -595,7 +649,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if open_mode == "r+b":
                     fh.seek(0, os.SEEK_END)
                 if use_splice:
-                    return await _splice_body(resp, fh)
+                    return await _splice_body(resp, fh.fileno())
                 async for raw in resp.content.iter_any():
                     if limiter is not None:
                         await limiter.consume(len(raw))
@@ -757,19 +811,29 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 "segmented: mis-ranged 206 "
                                 f"{resp.headers.get('Content-Range')!r}"
                             )
-                        async for raw in resp.content.iter_any():
-                            if limiter is not None:
-                                await limiter.consume(len(raw))
-                            fetched[0] += len(raw)
-                            watchdog.feed(fetched[0])
-                            # never write past our segment: a peer
-                            # segment owns the bytes after seg[2]
-                            data = raw[:seg[2] - seg[1]]
-                            await loop.run_in_executor(
-                                io_pool, os.pwrite, fd, data, seg[1])
-                            seg[1] += len(data)
-                            if len(data) < len(raw):
-                                break  # server over-delivered; done
+                        if (_spliceable(resp)
+                                and not _is_encoded(resp.headers)):
+                            # kernel landing at the segment's offset;
+                            # non-strict: a short/closed 206 just
+                            # re-ranges like the streaming loop would
+                            got = await _splice_body(
+                                resp, fd, offset=seg[1],
+                                limit=seg[2] - seg[1], strict=False)
+                            seg[1] += got
+                        else:
+                            async for raw in resp.content.iter_any():
+                                if limiter is not None:
+                                    await limiter.consume(len(raw))
+                                fetched[0] += len(raw)
+                                watchdog.feed(fetched[0])
+                                # never write past our segment: a peer
+                                # segment owns the bytes after seg[2]
+                                data = raw[:seg[2] - seg[1]]
+                                await loop.run_in_executor(
+                                    io_pool, os.pwrite, fd, data, seg[1])
+                                seg[1] += len(data)
+                                if len(data) < len(raw):
+                                    break  # server over-delivered; done
                     if seg[1] == before:
                         # a capped/empty 206 must still advance, else
                         # this loops forever against a broken origin

@@ -261,38 +261,64 @@ async def test_http_resumes_from_partial(tmp_path, broker, range_server):
     assert not (target_dir / "file.mkv.partial.meta").exists()
 
 
-async def test_http_splice_path_engaged_and_byte_identical(
-        tmp_path, broker, range_server, monkeypatch):
-    """The zero-copy splice landing (r5) actually runs for plain HTTP
-    with a known length, and produces byte-identical output to the
-    streaming fallback (HTTP_NO_SPLICE=1)."""
+@pytest.fixture
+def splice_probe(monkeypatch):
+    """Count _splice_body entries AND worker slices, so tests can prove
+    the fast path ran even when aiohttp had already buffered the whole
+    body (the head-drain then lands it without a worker slice)."""
     import downloader_tpu.stages.download as dl
 
-    base, payload, _requests = range_server
-    calls = {"slices": 0}
-    orig = dl._splice_slice_blocking
+    calls = {"slices": 0, "bodies": 0}
+    orig_slice = dl._splice_slice_blocking
 
-    def counting(*args, **kwargs):
+    def counting_slice(*args, **kwargs):
         calls["slices"] += 1
-        return orig(*args, **kwargs)
+        return orig_slice(*args, **kwargs)
 
-    monkeypatch.setattr(dl, "_splice_slice_blocking", counting)
+    orig_spliceable = dl._spliceable
+
+    def counting_spliceable(resp):
+        ok = orig_spliceable(resp)
+        if ok:
+            calls["bodies"] += 1
+        return ok
+
+    monkeypatch.setattr(dl, "_splice_slice_blocking", counting_slice)
+    monkeypatch.setattr(dl, "_spliceable", counting_spliceable)
+    return calls
+
+
+async def _run_splice_ab(tmp_path, broker, base, payload, splice_probe,
+                         monkeypatch, min_bodies):
+    """Shared A/B body: fast path engaged + byte-identical to the
+    HTTP_NO_SPLICE streaming fallback."""
+    import downloader_tpu.stages.download as dl
+
     stage = await make_stage(tmp_path, broker)
     await stage(make_job("HTTP", f"{base}/media/file.mkv"))
     spliced = (tmp_path / "downloads" / "job-1" / "file.mkv").read_bytes()
     assert spliced == payload
     if dl.SPLICE_OK:
-        assert calls["slices"] >= 1  # the fast path, not the fallback
+        assert splice_probe["bodies"] >= min_bodies
 
-    # same fetch with the kill switch: streaming loop, same bytes
     monkeypatch.setenv("HTTP_NO_SPLICE", "1")
-    calls["slices"] = 0
+    splice_probe["slices"] = splice_probe["bodies"] = 0
     stage2 = await make_stage(tmp_path, broker)
     await stage2(make_job("HTTP", f"{base}/media/file.mkv",
                           media_id="job-2"))
     plain = (tmp_path / "downloads" / "job-2" / "file.mkv").read_bytes()
     assert plain == payload
-    assert calls["slices"] == 0
+    assert splice_probe["slices"] == splice_probe["bodies"] == 0
+
+
+async def test_http_splice_path_engaged_and_byte_identical(
+        tmp_path, broker, range_server, splice_probe, monkeypatch):
+    """The zero-copy splice landing (r5) actually runs for plain HTTP
+    with a known length, and produces byte-identical output to the
+    streaming fallback (HTTP_NO_SPLICE=1)."""
+    base, payload, _requests = range_server
+    await _run_splice_ab(tmp_path, broker, base, payload, splice_probe,
+                         monkeypatch, min_bodies=1)
 
 
 async def test_http_resume_with_complete_partial(tmp_path, broker, range_server):
@@ -568,6 +594,17 @@ async def test_http_segmented_download(tmp_path, broker, range_server,
     assert set(requests[1:]) == expected
     # no stray working files
     assert sorted(p.name for p in target.parent.iterdir()) == ["file.mkv"]
+
+
+async def test_http_segmented_splice_engaged_and_byte_identical(
+        tmp_path, broker, range_server, small_segments, splice_probe,
+        monkeypatch):
+    """The segmented path lands ranges via positioned kernel splice
+    (r5): the fast path actually runs for every segment, and output
+    matches the streaming fallback byte-for-byte."""
+    base, payload, _requests = range_server
+    await _run_splice_ab(tmp_path, broker, base, payload, splice_probe,
+                         monkeypatch, min_bodies=4)
 
 
 async def test_http_segmented_resume_skips_done_bytes(
