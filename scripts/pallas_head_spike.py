@@ -1,0 +1,174 @@
+"""r5 spike: Pallas kernel for the s2d head (stride-2 4x4 conv 128->48).
+
+XLA's lowering of this conv re-reads the trunk output ~4x (input-
+bandwidth bound, ~13 ms of a ~67 ms step — BASELINE.md "The r5
+budget").  A Pallas kernel reads each input element once into VMEM and
+expresses the conv as 16 strided (1024,128)@(128,48) dots, targeting
+the ~5-6 ms single-read bound.
+
+Overlap handling without element-indexed BlockSpecs: each grid cell
+loads its own input block PLUS its right/bottom/corner neighbors
+(index maps clamp at the edge; the kernel masks the out-of-frame rows/
+cols to zero, which IS the SAME-padding semantics of the plain head).
+
+Run: python scripts/pallas_head_spike.py [check|race]
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+BM = 8    # output rows per block
+BN = 64   # output cols per block
+
+
+def _kernel(nh, nw, cin, cout, out_dtype,
+            x_ref, xr_ref, xb_ref, xc_ref, k_ref, b_ref, o_ref):
+    # the input is pre-padded with the SAME-conv zeros and rounded up to
+    # a block multiple, so neighbor reads never need masking
+    x = x_ref[0]                       # (2BM, 2BN, C)
+    right = xr_ref[0][:, :2, :]        # (2BM, 2, C)
+    bottom = xb_ref[0][:2, :, :]       # (2, 2BN, C)
+    corner = xc_ref[0][:2, :2, :]      # (2, 2, C)
+    top = jnp.concatenate([x, right], axis=1)          # (2BM, 2BN+2, C)
+    bot = jnp.concatenate([bottom, corner], axis=1)    # (2, 2BN+2, C)
+    xt = jnp.concatenate([top, bot], axis=0)           # (2BM+2, 2BN+2, C)
+    # stride-2 access via parity reshape: strided slices lower to
+    # (unsupported) gathers in Mosaic, unit-stride slices don't
+    xt4 = xt.reshape(BM + 1, 2, BN + 1, 2, cin)
+    acc = jnp.zeros((BM * BN, cout), jnp.float32)
+    for u in range(4):
+        for v in range(4):
+            p, du = u % 2, u // 2
+            q, dv = v % 2, v // 2
+            xs = xt4[du:du + BM, p, dv:dv + BN, q, :]
+            acc = acc + jnp.dot(
+                xs.reshape(BM * BN, cin), k_ref[u, v],
+                preferred_element_type=jnp.float32)
+    out = acc + b_ref[0].astype(jnp.float32)
+    o_ref[0] = out.reshape(BM, BN, cout).astype(out_dtype)
+
+
+def pallas_s2d_head(feats, k4, bias4, out_dtype=jnp.bfloat16):
+    """feats (B, H, W, C) -> (B, H/2, W/2, 4*C_head) like ops.s2d_head."""
+    b, h, w, cin = feats.shape
+    cout = k4.shape[-1]
+    h2, w2 = h // 2, w // 2
+    nh, nw = h2 // BM, w2 // BN
+    assert h2 % BM == 0 and w2 % BN == 0, (h2, w2)
+    grid = (b, nh, nw)
+    # SAME-padding zeros up front (+1 top/left), rounded up to a full
+    # extra block bottom/right so the clamped neighbor reads hit real
+    # zeros instead of needing in-kernel masks
+    feats = jnp.pad(feats, ((0, 0), (1, 2 * BM - 1), (1, 2 * BN - 1),
+                            (0, 0)))
+    nh_in = feats.shape[1] // (2 * BM)
+    nw_in = feats.shape[2] // (2 * BN)
+
+    def im_x(bi, i, j):
+        return (bi, i, j, 0)
+
+    def im_right(bi, i, j):
+        return (bi, i, jnp.minimum(j + 1, nw_in - 1), 0)
+
+    def im_bottom(bi, i, j):
+        return (bi, jnp.minimum(i + 1, nh_in - 1), j, 0)
+
+    def im_corner(bi, i, j):
+        return (bi, jnp.minimum(i + 1, nh_in - 1),
+                jnp.minimum(j + 1, nw_in - 1), 0)
+
+    block = (1, 2 * BM, 2 * BN, cin)
+    kern = functools.partial(_kernel, nh, nw, cin, cout, out_dtype)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(block, im_x),
+            pl.BlockSpec(block, im_right),
+            pl.BlockSpec(block, im_bottom),
+            pl.BlockSpec(block, im_corner),
+            pl.BlockSpec((4, 4, cin, cout), lambda bi, i, j: (0, 0, 0, 0)),
+            pl.BlockSpec((1, cout), lambda bi, i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BM, BN, cout),
+                               lambda bi, i, j: (bi, i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h2, w2, cout), out_dtype),
+    )(feats, feats, feats, feats, k4, bias4[None])
+
+
+def main():
+    from downloader_tpu.compute.ops.s2d_head import pack_s2d_kernel, s2d_head
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    mode = sys.argv[1] if len(sys.argv) > 1 else "check"
+    eng = FrameUpscaler(batch=8, use_mesh=False)
+    head = eng.params["params"]["subpixel"]
+    k4 = pack_s2d_kernel(head["kernel"]).astype(jnp.bfloat16)
+    bias4 = jnp.tile(head["bias"], 4).astype(jnp.bfloat16)
+    print("backend:", jax.default_backend(), flush=True)
+
+    if mode == "check":
+        rng = np.random.default_rng(0)
+        feats = jnp.asarray(
+            rng.standard_normal((2, 64, 256, 128)), jnp.bfloat16)
+        want = s2d_head(feats, head["kernel"], head["bias"])
+        got = pallas_s2d_head(feats, k4, bias4)
+        w32 = np.asarray(want, np.float32)
+        g32 = np.asarray(got, np.float32)
+        print("shapes:", want.shape, got.shape)
+        print("max |diff|:", float(np.abs(w32 - g32).max()))
+        print("exact frac:", float((w32 == g32).mean()))
+    elif mode == "race":
+        feats = jnp.asarray(
+            np.random.default_rng(0).standard_normal((8, 720, 1280, 128)),
+            jnp.bfloat16)
+
+        def run_xla(f):
+            return jnp.sum(s2d_head(f, head["kernel"], head["bias"])
+                           .astype(jnp.float32))
+
+        def run_pallas(f):
+            return jnp.sum(pallas_s2d_head(f, k4, bias4)
+                           .astype(jnp.float32))
+
+        def scan_runner(body, iters=20):
+            def rollout(f):
+                def step(s, _):
+                    # genuine dependence: the sum feeds the next input
+                    # scaled to ~0 so values stay finite — a *0 feedback
+                    # would let XLA elide the non-pallas variant
+                    total = body(f + s)
+                    return (total * 1e-30).astype(jnp.bfloat16), ()
+                final, _ = jax.lax.scan(
+                    step, jnp.bfloat16(0), None, length=iters)
+                return final
+            run = jax.jit(rollout)
+            jax.device_get(run(feats))
+            def timed():
+                t0 = time.monotonic()
+                jax.device_get(run(feats))
+                return (time.monotonic() - t0) / iters
+            return timed
+
+        variants = [("xla_head", scan_runner(run_xla)),
+                    ("pallas_head", scan_runner(run_pallas))]
+        best = {n: float("inf") for n, _ in variants}
+        for _ in range(4):
+            for n, t in variants:
+                best[n] = min(best[n], t())
+        for n, v in best.items():
+            print(f"{n}: {v*1000:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
