@@ -6,10 +6,12 @@ budget").  A Pallas kernel reads each input element once into VMEM and
 expresses the conv as 16 strided (1024,128)@(128,48) dots, targeting
 the ~5-6 ms single-read bound.
 
-Overlap handling without element-indexed BlockSpecs: each grid cell
-loads its own input block PLUS its right/bottom/corner neighbors
-(index maps clamp at the edge; the kernel masks the out-of-frame rows/
-cols to zero, which IS the SAME-padding semantics of the plain head).
+Overlap handling without element-indexed BlockSpecs: the input is
+pre-padded outside the kernel with the SAME-conv zeros (+1 top/left)
+and rounded up to a block multiple bottom/right, then each grid cell
+loads its own block PLUS its right/bottom/corner neighbors (index maps
+clamp at the edge, where the clamped reads hit real zero rows) — no
+in-kernel masking needed.
 
 Run: python scripts/pallas_head_spike.py [check|race]
 """
