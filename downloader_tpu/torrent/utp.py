@@ -502,6 +502,20 @@ class UtpConnection:
             # duplicate SYN (our ST_STATE got lost): re-ack it
             self._send_ack()
         self._flush()
+        if (self._closed and self._drain_timer is not None
+                and self._eof_seq is not None):
+            # this very datagram both completed our retire (its ack
+            # covered our FIN) and carried the peer's FIN: once the
+            # already-scheduled ack flushes (call_soon FIFO), the
+            # handshake is done — end the drain instead of holding the
+            # slot/socket for the linger (simultaneous-close case the
+            # closed-branch early-exit above cannot see).  The timer
+            # attr stays set until the deferred call so _flush_ack
+            # still treats the connection as drain-alive and sends the
+            # FIN's ack first.
+            self._drain_timer.cancel()
+            asyncio.get_running_loop().call_soon(
+                self._unregister_after_drain)
 
     def _flush_ack(self) -> None:
         self._ack_scheduled = False
@@ -560,7 +574,13 @@ class UtpConnection:
             if self._closing and not self._inflight and not self._send_q_len:
                 self._retire()
             return
-        if payload and self._eof_seq is None:
+        if payload and self._eof_seq is None and not self._closed:
+            # the _closed guard: a datagram can FIRST ack our FIN
+            # (retiring us, reader EOF'd) and ALSO carry in-order data
+            # — half-close with the peer still streaming; feeding a
+            # finished reader raises, killing the whole recv batch
+            # (review r5).  The data is discarded; the cumulative ack
+            # still flows from the drain path.
             self.reader.feed_data(payload)
 
     # -- ack / congestion path ------------------------------------------

@@ -1,6 +1,7 @@
 """Download-stage tests: protocol dispatch, http streaming, file gating,
 bucket fan-in (reference /root/reference/lib/download.js)."""
 
+import asyncio
 import os
 
 import pytest
@@ -319,6 +320,51 @@ async def test_http_splice_path_engaged_and_byte_identical(
     base, payload, _requests = range_server
     await _run_splice_ab(tmp_path, broker, base, payload, splice_probe,
                          monkeypatch, min_bodies=1)
+
+
+async def test_http_cancel_mid_splice_leaves_no_leaks_and_resumes(
+        tmp_path, broker):
+    """Cancelling (even twice, racing the cleanup join) mid-splice must
+    leak no fds, preserve the .partial for resume, and a retry must
+    finish byte-exact (the r5 splice path's cancellation contract)."""
+    import downloader_tpu.stages.download as dl
+
+    payload = os.urandom(6 << 20)
+
+    async def serve(req):
+        resp = web.StreamResponse(headers={
+            "ETag": '"x"', "Content-Length": str(len(payload))})
+        await resp.prepare(req)
+        for off in range(0, len(payload), 1 << 20):
+            await resp.write(payload[off:off + (1 << 20)])
+            await asyncio.sleep(0.03)  # drip: cancels land mid-body
+        return resp
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    stage = await make_stage(tmp_path, broker)
+    fds_before = len(os.listdir("/proc/self/fd"))
+    try:
+        for _ in range(3):
+            task = asyncio.create_task(
+                stage(make_job("HTTP", f"{base}/media/file.mkv")))
+            await asyncio.sleep(0.08)
+            task.cancel()
+            await asyncio.sleep(0.001)
+            task.cancel()  # double-cancel: the deferred-cleanup path
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        await asyncio.sleep(0.2)
+        leaked = len(os.listdir("/proc/self/fd")) - fds_before
+        assert leaked <= 4, f"fd leak after cancel storm: {leaked}"
+
+        # the partial survived for resume, and the retry completes
+        target_dir = tmp_path / "downloads" / "job-1"
+        if dl.SPLICE_OK:
+            assert (target_dir / "file.mkv.partial").exists()
+        await stage(make_job("HTTP", f"{base}/media/file.mkv"))
+        assert (target_dir / "file.mkv").read_bytes() == payload
+    finally:
+        await runner.cleanup()
 
 
 async def test_http_resume_with_complete_partial(tmp_path, broker, range_server):

@@ -232,6 +232,44 @@ async def test_utp_vs_tcp_ratio_floor():
     assert best >= 0.7, f"utp/tcp ratio {best:.3f} below the 0.7 floor"
 
 
+async def test_connection_churn_no_socket_accumulation():
+    """Short-lived connections must retire their sockets promptly: the
+    LAST_ACK drain window ends early once the peer's FIN completes the
+    handshake (r5 — without that, churn accumulates a dead socket per
+    close for the full linger, and before r5 every close stalled ~3 s
+    in FIN retransmits)."""
+    import time
+
+    async def handler(reader, writer):
+        data = await reader.read(65536)
+        writer.write(data)
+        await writer.drain()
+        writer.close()
+        await writer.wait_closed()
+
+    server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
+    try:
+        base = len(os.listdir("/proc/self/fd"))
+        t0 = time.monotonic()
+        async with asyncio.timeout(60):
+            for _ in range(30):
+                reader, writer = await open_utp_connection(
+                    *server.local_addr)
+                writer.write(b"x" * 4096)
+                await writer.drain()
+                await reader.read(4096)
+                writer.close()
+                await writer.wait_closed()
+        elapsed = time.monotonic() - t0
+        after = len(os.listdir("/proc/self/fd"))
+        assert after - base <= 2, f"socket accumulation: {after - base} fds"
+        assert len(server._conns) == 0
+        # pre-r5 the FIN stall was ~3 s per close; 30 must not crawl
+        assert elapsed < 30, f"close path stalling again ({elapsed:.1f}s)"
+    finally:
+        server.close()
+
+
 async def test_proactor_fallback_transport(monkeypatch):
     """Loops without ``add_reader`` (Windows' ProactorEventLoop) must
     fall back to asyncio's stock datagram transport instead of failing
