@@ -507,11 +507,20 @@ def test_upscale_stream_pipelines_io_and_compute():
     engine = FrameUpscaler(
         config=UpscalerConfig(features=16, depth=2), batch=4, use_mesh=False
     )
-    result = measure_overlap(engine)  # the bench runs the SAME harness
     # measured ~1.2 on this host (writes overlap too); 0.5 is the
-    # broken-pipelining alarm threshold with ample noise margin
-    assert result["overlap"] >= 0.5, result
-    assert result["pipelined_s"] <= result["serial_s"] * 0.85, result
+    # broken-pipelining alarm threshold with ample noise margin.  The
+    # drill is timing-sensitive, so a contended full-suite run can
+    # produce one bad sample — best-of-3 keeps the alarm property
+    # (broken pipelining fails ALL attempts) without the flake.
+    last = None
+    for _ in range(3):
+        result = measure_overlap(engine)  # the bench runs the SAME harness
+        last = result
+        if (result["overlap"] >= 0.5
+                and result["pipelined_s"] <= result["serial_s"] * 0.85):
+            break
+    assert last["overlap"] >= 0.5, last
+    assert last["pipelined_s"] <= last["serial_s"] * 0.85, last
 
 
 # -------------------------------------------------------------------- stage
