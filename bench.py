@@ -55,7 +55,23 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  compressed_pipeline_* numbers are not comparable to v5's; staging,
 #  compute, torrent, and overlap measurements are identical to v5 and
 #  vs_baseline's basis is unchanged.
-HARNESS_VERSION = 7
+# v8 (r6): two delivery fixes + one new workload, measurements otherwise
+#  identical to v7:
+#  - vs_baseline is now median(per-rep normalized cpu_s_per_gb) against
+#    the (median-basis) r3 freeze — v7 divided the freeze by the per-run
+#    FLOOR of the normalized reps, a median-vs-min asymmetry that
+#    systematically inflated the ratio (ADVICE r5).  The floor stays
+#    visible as cpu_s_per_gb_norm.
+#  - the final stdout line is a COMPACT headline (~15 keys, hard-capped
+#    under 1,500 chars so the driver's 2,000-char tail capture parses
+#    it); the full extra dict is emitted as its own earlier
+#    ``bench_extra_full`` line (VERDICT r5 item 1).
+#  - new fan-in workload: N same-content jobs through the
+#    content-addressed staging cache (store/cache.py) reporting
+#    cache_fanin_speedup (uncached wall / cached wall, one download
+#    amortized across all jobs) and cache_hit_mbps (warm single-job
+#    materialization rate).
+HARNESS_VERSION = 8
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -105,6 +121,11 @@ def calibration_probe() -> float:
 
 JOBS = int(os.environ.get("BENCH_JOBS", 8))
 MIB_PER_JOB = int(os.environ.get("BENCH_MIB_PER_JOB", 32))
+# fan-in workload: same-content jobs through the staging cache (>= 8 per
+# the acceptance bar: one download amortized across all of them; 16
+# default — deeper fan-in amortizes the single fetch further past the
+# per-job pipeline overhead the cache cannot remove)
+FANIN_JOBS = max(8, int(os.environ.get("BENCH_FANIN_JOBS", 16)))
 # single-core host: the loop is CPU-bound, so interleaving jobs only adds
 # scheduling overhead — prefetch=1 measured fastest (sweep: 1 > 4 > 3 > 2)
 PREFETCH = int(os.environ.get("BENCH_PREFETCH", 1))
@@ -236,11 +257,159 @@ async def bench_pipeline():
         "cpu_s_per_gb": round(cpu_s_per_gb, 3),
         "cpu_s_per_gb_best": round(min(cpu) / total_gb, 3),
         "cpu_s_per_gb_norm": round(min(per_rep_norm), 3),
+        # harness v8: the PRIMARY regression statistic — median of the
+        # per-rep normalized values, the same statistic the r3 freeze
+        # was recorded with (the v7 primary divided a median freeze by
+        # this list's MIN, inflating the ratio — ADVICE r5)
+        "cpu_s_per_gb_norm_median": round(
+            statistics.median(per_rep_norm), 3
+        ),
         "calibration_probe_cpu_s": round(probe, 4),
         "calibration_factor": round(calibration, 4),
         "jobs_per_min": JOBS / med * 60,
         "elapsed_s": med,
     }
+
+
+async def bench_cache_fanin() -> dict:
+    """Hot-content fan-in through the content-addressed staging cache.
+
+    ``FANIN_JOBS`` (>= 8) jobs for the SAME content run through the full
+    production graph four ways: a cold single job (the per-job network
+    floor), the fan-in batch WITHOUT the cache (the reference's
+    behavior: N full downloads), the fan-in batch WITH the cache (one
+    leader download, the rest coalesce/hit), and one warm job against
+    the filled cache (pure materialization rate).
+
+    - ``cache_fanin_speedup`` = uncached wall / cached wall — how much
+      of the N-fold redundancy the cache removes end-to-end.
+    - ``cache_hit_mbps`` = warm single-job staging rate; must beat
+      ``cache_cold_mbps`` (the network path it replaces).
+    The fixture asserts the cached batch + warm job performed exactly
+    ONE network GET in total — the bench fails loudly if the cache
+    silently stops deduplicating.
+    """
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import FilesystemObjectStore
+
+    size = MIB_PER_JOB << 20
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "media.mkv")
+    with open(path, "wb") as fh:
+        fh.write(os.urandom(size))
+    gets = [0]
+
+    async def serve(request):
+        # HEAD revalidation probes are free by design; only count body
+        # fetches (aiohttp routes HEAD through the GET handler)
+        if request.method == "GET":
+            gets[0] += 1
+        # FileResponse serves via sendfile AND carries the strong
+        # mtime/size ETag the cache keys on (RFC-7232 validator)
+        return web.FileResponse(path)
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    async def run_batch(tag: str, jobs: int, cache_dir: "str | None") -> float:
+        with tempfile.TemporaryDirectory() as work:
+            instance = {
+                "download_path": os.path.join(work, "dl"),
+                # fan-in admission: all jobs in flight together so
+                # same-content arrivals coalesce instead of queueing
+                "max_concurrent_jobs": jobs,
+            }
+            if cache_dir is not None:
+                instance["cache"] = {"path": cache_dir}
+            broker = InMemoryBroker()
+            orchestrator = Orchestrator(
+                config=ConfigNode({"instance": instance}),
+                mq=MemoryQueue(broker),
+                store=FilesystemObjectStore(os.path.join(work, "store")),
+                telemetry=Telemetry(MemoryQueue(broker)),
+                logger=NullLogger(),
+            )
+            await orchestrator.start()
+            started = time.monotonic()
+            for i in range(jobs):
+                msg = schemas.Download(
+                    media=schemas.Media(
+                        id=f"fanin-{tag}-{i}",
+                        creator_id=f"card-{i}",
+                        type=schemas.MediaType.Value("MOVIE"),
+                        source=schemas.SourceType.Value("HTTP"),
+                        source_uri=f"http://127.0.0.1:{port}/media.mkv",
+                    )
+                )
+                broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+            await broker.join(schemas.DOWNLOAD_QUEUE, timeout=600)
+            elapsed = time.monotonic() - started
+            converts = len(broker.published(schemas.CONVERT_QUEUE))
+            assert converts == jobs, f"{tag}: {converts}/{jobs} completed"
+            await orchestrator.shutdown(grace_seconds=5)
+        return elapsed
+
+    best: "dict | None" = None
+    try:
+        # interleaved rounds, best same-round ratio: cross-round ratios
+        # would mix host states, and wall clock on this shared host
+        # swings ±20% (the same de-noising the torrent bench uses)
+        for rep in range(int(os.environ.get("BENCH_FANIN_REPS", 3))):
+            cache_dir = os.path.join(tmp, f"cache-{rep}")  # fresh: the
+            # cached batch must include the ONE real fill, not be all-hit
+            cold_s = await run_batch(f"cold{rep}", 1, None)
+            uncached_s = await run_batch(f"raw{rep}", FANIN_JOBS, None)
+            gets_before = gets[0]
+            cached_s = await run_batch(f"cached{rep}", FANIN_JOBS, cache_dir)
+            warm_s = await run_batch(f"warm{rep}", 1, cache_dir)
+            fetches = gets[0] - gets_before
+            assert fetches == 1, (
+                f"cache fan-in made {fetches} network fetches, expected 1"
+            )
+            mb = size / 1e6
+            round_out = {
+                "cache_fanin_speedup": round(uncached_s / cached_s, 2),
+                "cache_hit_mbps": round(mb / warm_s, 1),
+                "cache_cold_mbps": round(mb / cold_s, 1),
+                "cache_fanin_jobs": FANIN_JOBS,
+                "cache_fanin_uncached_s": round(uncached_s, 3),
+                "cache_fanin_cached_s": round(cached_s, 3),
+                "cache_fanin_fetches": fetches,
+            }
+            if (best is None
+                    or round_out["cache_fanin_speedup"]
+                    > best["cache_fanin_speedup"]):
+                best = round_out
+    finally:
+        await runner.cleanup()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    # BENCH_FANIN_REPS<=0 leaves best=None; the safe wrapper passes
+    # dicts through verbatim, so never return a **-unmergeable None
+    return best or {"cache_fanin_error": "no fan-in reps ran"}
+
+
+def _bench_cache_fanin_safe() -> dict:
+    """A cache-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_cache_fanin())
+    except Exception as err:
+        return {"cache_fanin_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 _COMPUTE_SNIPPET = """
@@ -862,6 +1031,47 @@ def _bench_torrent_safe() -> dict:
         return {"torrent_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+# Final-line headline keys, in keep-priority order (first = kept
+# longest under the size cap).  ~15 keys: the driver's 2,000-char tail
+# capture must always see the full final line (VERDICT r5 item 1);
+# everything else rides the earlier ``bench_extra_full`` line.
+HEADLINE_KEYS = [
+    "harness_version",
+    "cpu_s_per_gb_norm_median",   # the vs_baseline basis, shown raw
+    "cpu_s_per_gb_norm",
+    "cpu_s_per_gb",
+    "vs_baseline_raw",
+    "mbps_best",
+    "calibration_factor",
+    "cache_fanin_speedup",        # r6 fan-in cache bar: >= 3.0
+    "cache_hit_mbps",             # must beat cache_cold_mbps
+    "cache_cold_mbps",
+    "cache_fanin_jobs",
+    "cache_fanin_error",          # present only on failure — visible
+    "utp_vs_tcp",
+    "mfu",
+    "mfu_1080p",
+    "upscale_pipeline_overlap",
+    "mbps_vs_v2_freeze",
+]
+
+FINAL_LINE_MAX_CHARS = 1500
+
+
+def compact_final_line(metric: dict, extra: dict) -> str:
+    """The driver-parsed last stdout line: headline keys only, dropped
+    from the back until the line fits the hard cap."""
+    keep = [k for k in HEADLINE_KEYS if k in extra]
+    while True:
+        line = json.dumps(
+            {**metric, "extra": {k: extra[k] for k in keep}},
+            separators=(",", ":"),
+        )
+        if len(line) <= FINAL_LINE_MAX_CHARS or not keep:
+            return line
+        keep.pop()
+
+
 def main() -> None:
     pipeline = asyncio.run(bench_pipeline())
     extra = {
@@ -872,12 +1082,14 @@ def main() -> None:
         "cpu_s_per_gb": pipeline["cpu_s_per_gb"],
         "cpu_s_per_gb_best": pipeline["cpu_s_per_gb_best"],
         "cpu_s_per_gb_norm": pipeline["cpu_s_per_gb_norm"],
+        "cpu_s_per_gb_norm_median": pipeline["cpu_s_per_gb_norm_median"],
         "calibration_probe_cpu_s": pipeline["calibration_probe_cpu_s"],
         "calibration_factor": pipeline["calibration_factor"],
         "jobs_per_min": round(pipeline["jobs_per_min"], 1),
         "elapsed_s": round(pipeline["elapsed_s"], 3),
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
+        **_bench_cache_fanin_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),
         **bench_upscale_pipeline(),
@@ -914,13 +1126,16 @@ def main() -> None:
             "upscale_pipeline_link_required_mbps)"
         )
     # value = MEDIAN MB/s over reps (human-readable headline);
-    # vs_baseline (v5) = frozen cpu_s_per_gb / measured — the
-    # noise-immune regression axis (cycles per byte don't depend on how
-    # much the neighbors steal of the shared core).  The legacy
-    # wall-clock ratio stays visible as mbps_vs_v2_freeze.
+    # vs_baseline (v8) = frozen cpu_s_per_gb / MEDIAN of the per-rep
+    # probe-normalized values — median against median, the same
+    # statistic on both sides of the ratio (v7 divided the median-basis
+    # freeze by the per-run floor, which systematically inflated it —
+    # ADVICE r5).  The floor stays in extra as cpu_s_per_gb_norm; the
+    # legacy wall-clock ratio stays visible as mbps_vs_v2_freeze.
     extra["baseline_basis"] = (
-        f"cpu_s_per_gb_norm (in-run probe-calibrated, harness v7) vs "
-        f"{SELF_BASELINE_CPU_S_PER_GB} r3 freeze; raw alongside"
+        f"cpu_s_per_gb_norm_median (in-run probe-calibrated, harness "
+        f"v8, median-vs-median) vs {SELF_BASELINE_CPU_S_PER_GB} r3 "
+        f"freeze; raw + floor alongside"
     )
     extra["mbps_vs_v2_freeze"] = round(
         pipeline["mbps_best"] / SELF_BASELINE_MBPS, 3
@@ -929,20 +1144,19 @@ def main() -> None:
         SELF_BASELINE_CPU_S_PER_GB / pipeline["cpu_s_per_gb"], 3
     )
     value = round(pipeline["mbps"], 1)
-    print(
-        json.dumps(
-            {
-                "metric": "pipeline_staging_throughput",
-                "value": value,
-                "unit": "MB/s",
-                "vs_baseline": round(
-                    SELF_BASELINE_CPU_S_PER_GB
-                    / pipeline["cpu_s_per_gb_norm"], 3
-                ),
-                "extra": extra,
-            }
-        )
-    )
+    metric = {
+        "metric": "pipeline_staging_throughput",
+        "value": value,
+        "unit": "MB/s",
+        "vs_baseline": round(
+            SELF_BASELINE_CPU_S_PER_GB
+            / pipeline["cpu_s_per_gb_norm_median"], 3
+        ),
+    }
+    # the FULL detail dict gets its own line (and never truncates the
+    # driver's tail capture); the FINAL line is the compact contract
+    print(json.dumps({"bench_extra_full": extra}))
+    print(compact_final_line(metric, extra))
 
 
 if __name__ == "__main__":

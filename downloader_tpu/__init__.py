@@ -24,3 +24,10 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# backport asyncio pieces the codebase relies on when the runtime is
+# older than the 3.11 target (no-op otherwise) — see utils/compat.py
+from .utils.compat import install as _install_compat
+
+_install_compat()
+del _install_compat

@@ -34,7 +34,7 @@ class _Message:
 
 
 class _MemoryDelivery(Delivery):
-    __slots__ = ("_msg", "_broker", "_queue", "_settled", "_sem")
+    __slots__ = ("_msg", "_broker", "_queue", "_settled", "_sem", "_headers")
 
     def __init__(self, msg: _Message, broker: "InMemoryBroker", queue: str,
                  sem: asyncio.Semaphore):
@@ -43,6 +43,10 @@ class _MemoryDelivery(Delivery):
         self._queue = queue
         self._settled = False
         self._sem = sem
+        # per-DELIVERY copy: the AMQP backend re-decodes headers from the
+        # wire for every delivery, so a handler mutating its delivery's
+        # headers must see a fresh dict again on redelivery (advisor r5)
+        self._headers = dict(msg.headers)
 
     @property
     def body(self) -> bytes:
@@ -54,7 +58,7 @@ class _MemoryDelivery(Delivery):
 
     @property
     def headers(self) -> dict:
-        return self._msg.headers
+        return self._headers
 
     def _settle(self) -> bool:
         if self._settled:

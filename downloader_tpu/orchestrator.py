@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import asyncio
 import datetime
+import os
 import time
 from typing import Dict, List, Optional
 
 from . import schemas
 from .mq.base import Delivery, MessageQueue
+from .platform.config import cfg_get
 from .platform.logging import Logger, get_logger
 from .platform.metrics import Metrics
 from .platform.telemetry import NullTelemetry, Telemetry
@@ -40,6 +42,7 @@ from .platform.tracing import (NullTracer, Tracer, format_traceparent,
 from .stages.base import STAGES, Job, StageContext, load_stages
 from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
+from .store.cache import ContentCache
 from .utils import EventEmitter
 
 
@@ -62,8 +65,10 @@ class Orchestrator:
         tracer: Optional[Tracer] = None,
         logger: Optional[Logger] = None,
         stages: Optional[List[str]] = None,
-        prefetch: int = 2,
+        prefetch: Optional[int] = None,
         poison_threshold: int = 5,
+        cache: Optional[ContentCache] = None,
+        admission_timeout: float = 30.0,
     ):
         self.config = config
         self.mq = mq
@@ -73,15 +78,45 @@ class Orchestrator:
         self.tracer = tracer or NullTracer()
         self.logger = logger or get_logger("orchestrator")
         self.stage_names = stages or list(STAGES)
-        # Default 2 resolves BASELINE.md's ``new AMQP(addr, 1, 2, prom)``
-        # question (lib/main.js:46): triton-core's AMQP signature is
-        # (host, connections, prefetch, prom) — one connection (we likewise
-        # hold one job connection; telemetry rides its own, app.py), and a
-        # consumer prefetch of 2: up to two deliveries in flight, processed
-        # CONCURRENTLY (both backends dispatch one handler task per
-        # delivery), matching the reference's async consumer behavior under
-        # the same qos.  See PARITY.md "AMQP constructor constants".
+        # Consumer prefetch = max concurrently-processed jobs, now
+        # configurable (MAX_CONCURRENT_JOBS / instance.max_concurrent_jobs)
+        # instead of hardcoded.  The default of 2 resolves BASELINE.md's
+        # ``new AMQP(addr, 1, 2, prom)`` question (lib/main.js:46):
+        # triton-core's AMQP signature is (host, connections, prefetch,
+        # prom) — one connection (we likewise hold one job connection;
+        # telemetry rides its own, app.py), and a consumer prefetch of 2:
+        # up to two deliveries in flight, processed CONCURRENTLY (both
+        # backends dispatch one handler task per delivery), matching the
+        # reference's async consumer behavior under the same qos.  See
+        # PARITY.md "AMQP constructor constants".  Fan-in deployments
+        # raise it: with the content cache, same-content jobs coalesce
+        # onto one fetch, so extra in-flight jobs are nearly free.
+        if prefetch is None:
+            raw = os.environ.get("MAX_CONCURRENT_JOBS") or cfg_get(
+                config, "instance.max_concurrent_jobs", 2
+            )
+            try:
+                prefetch = int(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"max_concurrent_jobs must be an integer, got {raw!r}"
+                ) from None
+        if prefetch < 1:
+            raise ValueError(f"max_concurrent_jobs must be >= 1, got {prefetch}")
         self.prefetch = prefetch
+
+        # content-addressed staging cache (store/cache.py): shared with
+        # the download stage via stage_resources, consulted by the
+        # admission gate below.  None = disabled (the config default).
+        self.cache = cache if cache is not None else ContentCache.from_config(
+            config, logger=self.logger
+        )
+        if self.cache is not None and metrics is not None:
+            self.cache.metrics = metrics
+        # how long admission may hold a job waiting for cache-volume disk
+        # headroom before letting it proceed (the download stage's own
+        # ensure_disk_space preflight still fails loudly if truly full)
+        self.admission_timeout = admission_timeout
 
         # (reference EmitterTable / activeJobs, lib/main.js:26,34)
         self.emitter_table: Dict[str, EventEmitter] = {}
@@ -92,6 +127,9 @@ class Orchestrator:
         # teardown callables, run once at shutdown
         self.stage_resources: dict = {}
         self.stage_cleanups: list = []
+        # the download stage probes/fills the same cache instance the
+        # admission gate watches (None = disabled; the stage respects it)
+        self.stage_resources["content_cache"] = self.cache
 
         # poison-job guard: the reference nacks failed jobs forever
         # (lib/main.js:148-150), which on RabbitMQ without a dead-letter
@@ -168,6 +206,14 @@ class Orchestrator:
         job_entry = {"cardId": file_id, "jobId": job_id}
         child = self.logger.child(jobId=job_id, fileId=file_id)
 
+        # admission control: a new job only starts once the cache volume
+        # has its configured disk headroom — LRU entries are evicted to
+        # make room, and if nothing is evictable the job waits (bounded)
+        # for in-flight work to free space.  The delivery stays unsettled
+        # while we wait, so the broker's prefetch window provides the
+        # backpressure.
+        await self._admit_job(child)
+
         # all bookkeeping after this point is undone in the finally, so a
         # failure anywhere (even in the status emit) can't leak the gauge or
         # the active-jobs entry
@@ -201,6 +247,40 @@ class Orchestrator:
             self.emitter_table.pop(job_id, None)
             if self.metrics is not None:
                 self.metrics.jobs_active.dec()
+
+    async def _admit_job(self, logger: Logger) -> None:
+        """Gate job start on cache-volume disk headroom.
+
+        No cache -> no gate (the download stage's ensure_disk_space
+        preflight is then the only guard, as before).  With a cache, the
+        order is: evict LRU entries first (cached bytes are the one
+        reclaimable resource), then wait for running jobs to release
+        space, then — after ``admission_timeout`` — proceed anyway and
+        let the preflight make the loud per-job call.
+        """
+        if self.cache is None:
+            return
+        deadline = time.monotonic() + self.admission_timeout
+        warned = False
+        while not await asyncio.to_thread(self.cache.has_headroom):
+            evicted = await self.cache.evict_to_budget()
+            if evicted:
+                continue  # re-check headroom after the reclaim
+            if time.monotonic() >= deadline:
+                logger.warn(
+                    "admitting job without cache disk headroom",
+                    free_bytes=self.cache.free_disk_bytes(),
+                    min_free_bytes=self.cache.min_free_bytes,
+                )
+                return
+            if not warned:
+                warned = True
+                logger.warn(
+                    "job admission waiting for cache disk headroom",
+                    free_bytes=self.cache.free_disk_bytes(),
+                    min_free_bytes=self.cache.min_free_bytes,
+                )
+            await asyncio.sleep(0.25)
 
     async def _run_job(
         self,

@@ -25,6 +25,19 @@ DEFAULTS: dict = {
         # matching the reference's relative-path fixup
         # (/root/reference/lib/download.js:234-240).
         "download_path": "downloading",
+        # Max concurrently-processed jobs (the MQ consumer prefetch).  2
+        # is the reference's qos (PARITY.md "AMQP constructor constants");
+        # raise it for fan-in traffic where the content cache makes extra
+        # in-flight jobs cheap.  Env: MAX_CONCURRENT_JOBS.
+        "max_concurrent_jobs": 2,
+        # Content-addressed staging cache (store/cache.py).  Disabled
+        # unless ``cache.enabled`` is true or ``cache.path`` is set
+        # (CACHE_ENABLED / CACHE_DIR).  ``cache.max_bytes`` caps the LRU
+        # disk budget (CACHE_MAX_BYTES); ``cache.min_free_bytes`` is the
+        # free-disk floor job admission maintains on the cache volume
+        # (CACHE_MIN_FREE_BYTES).
+        # "cache": {"enabled": True, "path": "...", "max_bytes": ...,
+        #           "min_free_bytes": ...},
     },
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),

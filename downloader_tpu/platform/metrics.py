@@ -98,6 +98,33 @@ class Metrics:
             "effect of the encode back-end",
             registry=self.registry,
         )
+        self.cache_hits = Counter(
+            f"{ns}_cache_hits_total",
+            "Download jobs served from the content-addressed staging cache",
+            registry=self.registry,
+        )
+        self.cache_misses = Counter(
+            f"{ns}_cache_misses_total",
+            "Cacheable downloads that had to fetch from the network",
+            registry=self.registry,
+        )
+        self.cache_coalesced = Counter(
+            f"{ns}_cache_coalesced_waiters_total",
+            "Jobs that awaited another job's in-flight fetch of the same "
+            "content (singleflight fan-in)",
+            registry=self.registry,
+        )
+        self.cache_bytes_saved = Counter(
+            f"{ns}_cache_bytes_saved_total",
+            "Bytes served from cache or coalesced fetches instead of "
+            "re-downloaded over the network",
+            registry=self.registry,
+        )
+        self.cache_evicted_bytes = Counter(
+            f"{ns}_cache_evicted_bytes_total",
+            "Bytes LRU-evicted from the staging cache",
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",

@@ -36,6 +36,10 @@ class Job:
 
     media: schemas.Media
     last_stage: Any = None
+    # set by the download stage while this job LEADS a singleflight fetch
+    # (store/cache.py): a ``report(percent)`` callable whose updates are
+    # re-emitted through each coalesced waiter's own telemetry
+    cache_report: Any = None
 
 
 @dataclasses.dataclass

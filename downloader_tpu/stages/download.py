@@ -37,6 +37,7 @@ import zlib
 import aiohttp
 
 from .. import schemas
+from ..store.cache import ContentCache, Singleflight, cache_key
 from ..utils.disk import ensure_disk_space as _ensure_disk_space
 from ..utils.watchdog import STALL_TIMEOUT_SECONDS, StallWatchdog
 from .base import Job, StageContext, StageFn
@@ -68,21 +69,34 @@ _SPLICE_PIPE_SIZE = 1 << 20
 # moves amortize the ~200 us/syscall kernel cost (A/B measured ~10-15%
 # off the cpu_s_per_gb floor).  An EXPLICIT SO_RCVBUF permanently
 # disables TCP receive autotuning and silently clamps at rmem_max, so
-# on default-tuned hosts (rmem_max ~208 KiB, autotuning can reach
-# tcp_rmem[2] ~6 MB) setting it would SHRINK the effective window and
-# wreck high-BDP throughput (review r5) — only grow when the host's
-# limit makes the locked buffer genuinely large.
+# the grow is only safe when the locked window — min(request, rmem_max)
+# — is at least what autotuning itself could have reached, which is
+# tcp_rmem[2] (independent of rmem_max; default ~6 MB).  Gating on
+# rmem_max alone (pre-r6) still shrank the window on hosts with
+# rmem_max between 1 MiB and tcp_rmem[2] (advisor r5).
 _SPLICE_RCVBUF = 8 << 20
-_SPLICE_RCVBUF_MIN_RMEM_MAX = 1 << 20
+
+
+def _read_proc_int(path: str, field: int = 0) -> "int | None":
+    try:
+        with open(path) as fh:
+            return int(fh.read().split()[field])
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 @functools.lru_cache(maxsize=1)
 def _rcvbuf_grow_ok() -> bool:
-    try:
-        with open("/proc/sys/net/core/rmem_max") as fh:
-            return int(fh.read()) >= _SPLICE_RCVBUF_MIN_RMEM_MAX
-    except (OSError, ValueError):
+    rmem_max = _read_proc_int("/proc/sys/net/core/rmem_max")
+    if rmem_max is None:
         return False
+    autotune_ceiling = _read_proc_int("/proc/sys/net/ipv4/tcp_rmem", 2)
+    if autotune_ceiling is None:
+        # can't see the autotuning ceiling: only grow when the locked
+        # buffer honors the full request (never a shrink vs any ceiling
+        # the kernel default could plausibly reach)
+        return rmem_max >= _SPLICE_RCVBUF
+    return min(_SPLICE_RCVBUF, rmem_max) >= autotune_ceiling
 
 # Segmented HTTP: entities smaller than this aren't worth the extra
 # connections (segment setup costs more than the parallelism returns)
@@ -405,6 +419,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             if percent != last_emitted[0]:
                 last_emitted[0] = percent
                 await telemetry.emit_progress(file_id, downloading, percent)
+                # coalesced same-content jobs ride this fetch: re-broadcast
+                # so each waiter re-emits through its own telemetry
+                report = getattr(job, "cache_report", None)
+                if report is not None:
+                    report(percent)
 
         stats: dict = {}
         await client.download(
@@ -568,6 +587,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             out_dup = os.dup(out_fd)
             total = 0
             resp_left = resp.content_length - len(head)
+            if resp_left < 0 and strict:
+                # server closed early AND aiohttp buffered the truncated
+                # body past content_length's promise — without this the
+                # loop below is skipped (remaining <= 0) and a short
+                # total returns silently, unlike the unbuffered path
+                # which raises (advisor r5).  Close before raising: body
+                # bytes are unaccounted, the connection can't be pooled.
+                resp.close()
+                os.close(out_dup)
+                raise aiohttp.ClientPayloadError(
+                    f"response over-delivered: buffered {len(head)} bytes "
+                    f"against content-length {resp.content_length}")
             cap = (limit if limit is not None
                    else len(head) + max(resp_left, 0))
             pipe_r, pipe_w = os.pipe()
@@ -1129,6 +1160,171 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
     methods = {"torrent": torrent, "http": http, "file": file, "bucket": bucket}
 
+    # -- content-addressed staging cache + singleflight -----------------
+    # Shared across every job via ctx.resources: the orchestrator injects
+    # its instance (possibly None = disabled); standalone stage use (tests,
+    # one-shot CLI) builds one from config on first touch.  N same-content
+    # jobs — concurrent or sequential — pay for at most one download.
+    if "content_cache" not in ctx.resources:
+        ctx.resources["content_cache"] = ContentCache.from_config(
+            ctx.config, logger=logger
+        )
+    cache: "ContentCache | None" = ctx.resources["content_cache"]
+    flights: Singleflight = ctx.resources.setdefault(
+        "cache_singleflight", Singleflight()
+    )
+
+    def _probe_session() -> aiohttp.ClientSession:
+        """One shared keep-alive session for HEAD revalidation probes:
+        under fan-in every job probes the same origin, so per-probe
+        session/connection setup is pure per-job overhead.  Memoized
+        across jobs in ctx.resources; closed at orchestrator shutdown."""
+        session = ctx.resources.get("cache_probe_session")
+        if session is None or session.closed:
+            session = aiohttp.ClientSession(trust_env=True)
+            ctx.resources["cache_probe_session"] = session
+
+            async def _close(session=session) -> None:
+                await session.close()
+
+            ctx.cleanups.append(_close)
+        return session
+
+    async def cache_identity(protocol: str, url: str) -> "str | None":
+        """Content key for this source; None = not cacheable.
+
+        - torrent magnets: the infohash IS the content address (and the
+          client verifies every piece against it before the fill).
+        - http: URL + strong RFC-7232 validator from a HEAD probe
+          (``choose_validator``'s strict rules) — no validator means no
+          way to prove two fetches returned the same entity, so no
+          caching.  ``.torrent`` URLs chain to the torrent method and are
+          keyed there only via magnets.
+        - bucket: endpoint + bucket + subFolder + the job's credentials
+          (hashed): only jobs presenting the same credentials share an
+          entry, so a cache hit never hands out bytes the job couldn't
+          have fetched itself.  Object stores feeding this pipeline
+          publish immutable media, the same assumption the idempotency
+          marker already makes.
+        - file: local copies are already cheap; never cached.
+        """
+        if cache is None:
+            return None
+        if protocol == "torrent" and url.startswith("magnet:"):
+            try:
+                from ..torrent.magnet import parse_magnet
+
+                return cache_key("torrent", parse_magnet(url).info_hash_hex)
+            except ValueError:
+                return None
+        if protocol == "http":
+            parsed = urllib.parse.urlparse(url)
+            if posixpath.splitext(parsed.path)[1] == ".torrent":
+                return None
+            try:
+                session = _probe_session()
+                async with session.head(
+                    url, allow_redirects=True,
+                    headers={"Accept-Encoding": "identity"},
+                    # a black-holed origin must cost seconds, not the
+                    # session's 5-minute default, before the real fetch
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    if resp.status != 200:
+                        return None
+                    validator = choose_validator(resp.headers)
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return None  # probe trouble never blocks the real fetch
+            if not validator:
+                return None
+            return cache_key("http", url, validator)
+        if protocol == "bucket":
+            try:
+                params = parse_bucket_uri(url)
+            except ValueError:
+                return None
+            # credentials ARE part of the key identity: a job may only
+            # hit entries filled under the same credentials, so a job
+            # whose keys were revoked (or wrong) can never be served
+            # bytes it couldn't fetch itself (key material is hashed,
+            # so secrets never appear on disk)
+            return cache_key("bucket", params["endpoint"], params["bucket"],
+                             params["sub_folder"], params["access_key"],
+                             params["secret_key"])
+        return None
+
+    async def materialize_hit(key: str, download_path: str,
+                              *, coalesced: bool) -> bool:
+        """Serve the job from the cache; False = miss (or entry lost)."""
+        entry = await cache.lookup(key)
+        if entry is None:
+            return False
+        with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
+            got = await cache.materialize(key, download_path)
+            span.set_tag("outcome",
+                         "lost" if got is None
+                         else ("coalesced" if coalesced else "hit"))
+        if got is None:
+            return False  # evicted between lookup and link: treat as miss
+        if ctx.metrics is not None:
+            if not coalesced:
+                ctx.metrics.cache_hits.inc()
+            ctx.metrics.cache_bytes_saved.inc(got)
+        logger.info("download served from staging cache",
+                    key=key[:16], bytes=got, coalesced=coalesced)
+        return True
+
+    async def cached_download(key: str, method, url: str, file_id: str,
+                              download_path: str, job: Job) -> None:
+        """Probe -> singleflight -> fetch -> fill, for a cacheable key."""
+        # warm path: no network at all (acceptance: a warm-cache job
+        # never re-fetches — only the HEAD revalidation above ran)
+        if await materialize_hit(key, download_path, coalesced=False):
+            return
+
+        async def leader_fetch(report) -> None:
+            # re-probe under the flight: a previous leader may have
+            # filled the key while this job queued for leadership
+            if await materialize_hit(key, download_path, coalesced=False):
+                return
+            if ctx.metrics is not None:
+                ctx.metrics.cache_misses.inc()
+            with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
+                span.set_tag("outcome", "miss")
+            job.cache_report = report  # torrent progress feeds waiters
+            try:
+                report(0)
+                await method(url, file_id, download_path, job)
+                report(50)
+            finally:
+                job.cache_report = None
+            # fill AFTER the fetch completed (torrent pieces are SHA-1
+            # verified by the client; http promoted its .partial only on
+            # a complete body) — a failed fetch raises before this, so a
+            # partial workdir is never inserted.  A fill failure (disk)
+            # must not fail a job that already has its bytes.
+            try:
+                await cache.insert(key, download_path)
+            except OSError as err:
+                logger.warn("cache fill failed", error=str(err))
+
+        async def waiter_progress(percent: int) -> None:
+            await telemetry.emit_progress(file_id, downloading, percent)
+
+        led = await flights.run(key, leader_fetch,
+                                on_wait_progress=waiter_progress)
+        if not led:
+            # coalesced onto another job's fetch; take the bytes from the
+            # cache it just filled
+            if ctx.metrics is not None:
+                ctx.metrics.cache_coalesced.inc()
+            if not await materialize_hit(key, download_path, coalesced=True):
+                # leader succeeded but its fill wasn't usable (nothing
+                # cacheable, fill error, instant eviction): fetch alone
+                logger.warn("coalesced fetch left no cache entry; "
+                            "falling back to own download", key=key[:16])
+                await method(url, file_id, download_path, job)
+
     async def download(job: Job):
         media = job.media
         file_id = media.id
@@ -1156,7 +1352,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         with ctx.tracer.span("stage.download", protocol=protocol, mediaId=file_id):
             try:
-                await method(url, file_id, download_path, job)
+                key = await cache_identity(protocol.lower(), url)
+                if key is None:
+                    await method(url, file_id, download_path, job)
+                else:
+                    await cached_download(
+                        key, method, url, file_id, download_path, job
+                    )
             except Exception as err:
                 logger.error("Download error", error=str(err))
                 raise

@@ -10,6 +10,7 @@ implementations.
 """
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
+from .cache import ContentCache, Singleflight, cache_key
 from .fs import FilesystemObjectStore
 from .memory import InMemoryObjectStore
 
@@ -17,6 +18,9 @@ __all__ = [
     "ObjectInfo",
     "ObjectNotFound",
     "ObjectStore",
+    "ContentCache",
+    "Singleflight",
+    "cache_key",
     "FilesystemObjectStore",
     "InMemoryObjectStore",
 ]

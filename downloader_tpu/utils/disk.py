@@ -34,6 +34,15 @@ def allocated_bytes(path: str) -> int:
     return min(blocks * 512, st.st_size)
 
 
+def free_bytes(dirpath: str) -> int:
+    """Free bytes on ``dirpath``'s volume; 0 when the path is unstatable
+    (callers treat that as "no headroom" rather than crashing)."""
+    try:
+        return shutil.disk_usage(dirpath).free
+    except OSError:
+        return 0
+
+
 def ensure_disk_space(dirpath: str, needed: int) -> None:
     """Raise :class:`InsufficientDiskSpace` unless ``dirpath``'s volume
     has ``needed`` bytes free."""

@@ -1,0 +1,504 @@
+"""Content-addressed staging cache + singleflight coalescing
+(``store/cache.py``) and its wiring through the download stage and the
+orchestrator's admission gate.
+
+Hermetic throughout: fetch-counting aiohttp fixtures (the acceptance
+bar: a warm-cache job must make ZERO network GETs), the in-memory
+broker/store fakes, and fault injection by tampering with the cache's
+on-disk layout directly.
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+from helpers import start_http_server
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
+from downloader_tpu.stages.base import Job, StageContext
+from downloader_tpu.stages.download import stage_factory
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store.cache import (ContentCache, META_NAME, Singleflight,
+                                        cache_key)
+from downloader_tpu.utils import EventEmitter
+
+pytestmark = pytest.mark.anyio
+
+PAYLOAD = b"C" * (256 << 10)
+
+
+# ---------------------------------------------------------------------------
+# ContentCache unit behavior
+# ---------------------------------------------------------------------------
+
+def _write_src(tmp_path, name="media.mkv", data=PAYLOAD):
+    src = tmp_path / "src"
+    src.mkdir(exist_ok=True)
+    (src / name).write_bytes(data)
+    return str(src)
+
+
+async def test_cache_roundtrip_and_transient_exclusion(tmp_path):
+    cache = ContentCache(str(tmp_path / "cache"), min_free_bytes=0)
+    src = _write_src(tmp_path)
+    # in-flight artifacts and dotfile sidecars must never be cached
+    for junk in (".dt-resume", "media.mkv.partial", "media.mkv.partial.meta",
+                 "media.mkv.partial-seg.state"):
+        with open(os.path.join(src, junk), "w") as fh:
+            fh.write("junk")
+
+    key = cache_key("http", "http://x/media.mkv", '"v1"')
+    assert await cache.lookup(key) is None  # miss
+    entry = await cache.insert(key, src)
+    assert entry is not None
+    assert entry.files == ["media.mkv"]
+    assert entry.size == len(PAYLOAD)
+
+    dest = tmp_path / "job"
+    dest.mkdir()
+    got = await cache.materialize(key, str(dest))
+    assert got == len(PAYLOAD)
+    assert (dest / "media.mkv").read_bytes() == PAYLOAD
+    # hardlink (same volume): O(1) materialization, shared inode
+    assert os.stat(dest / "media.mkv").st_ino == os.stat(
+        os.path.join(cache.entries_dir, key, "media.mkv")).st_ino
+
+
+async def test_cache_lru_eviction_respects_recency_and_budget(tmp_path):
+    size = 1 << 10
+    cache = ContentCache(str(tmp_path / "cache"), max_bytes=2 * size,
+                         min_free_bytes=0)
+    keys = [cache_key("k", str(i)) for i in range(3)]
+    now = 1_700_000_000.0
+    for i, key in enumerate(keys[:2]):
+        await cache.insert(key, _write_src(tmp_path, data=b"x" * size))
+        # deterministic LRU clock (utime granularity beats the test pace)
+        os.utime(os.path.join(cache.entries_dir, key, META_NAME),
+                 (now + i, now + i))
+    # touching entry 0 makes entry 1 the LRU victim
+    assert await cache.lookup(keys[0]) is not None
+    os.utime(os.path.join(cache.entries_dir, keys[0], META_NAME),
+             (now + 10, now + 10))
+
+    await cache.insert(keys[2], _write_src(tmp_path, data=b"x" * size))
+    # budget is 2 entries: the least-recently-used (keys[1]) was evicted
+    assert await cache.lookup(keys[1]) is None
+    assert await cache.lookup(keys[0]) is not None
+    assert await cache.lookup(keys[2]) is not None
+    assert cache.total_bytes() == 2 * size
+
+
+async def test_partial_entry_is_never_served_and_swept(tmp_path):
+    root = tmp_path / "cache"
+    cache = ContentCache(str(root), min_free_bytes=0)
+    key = cache_key("k", "partial")
+    await cache.insert(key, _write_src(tmp_path))
+
+    # corrupt: manifest gone (crashed eviction) -> invisible immediately
+    os.unlink(os.path.join(cache.entries_dir, key, META_NAME))
+    assert await cache.lookup(key) is None
+    dest = tmp_path / "job"
+    dest.mkdir()
+    assert await cache.materialize(key, str(dest)) is None
+    assert list(dest.iterdir()) == []  # nothing materialized
+
+    # a fresh construction sweeps the manifest-less dir entirely
+    cache2 = ContentCache(str(root), min_free_bytes=0)
+    assert not os.path.exists(os.path.join(cache2.entries_dir, key))
+
+    # manifest present but state != complete -> also never served
+    key2 = cache_key("k", "filling")
+    await cache2.insert(key2, _write_src(tmp_path))
+    meta_path = os.path.join(cache2.entries_dir, key2, META_NAME)
+    with open(meta_path) as fh:
+        tampered = fh.read().replace("complete", "filling")
+    with open(meta_path, "w") as fh:
+        fh.write(tampered)
+    assert await cache2.lookup(key2) is None
+
+
+async def test_crashed_fill_staging_dir_is_swept(tmp_path):
+    root = tmp_path / "cache"
+    ContentCache(str(root), min_free_bytes=0)
+    # a staging dir owned by a provably-dead pid (pid_max sentinel)
+    orphan = os.path.join(str(root), "staging", f"{'a' * 64}.4194303.0")
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "media.mkv"), "wb") as fh:
+        fh.write(b"partial bytes")
+    cache = ContentCache(str(root), min_free_bytes=0)
+    assert not os.path.exists(orphan)
+    # and it was never visible as an entry
+    assert cache.total_bytes() == 0
+
+
+async def test_materialize_tolerates_entry_file_vanishing(tmp_path):
+    """A listed file missing under the entry (eviction race / tamper)
+    degrades to a miss and leaves no droppings in the workdir."""
+    cache = ContentCache(str(tmp_path / "cache"), min_free_bytes=0)
+    key = cache_key("k", "vanish")
+    await cache.insert(key, _write_src(tmp_path))
+    os.unlink(os.path.join(cache.entries_dir, key, "media.mkv"))
+    dest = tmp_path / "job"
+    dest.mkdir()
+    assert await cache.materialize(key, str(dest)) is None
+    assert list(dest.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# Singleflight
+# ---------------------------------------------------------------------------
+
+async def test_singleflight_coalesces_concurrent_fetches():
+    sf = Singleflight()
+    fetches = [0]
+
+    async def fetch(report):
+        fetches[0] += 1
+        report(10)
+        await asyncio.sleep(0.05)
+        report(40)
+
+    led = await asyncio.gather(*(sf.run("ab" * 32, fetch) for _ in range(5)))
+    assert fetches[0] == 1
+    assert sorted(led) == [False, False, False, False, True]
+
+
+async def test_singleflight_waiters_reemit_progress():
+    sf = Singleflight()
+    seen = []
+
+    async def fetch(report):
+        await asyncio.sleep(0.02)  # let the waiter subscribe
+        report(10)
+        await asyncio.sleep(0.02)
+        report(40)
+        await asyncio.sleep(0.02)
+
+    async def on_progress(percent):
+        seen.append(percent)
+
+    await asyncio.gather(
+        sf.run("cd" * 32, fetch),
+        sf.run("cd" * 32, fetch, on_wait_progress=on_progress),
+    )
+    # the waiter observed the leader's progress through its own callback
+    assert seen == [10, 40]
+
+
+async def test_singleflight_leader_failure_hands_over():
+    sf = Singleflight()
+    calls = [0]
+
+    async def flaky(report):
+        calls[0] += 1
+        if calls[0] == 1:
+            await asyncio.sleep(0.02)
+            raise RuntimeError("boom")
+        await asyncio.sleep(0.01)
+
+    results = await asyncio.gather(
+        sf.run("ef" * 32, flaky), sf.run("ef" * 32, flaky),
+        return_exceptions=True,
+    )
+    # the failed leader's error reached only the leader; the waiter
+    # retried, became the new leader, and succeeded
+    assert calls[0] == 2
+    assert sum(1 for r in results if isinstance(r, RuntimeError)) == 1
+    assert sum(1 for r in results if r is True) == 1
+
+
+# ---------------------------------------------------------------------------
+# Download stage wiring
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+async def counting_server():
+    """Serves PAYLOAD with a strong ETag; counts body fetches (GETs)."""
+    gets = [0]
+
+    async def serve(request):
+        if request.method == "GET":
+            gets[0] += 1
+        return web.Response(body=PAYLOAD, headers={"ETag": '"seg-1"'})
+
+    runner, base = await start_http_server(serve, path="/media/{name}")
+    yield base, gets
+    await runner.cleanup()
+
+
+async def make_cached_stage(tmp_path, broker, media_id="job-1"):
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "downloads"),
+        "cache": {"path": str(tmp_path / "cache")},
+    }})
+    mq = MemoryQueue(broker)
+    await mq.connect()
+    ctx = StageContext(
+        config=config,
+        emitter=EventEmitter(),
+        logger=NullLogger(),
+        telemetry=Telemetry(mq),
+        metrics=prom.new(f"t{os.urandom(4).hex()}"),
+    )
+    return await stage_factory(ctx), ctx
+
+
+def make_job(uri, media_id):
+    return Job(media=schemas.Media(
+        id=media_id, source=schemas.SourceType.Value("HTTP"),
+        source_uri=uri))
+
+
+async def test_warm_cache_job_never_refetches(tmp_path, counting_server):
+    """THE acceptance bar: the second same-content job makes zero GETs."""
+    base, gets = counting_server
+    broker = InMemoryBroker()
+    stage, ctx = await make_cached_stage(tmp_path, broker)
+    uri = f"{base}/media/file.mkv"
+
+    await stage(make_job(uri, "job-1"))
+    assert gets[0] == 1
+    await stage(make_job(uri, "job-2"))
+    assert gets[0] == 1  # served from cache; only a HEAD revalidated
+
+    for job in ("job-1", "job-2"):
+        path = tmp_path / "downloads" / job / "file.mkv"
+        assert path.read_bytes() == PAYLOAD
+    assert ctx.metrics.cache_hits._value.get() == 1
+    assert ctx.metrics.cache_misses._value.get() == 1
+    assert ctx.metrics.cache_bytes_saved._value.get() == len(PAYLOAD)
+
+
+async def test_no_validator_means_no_caching(tmp_path):
+    """An origin offering no strong validator cannot prove two fetches
+    are the same entity — every job downloads."""
+    gets = [0]
+
+    async def serve(request):
+        if request.method == "GET":
+            gets[0] += 1
+        return web.Response(body=PAYLOAD)  # no ETag, no Last-Modified
+
+    runner, base = await start_http_server(serve, path="/media/{name}")
+    try:
+        broker = InMemoryBroker()
+        stage, _ctx = await make_cached_stage(tmp_path, broker)
+        await stage(make_job(f"{base}/media/file.mkv", "job-1"))
+        await stage(make_job(f"{base}/media/file.mkv", "job-2"))
+        assert gets[0] == 2
+    finally:
+        await runner.cleanup()
+
+
+async def test_corrupted_entry_falls_back_to_network(tmp_path,
+                                                     counting_server):
+    """A tampered/partial entry is never materialized into a workdir —
+    the job re-downloads and repairs the cache."""
+    base, gets = counting_server
+    broker = InMemoryBroker()
+    stage, ctx = await make_cached_stage(tmp_path, broker)
+    uri = f"{base}/media/file.mkv"
+    await stage(make_job(uri, "job-1"))
+
+    cache = ctx.resources["content_cache"]
+    entries = os.listdir(cache.entries_dir)
+    assert len(entries) == 1
+    os.unlink(os.path.join(cache.entries_dir, entries[0], META_NAME))
+
+    await stage(make_job(uri, "job-2"))
+    assert gets[0] == 2  # refetched: the partial entry was not served
+    assert (tmp_path / "downloads" / "job-2" / "file.mkv").read_bytes() \
+        == PAYLOAD
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: fan-in coalescing end-to-end
+# ---------------------------------------------------------------------------
+
+def make_download_msg(uri, job_id):
+    return schemas.encode(schemas.Download(media=schemas.Media(
+        id=job_id, creator_id=f"card-{job_id}", name="A Show",
+        type=schemas.MediaType.Value("MOVIE"),
+        source=schemas.SourceType.Value("HTTP"), source_uri=uri)))
+
+
+async def make_cached_orchestrator(tmp_path, broker, store, **kwargs):
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "downloads"),
+        "cache": {"path": str(tmp_path / "cache")},
+        "max_concurrent_jobs": 4,
+    }})
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config, mq=MemoryQueue(broker), store=store,
+        telemetry=Telemetry(telem_mq), metrics=prom.new(
+            f"t{os.urandom(4).hex()}"),
+        logger=NullLogger(), **kwargs)
+    await orchestrator.start()
+    return orchestrator
+
+
+async def test_fanin_jobs_coalesce_to_one_fetch(tmp_path):
+    """N concurrent same-content jobs -> ONE network GET; every job
+    stages, publishes Convert, and emits its own telemetry."""
+    gets = [0]
+
+    async def serve(request):
+        if request.method != "GET":
+            return web.Response(headers={"ETag": '"fan-1"'})
+        gets[0] += 1
+        await asyncio.sleep(0.2)  # hold the fetch open so jobs overlap
+        return web.Response(body=PAYLOAD, headers={"ETag": '"fan-1"'})
+
+    runner, base = await start_http_server(serve, path="/show.mkv")
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_cached_orchestrator(tmp_path, broker, store)
+    try:
+        for i in range(4):
+            broker.publish(schemas.DOWNLOAD_QUEUE,
+                           make_download_msg(f"{base}/show.mkv", f"job-{i}"))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+
+        assert gets[0] == 1  # one download amortized across the fan-in
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 4
+        for i in range(4):
+            staged = await store.get_object(
+                STAGING_BUCKET, object_name(f"job-{i}", "show.mkv"))
+            assert staged == PAYLOAD
+
+        m = orchestrator.metrics
+        assert m.cache_misses._value.get() == 1
+        coalesced = m.cache_coalesced._value.get()
+        hits = m.cache_hits._value.get()
+        assert coalesced + hits == 3
+        assert coalesced >= 1  # jobs genuinely overlapped the fetch
+        assert m.cache_bytes_saved._value.get() == 3 * len(PAYLOAD)
+
+        # every coalesced job re-emitted progress through ITS OWN
+        # telemetry channel (not just the leader's)
+        events = [schemas.decode(schemas.TelemetryProgressEvent, raw)
+                  for raw in broker.published(PROGRESS_QUEUE)]
+        for i in range(4):
+            assert any(e.media_id == f"job-{i}" and e.percent == 50
+                       for e in events)
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_sequential_fanin_hits_cache(tmp_path, counting_server):
+    """Jobs arriving AFTER the first completes are plain cache hits."""
+    base, gets = counting_server
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_cached_orchestrator(tmp_path, broker, store)
+    try:
+        for i in range(3):
+            broker.publish(
+                schemas.DOWNLOAD_QUEUE,
+                make_download_msg(f"{base}/media/file.mkv", f"seq-{i}"))
+            await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        assert gets[0] == 1
+        assert orchestrator.metrics.cache_hits._value.get() == 2
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: admission gate
+# ---------------------------------------------------------------------------
+
+async def test_admission_waits_for_disk_headroom_and_evicts(
+        tmp_path, counting_server):
+    """A job is held (delivery unsettled, nothing fetched) while the
+    cache volume lacks headroom; LRU entries are evicted to make room;
+    the job proceeds as soon as headroom appears."""
+    base, gets = counting_server
+    cache = ContentCache(str(tmp_path / "cache"), min_free_bytes=1 << 20)
+    # pre-seed an entry so admission has something to reclaim
+    src = tmp_path / "seed"
+    src.mkdir()
+    (src / "old.mkv").write_bytes(b"o" * 4096)
+    seeded = cache_key("http", "http://old/media.mkv", '"old"')
+    await cache.insert(seeded, str(src))
+
+    free = [0]  # fake volume: no headroom until the test says so
+    cache.free_disk_bytes = lambda: free[0]
+
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "downloads")}})
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config, mq=MemoryQueue(broker), store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"t{os.urandom(4).hex()}"),
+        logger=NullLogger(), cache=cache, admission_timeout=30)
+    await orchestrator.start()
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/media/file.mkv", "gated"))
+        await asyncio.sleep(0.6)
+        # held at admission: nothing fetched, nothing converted
+        assert gets[0] == 0
+        assert broker.published(schemas.CONVERT_QUEUE) == []
+        # the reclaimable entry was evicted in the attempt
+        assert await cache.lookup(seeded) is None
+        assert orchestrator.metrics.cache_evicted_bytes._value.get() == 4096
+
+        free[0] = 64 << 20  # headroom appears (e.g. a job finished)
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        assert gets[0] == 1
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_admission_no_cache_is_not_gated(tmp_path, counting_server):
+    """Without a cache the gate is inert — jobs start immediately."""
+    base, gets = counting_server
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "downloads")}})
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config, mq=MemoryQueue(broker), store=store,
+        telemetry=Telemetry(telem_mq), logger=NullLogger())
+    assert orchestrator.cache is None
+    await orchestrator.start()
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/media/file.mkv", "free"))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_prefetch_resolves_from_config(tmp_path):
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "d"),
+        "max_concurrent_jobs": 7}})
+    orchestrator = Orchestrator(
+        config=config, mq=MemoryQueue(InMemoryBroker()),
+        store=InMemoryObjectStore(), logger=NullLogger())
+    assert orchestrator.prefetch == 7
+    # explicit argument still wins (bench/tests pin their own)
+    orchestrator = Orchestrator(
+        config=config, mq=MemoryQueue(InMemoryBroker()),
+        store=InMemoryObjectStore(), logger=NullLogger(), prefetch=3)
+    assert orchestrator.prefetch == 3

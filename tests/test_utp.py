@@ -229,7 +229,10 @@ async def test_utp_vs_tcp_ratio_floor():
             tcp_rate = await measure(tcp_start, tcp_open, tcp_stop)
             utp_rate = await measure(utp_start, utp_open, utp_stop)
             best = max(best, utp_rate / tcp_rate)
-    assert best >= 0.7, f"utp/tcp ratio {best:.3f} below the 0.7 floor"
+    # 0.85 ratchets the floor to the r5 level (shipping 0.93-1.41 after
+    # the FIN-drain/TLP/coalescing work; 0.7 only guarded r4) while
+    # keeping margin for CI noise — best-of-2 already de-noises
+    assert best >= 0.85, f"utp/tcp ratio {best:.3f} below the 0.85 floor"
 
 
 async def test_connection_churn_no_socket_accumulation():
