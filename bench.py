@@ -71,7 +71,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #    cache_fanin_speedup (uncached wall / cached wall, one download
 #    amortized across all jobs) and cache_hit_mbps (warm single-job
 #    materialization rate).
-HARNESS_VERSION = 8
+# v9 (r7): staging/compute/torrent/fan-in measurements identical to v8;
+#  new control-plane microbench only: cancel_latency_ms (POST /cancel of
+#  a mid-transfer job -> delivery settled + temp files gone) and
+#  registry_overhead_ms (full lifecycle walk per job; guard < 1 ms).
+HARNESS_VERSION = 9
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -410,6 +414,137 @@ def _bench_cache_fanin_safe() -> dict:
         return asyncio.run(bench_cache_fanin())
     except Exception as err:
         return {"cache_fanin_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+async def bench_control() -> dict:
+    """Control-plane microbenches (harness v9).
+
+    - ``cancel_latency_ms``: wall time from ``POST /v1/jobs/{id}/cancel``
+      against a mid-transfer download to the delivery being settled AND
+      the job's temp files gone (the orchestrator removes the workdir
+      before acking, so broker idle == disk reclaimed).
+    - ``registry_overhead_ms``: per-job cost of the full registry walk
+      (register + 6 transitions + terminal retirement), measured over
+      2000 synthetic jobs; the guard bar is < 1 ms/job
+      (``registry_overhead_ok``).
+    """
+    import statistics
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.control.registry import (
+        ADMITTED, DONE, PUBLISHING, RUNNING, JobRegistry,
+    )
+    from downloader_tpu.health import build_app
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import InMemoryObjectStore
+
+    # -- registry overhead ---------------------------------------------
+    registry = JobRegistry()
+    jobs = 2000
+    t0 = time.perf_counter()
+    for i in range(jobs):
+        record = registry.register(f"bench-{i}", "card")
+        registry.transition(record, ADMITTED)
+        for stage in ("download", "process", "upload"):
+            registry.transition(record, RUNNING, stage=stage)
+        registry.transition(record, PUBLISHING)
+        registry.transition(record, DONE)
+    registry_ms = (time.perf_counter() - t0) * 1000.0 / jobs
+
+    # -- cancel latency -------------------------------------------------
+    async def serve(request):
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        try:
+            for _ in range(100_000):
+                await resp.write(b"x" * 8192)
+                await asyncio.sleep(0.005)
+        except (ConnectionError, aiohttp.ClientConnectionError):
+            pass  # cancelled jobs drop the connection — expected here
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    media_runner = web.AppRunner(app)
+    await media_runner.setup()
+    site = web.TCPSite(media_runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    latencies = []
+    with tempfile.TemporaryDirectory() as work:
+        broker = InMemoryBroker()
+        orchestrator = Orchestrator(
+            config=ConfigNode({"instance": {
+                "download_path": os.path.join(work, "dl"),
+                "max_concurrent_jobs": 2,
+            }}),
+            mq=MemoryQueue(broker),
+            store=InMemoryObjectStore(),
+            telemetry=Telemetry(MemoryQueue(broker)),
+            logger=NullLogger(),
+        )
+        await orchestrator.start()
+        admin = build_app(orchestrator)
+        admin_runner = web.AppRunner(admin)
+        await admin_runner.setup()
+        admin_site = web.TCPSite(admin_runner, "127.0.0.1", 0)
+        await admin_site.start()
+        admin_port = admin_site._server.sockets[0].getsockname()[1]
+        try:
+            async with aiohttp.ClientSession() as session:
+                for i in range(5):
+                    job_id = f"cancel-{i}"
+                    msg = schemas.Download(media=schemas.Media(
+                        id=job_id, creator_id="c",
+                        type=schemas.MediaType.Value("MOVIE"),
+                        source=schemas.SourceType.Value("HTTP"),
+                        source_uri=f"http://127.0.0.1:{port}/media.mkv",
+                    ))
+                    broker.publish(schemas.DOWNLOAD_QUEUE,
+                                   schemas.encode(msg))
+                    workdir = os.path.join(work, "dl", job_id)
+                    async with asyncio.timeout(30):
+                        while not os.path.isdir(workdir):
+                            await asyncio.sleep(0.002)
+                    t0 = time.perf_counter()
+                    async with session.post(
+                        f"http://127.0.0.1:{admin_port}"
+                        f"/v1/jobs/{job_id}/cancel"
+                    ) as resp:
+                        assert resp.status == 202, resp.status
+                    async with asyncio.timeout(30):
+                        while not broker.idle(schemas.DOWNLOAD_QUEUE):
+                            await asyncio.sleep(0.002)
+                    assert not os.path.exists(workdir), "temp files leaked"
+                    latencies.append((time.perf_counter() - t0) * 1000.0)
+        finally:
+            await admin_runner.cleanup()
+            await orchestrator.shutdown(grace_seconds=5)
+            await media_runner.cleanup()
+
+    return {
+        "cancel_latency_ms": round(statistics.median(latencies), 1),
+        "registry_overhead_ms": round(registry_ms, 4),
+        "registry_overhead_ok": registry_ms < 1.0,
+    }
+
+
+def _bench_control_safe() -> dict:
+    """A control-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_control())
+    except Exception as err:
+        return {"control_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 _COMPUTE_SNIPPET = """
@@ -1048,6 +1183,9 @@ HEADLINE_KEYS = [
     "cache_cold_mbps",
     "cache_fanin_jobs",
     "cache_fanin_error",          # present only on failure — visible
+    "cancel_latency_ms",          # r7 control plane: cancel -> settled+clean
+    "registry_overhead_ms",       # r7 guard: must stay < 1 ms/job
+    "control_bench_error",        # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1090,6 +1228,7 @@ def main() -> None:
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
+        **_bench_control_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),
         **bench_upscale_pipeline(),

@@ -12,6 +12,7 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli magnet media.torrent
     python -m downloader_tpu.cli scrape media.torrent
     python -m downloader_tpu.cli status [--url http://host:3401]
+    python -m downloader_tpu.cli jobs list|show ID|cancel ID [--url ...]
     python -m downloader_tpu.cli watch [--id my-movie]
     python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
     python -m downloader_tpu.cli train --data media/ --steps 500 \
@@ -63,6 +64,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     submit.add_argument("--uri", required=True,
                         help="magnet:, http(s)://, file://, or bucket:// URI")
+    submit.add_argument(
+        "--priority", default="NORMAL", type=str.upper,
+        choices=list(schemas.JobPriority.keys()),
+        help="scheduling class: HIGH starts before NORMAL before BULK "
+             "when the service's run slots are contended",
+    )
     submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
     submit.add_argument("--wait", action="store_true",
                         help="tap telemetry and block until the job's "
@@ -101,6 +108,37 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     status.add_argument("--url", default="http://127.0.0.1:3401",
                         help="service base URL (default local health port)")
+
+    jobs = sub.add_parser(
+        "jobs", help="list/inspect/cancel jobs via a service's admin API"
+    )
+    jobs_sub = jobs.add_subparsers(dest="jobs_command", required=True)
+
+    def _jobs_common(p):
+        p.add_argument("--url", default="http://127.0.0.1:3401",
+                       help="service base URL (default local health port)")
+        p.add_argument("--token", default=None,
+                       help="bearer token for mutating endpoints "
+                            "(default: $CONTROL_TOKEN)")
+
+    jobs_list = jobs_sub.add_parser("list", help="list live + recent jobs")
+    _jobs_common(jobs_list)
+    jobs_list.add_argument("--state", default=None,
+                           help="filter by lifecycle state "
+                                "(RECEIVED/ADMITTED/RUNNING/PUBLISHING/"
+                                "DONE/FAILED/CANCELLED/DROPPED_POISON)")
+
+    jobs_show = jobs_sub.add_parser("show", help="one job's full record")
+    _jobs_common(jobs_show)
+    jobs_show.add_argument("id", help="media/job id")
+
+    jobs_cancel = jobs_sub.add_parser(
+        "cancel", help="cooperatively cancel a job (settled, not requeued)"
+    )
+    _jobs_common(jobs_cancel)
+    jobs_cancel.add_argument("id", help="media/job id")
+    jobs_cancel.add_argument("--reason", default="cli",
+                             help="recorded in the job's terminal state")
 
     watch = sub.add_parser(
         "watch", help="tail job status/progress telemetry from the queue"
@@ -189,7 +227,8 @@ async def _submit(args) -> int:
             type=schemas.MediaType.Value(args.type),
             source=schemas.SourceType.Value(args.source),
             source_uri=args.uri,
-        )
+        ),
+        priority=schemas.JobPriority.Value(args.priority),
     )
     from .platform.tracing import format_traceparent, init_tracer
 
@@ -317,6 +356,56 @@ async def _status(args) -> int:
         if any(key in line for key in wanted):
             print(line)
     return 0
+
+
+async def _jobs(args) -> int:
+    """Drive the control plane's admin API (health.py port)."""
+    import json
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    token = args.token or os.environ.get("CONTROL_TOKEN")
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    timeout = aiohttp.ClientTimeout(total=60)  # drain-adjacent ops can wait
+    async with aiohttp.ClientSession(timeout=timeout,
+                                     headers=headers) as session:
+        try:
+            if args.jobs_command == "list":
+                params = {"state": args.state} if args.state else {}
+                async with session.get(f"{base}/v1/jobs",
+                                       params=params) as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+                if body.get("intakePaused"):
+                    print("# intake PAUSED", file=sys.stderr)
+                for job in body.get("jobs", []):
+                    stage = job.get("stage") or "-"
+                    percent = job.get("percent")
+                    progress = f"{percent}%" if percent is not None else "-"
+                    print(f"{job['id']}\t{job['state']}\t{stage}\t{progress}"
+                          f"\t{job.get('priority', 'NORMAL')}")
+                return 0
+            if args.jobs_command == "show":
+                async with session.get(
+                    f"{base}/v1/jobs/{args.id}"
+                ) as resp:
+                    body = await resp.json()
+                    print(json.dumps(body, indent=2, sort_keys=True))
+                    return 0 if resp.status == 200 else 1
+            # cancel
+            async with session.post(
+                f"{base}/v1/jobs/{args.id}/cancel",
+                json={"reason": args.reason},
+            ) as resp:
+                body = await resp.json()
+                print(json.dumps(body, indent=2, sort_keys=True))
+                return 0 if resp.status in (200, 202) else 1
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
 
 
 async def _watch(args) -> int:
@@ -518,6 +607,8 @@ def main(argv=None) -> int:
         return asyncio.run(_scrape(args))
     if args.command == "status":
         return asyncio.run(_status(args))
+    if args.command == "jobs":
+        return asyncio.run(_jobs(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
     if args.command == "upscale":

@@ -1,0 +1,164 @@
+"""Admin API: the control plane's HTTP surface.
+
+Mounted on the same aiohttp app as ``/health`` (health.py), so one port
+serves probes, metrics, and operations:
+
+    GET  /v1/jobs                   list live + recently-terminal jobs
+    GET  /v1/jobs/{id}              one job's record
+    POST /v1/jobs/{id}/cancel       fire the job's cancel token
+    POST /v1/intake/pause           stop pulling deliveries (in-flight
+                                    work keeps running; /readyz -> 503)
+    POST /v1/intake/resume          start pulling again
+    POST /v1/drain?grace=30         pause intake + wait for in-flight
+                                    jobs (programmatic shutdown grace)
+
+Mutating endpoints (POST) are gated by an optional bearer token from
+``control.token`` / env ``CONTROL_TOKEN``; reads stay open like
+``/metrics``.  Without a token configured every caller is allowed — the
+parity posture for a service that previously had no API at all.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..platform.config import cfg_get
+from . import registry as reg
+
+
+def resolve_token(config) -> Optional[str]:
+    return os.environ.get("CONTROL_TOKEN") or cfg_get(
+        config, "control.token", None
+    )
+
+
+def bind_control_routes(app: web.Application, orchestrator) -> None:
+    token = resolve_token(getattr(orchestrator, "config", None))
+
+    def _registry():
+        return getattr(orchestrator, "registry", None)
+
+    def _authorized(request: web.Request) -> bool:
+        if not token:
+            return True
+        header = request.headers.get("Authorization", "")
+        # compare BYTES: compare_digest on str raises TypeError for
+        # non-ASCII input, which would turn a hostile header into a 500
+        # instead of a 401
+        return hmac.compare_digest(
+            header.encode("utf-8", "surrogateescape"),
+            f"Bearer {token}".encode("utf-8", "surrogateescape"),
+        )
+
+    def _deny() -> web.Response:
+        return web.json_response(
+            {"error": "missing or invalid bearer token"}, status=401
+        )
+
+    def _unavailable() -> web.Response:
+        return web.json_response(
+            {"error": "control plane unavailable"}, status=503
+        )
+
+    async def jobs_list(request: web.Request) -> web.Response:
+        registry = _registry()
+        if registry is None:
+            return _unavailable()
+        state = request.query.get("state")
+        if state and state not in reg.LEGAL_TRANSITIONS:
+            return web.json_response(
+                {"error": f"unknown state {state!r}",
+                 "states": sorted(reg.LEGAL_TRANSITIONS)}, status=400
+            )
+        return web.json_response({
+            "jobs": [r.to_dict() for r in registry.jobs(state)],
+            "counts": registry.counts(),
+            "intakePaused": bool(
+                getattr(orchestrator, "intake_paused", False)
+            ),
+        })
+
+    async def job_show(request: web.Request) -> web.Response:
+        registry = _registry()
+        if registry is None:
+            return _unavailable()
+        record = registry.get(request.match_info["id"])
+        if record is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response(record.to_dict())
+
+    async def job_cancel(request: web.Request) -> web.Response:
+        if not _authorized(request):
+            return _deny()
+        registry = _registry()
+        if registry is None:
+            return _unavailable()
+        job_id = request.match_info["id"]
+        reason = request.query.get("reason") or "operator"
+        if request.can_read_body:
+            try:
+                body = await request.json()
+                reason = body.get("reason") or reason
+            except (ValueError, AttributeError):
+                pass
+        fired = registry.cancel(job_id, reason=reason)
+        record = registry.get(job_id)
+        if not fired:
+            if record is None:
+                return web.json_response({"error": "unknown job"}, status=404)
+            # known but already terminal (or token already fired)
+            return web.json_response(
+                {"error": "job is not cancellable", "job": record.to_dict()},
+                status=409,
+            )
+        return web.json_response(
+            {"cancelled": len(fired), "job": record.to_dict()}, status=202
+        )
+
+    async def intake_pause(request: web.Request) -> web.Response:
+        if not _authorized(request):
+            return _deny()
+        pause = getattr(orchestrator, "pause_intake", None)
+        if pause is None:
+            return _unavailable()
+        await pause()
+        return web.json_response({"intakePaused": True})
+
+    async def intake_resume(request: web.Request) -> web.Response:
+        if not _authorized(request):
+            return _deny()
+        resume = getattr(orchestrator, "resume_intake", None)
+        if resume is None:
+            return _unavailable()
+        await resume()
+        return web.json_response({"intakePaused": False})
+
+    async def drain(request: web.Request) -> web.Response:
+        if not _authorized(request):
+            return _deny()
+        drain_fn = getattr(orchestrator, "drain", None)
+        if drain_fn is None:
+            return _unavailable()
+        try:
+            grace = float(request.query.get("grace", 30.0))
+        except ValueError:
+            return web.json_response(
+                {"error": "grace must be a number of seconds"}, status=400
+            )
+        drained = await drain_fn(grace_seconds=grace)
+        return web.json_response({
+            "drained": drained,
+            "intakePaused": True,
+            "active": len(getattr(orchestrator, "active_jobs", [])),
+        }, status=200 if drained else 504)
+
+    app.router.add_get("/v1/jobs", jobs_list)
+    app.router.add_get("/v1/jobs/{id}", job_show)
+    app.router.add_post("/v1/jobs/{id}/cancel", job_cancel)
+    app.router.add_post("/v1/intake/pause", intake_pause)
+    app.router.add_post("/v1/intake/resume", intake_resume)
+    app.router.add_post("/v1/drain", drain)

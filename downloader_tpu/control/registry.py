@@ -1,0 +1,251 @@
+"""Job registry: the control plane's source of truth for job lifecycle.
+
+The reference's only job visibility is the inverted ``/health`` counter
+(/root/reference/lib/main.js:174-194): an operator cannot list, inspect,
+or intervene in work.  The registry records every delivery from the
+moment it is received — *before* admission, closing the pre-r7 blind
+spot where a job parked in the admission gate was invisible to
+``/health`` and drain — and walks it through a validated state machine:
+
+    RECEIVED -> ADMITTED -> RUNNING(stage) -> PUBLISHING
+                                 -> DONE | FAILED | CANCELLED | DROPPED_POISON
+
+Illegal transitions raise :class:`IllegalTransition` (a lifecycle bug
+must fail loudly, not corrupt operator-facing state).  Each record keeps
+per-stage wall timing, byte counters sampled from stage progress, and
+the cancel token the admin API fires.  Terminal records move to a
+bounded ring for post-hoc inspection (``GET /v1/jobs`` keeps answering
+for recently finished work without growing forever).
+
+Metrics: ``jobs_by_state`` gauge (every record the registry knows, by
+state) and ``job_state_transitions_total`` counter (from/to labels).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from typing import Deque, Dict, List, Optional
+
+from ..utils import utcnow_iso as _utcnow_iso
+from .cancel import CancelToken
+
+# -- lifecycle states ---------------------------------------------------
+RECEIVED = "RECEIVED"
+ADMITTED = "ADMITTED"
+RUNNING = "RUNNING"
+PUBLISHING = "PUBLISHING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+DROPPED_POISON = "DROPPED_POISON"
+
+TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON})
+
+# RUNNING -> RUNNING models stage hops (download -> process -> upload);
+# ADMITTED -> PUBLISHING is the idempotency skip (done marker already
+# staged); FAILED is reachable from anywhere non-terminal (a handler can
+# die at any point and the record must still close).
+LEGAL_TRANSITIONS: Dict[str, frozenset] = {
+    RECEIVED: frozenset({ADMITTED, FAILED, CANCELLED}),
+    ADMITTED: frozenset({RUNNING, PUBLISHING, FAILED, CANCELLED}),
+    RUNNING: frozenset(
+        {RUNNING, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
+    ),
+    PUBLISHING: frozenset({DONE, FAILED, CANCELLED}),
+    DONE: frozenset(),
+    FAILED: frozenset(),
+    CANCELLED: frozenset(),
+    DROPPED_POISON: frozenset(),
+}
+
+DEFAULT_TERMINAL_RING = 256
+
+
+class IllegalTransition(RuntimeError):
+    """A lifecycle move the state machine forbids."""
+
+
+class JobRecord:
+    """One delivery's lifecycle, as the control plane sees it."""
+
+    __slots__ = (
+        "uid", "job_id", "file_id", "priority", "state", "stage", "reason",
+        "percent", "bytes", "cancel", "created_at", "updated_at",
+        "stage_seconds", "_entered_mono", "_created_mono",
+    )
+
+    def __init__(self, uid: int, job_id: str, file_id: str, priority: str):
+        self.uid = uid
+        self.job_id = job_id
+        self.file_id = file_id
+        self.priority = priority
+        self.state = RECEIVED
+        self.stage: Optional[str] = None
+        self.reason: Optional[str] = None
+        self.percent: Optional[int] = None
+        self.bytes: Dict[str, int] = {}
+        self.cancel = CancelToken(job_id)
+        self.created_at = _utcnow_iso()
+        self.updated_at = self.created_at
+        self.stage_seconds: Dict[str, float] = {}
+        self._created_mono = time.monotonic()
+        self._entered_mono = self._created_mono
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def add_bytes(self, kind: str, count: int) -> None:
+        """Stage-side byte sampling (downloaded/uploaded so far)."""
+        if count:
+            self.bytes[kind] = self.bytes.get(kind, 0) + int(count)
+
+    def note_progress(self, percent: int) -> None:
+        self.percent = int(percent)
+        self.updated_at = _utcnow_iso()
+
+    def to_dict(self) -> dict:
+        """JSON shape served by ``GET /v1/jobs[/{id}]``."""
+        return {
+            "id": self.job_id,
+            "fileId": self.file_id,
+            "priority": self.priority,
+            "state": self.state,
+            "stage": self.stage,
+            "reason": self.reason,
+            "percent": self.percent,
+            "bytes": dict(self.bytes),
+            "cancelRequested": self.cancel.cancelled,
+            "createdAt": self.created_at,
+            "updatedAt": self.updated_at,
+            "ageSeconds": round(time.monotonic() - self._created_mono, 3),
+            "stageSeconds": {
+                k: round(v, 3) for k, v in self.stage_seconds.items()
+            },
+        }
+
+
+class JobRegistry:
+    """Registry of live jobs + a bounded ring of terminal ones.
+
+    Single-event-loop discipline (like the orchestrator's other state):
+    every mutation happens on the loop, so no lock is needed.
+    """
+
+    def __init__(self, metrics=None, terminal_ring: int = DEFAULT_TERMINAL_RING,
+                 logger=None):
+        self.metrics = metrics
+        self.logger = logger
+        self.terminal_ring = max(int(terminal_ring), 0)
+        self._active: "collections.OrderedDict[int, JobRecord]" = (
+            collections.OrderedDict()
+        )
+        self._ring: Deque[JobRecord] = collections.deque()
+        self._seq = itertools.count(1)
+
+    # -- metrics helpers -----------------------------------------------
+    def _gauge(self, state: str, delta: int) -> None:
+        if self.metrics is not None:
+            self.metrics.jobs_by_state.labels(state=state).inc(delta)
+
+    # -- lifecycle ------------------------------------------------------
+    def register(self, job_id: str, file_id: str,
+                 priority: str = "NORMAL") -> JobRecord:
+        """Open a record at delivery receipt (state RECEIVED)."""
+        record = JobRecord(next(self._seq), job_id, file_id, priority)
+        self._active[record.uid] = record
+        self._gauge(RECEIVED, +1)
+        return record
+
+    def transition(self, record: JobRecord, state: str,
+                   stage: Optional[str] = None,
+                   reason: Optional[str] = None) -> JobRecord:
+        """Move ``record`` to ``state``; illegal moves raise."""
+        if state not in LEGAL_TRANSITIONS:
+            raise IllegalTransition(f"unknown state {state!r}")
+        if state not in LEGAL_TRANSITIONS[record.state]:
+            raise IllegalTransition(
+                f"job {record.job_id}: {record.state} -> {state} is not a "
+                f"legal lifecycle transition"
+            )
+        now = time.monotonic()
+        # close the timing of the stage (or state) being left
+        if record.state == RUNNING and record.stage:
+            record.stage_seconds[record.stage] = (
+                record.stage_seconds.get(record.stage, 0.0)
+                + (now - record._entered_mono)
+            )
+        if self.metrics is not None:
+            self.metrics.job_state_transitions.labels(
+                from_state=record.state, to_state=state
+            ).inc()
+        self._gauge(record.state, -1)
+        self._gauge(state, +1)
+        record.state = state
+        if state == RUNNING:
+            record.stage = stage
+        # non-RUNNING states keep the last stage entered: a terminal
+        # record should still say which stage the job died/cancelled in
+        if reason is not None:
+            record.reason = reason
+        record.updated_at = _utcnow_iso()
+        record._entered_mono = now
+        if state in TERMINAL_STATES:
+            self._retire(record)
+        return record
+
+    def _retire(self, record: JobRecord) -> None:
+        self._active.pop(record.uid, None)
+        self._ring.append(record)
+        while len(self._ring) > self.terminal_ring:
+            evicted = self._ring.popleft()
+            # the gauge counts records the registry still knows about
+            self._gauge(evicted.state, -1)
+
+    # -- control --------------------------------------------------------
+    def cancel(self, job_id: str, reason: str = "operator") -> List[JobRecord]:
+        """Fire the cancel token of every live record for ``job_id``.
+
+        Returns the records whose tokens fired (empty when the job is
+        unknown or already terminal).  The *state* moves to CANCELLED
+        only when the job actually settles — cancellation is
+        cooperative, and the record must reflect reality.
+        """
+        fired = []
+        for record in self._active.values():
+            if record.job_id == job_id and record.cancel.cancel(reason):
+                record.updated_at = _utcnow_iso()
+                fired.append(record)
+        if fired and self.logger is not None:
+            self.logger.info("job cancellation requested",
+                             jobId=job_id, reason=reason)
+        return fired
+
+    # -- introspection --------------------------------------------------
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        """Most recent record for ``job_id``: live first, then the ring."""
+        latest = None
+        for record in self._active.values():
+            if record.job_id == job_id:
+                latest = record
+        if latest is not None:
+            return latest
+        for record in reversed(self._ring):
+            if record.job_id == job_id:
+                return record
+        return None
+
+    def jobs(self, state: Optional[str] = None) -> List[JobRecord]:
+        """All known records, live before terminal, newest last."""
+        out = list(self._active.values()) + list(self._ring)
+        if state:
+            out = [r for r in out if r.state == state]
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for record in self.jobs():
+            out[record.state] = out.get(record.state, 0) + 1
+        return out

@@ -1,4 +1,8 @@
-"""HTTP surface: ``GET /health`` + ``/livez`` + ``/readyz`` + ``/metrics``.
+"""HTTP surface: probes + metrics + the control plane's admin API.
+
+``GET /health`` + ``/livez`` + ``/readyz`` + ``/metrics``, plus the
+``/v1/jobs`` / cancel / intake / drain endpoints from ``control/api.py``
+mounted on the same app (one port for probes, metrics, and operations).
 
 ``/health`` has behavioral parity with /root/reference/lib/main.js:174-194,
 including the reference's deliberate inverted semantics: a worker with zero
@@ -30,6 +34,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from .control.api import bind_control_routes
 from .orchestrator import Orchestrator
 from .platform.config import cfg_get
 from .platform.metrics import Metrics
@@ -59,11 +64,18 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
         return web.json_response({"status": "ok"})
 
     async def readyz(_request: web.Request) -> web.Response:
-        if orchestrator.consuming:
+        if not orchestrator.consuming:
+            return web.json_response({"status": "not consuming"}, status=503)
+        if getattr(orchestrator, "intake_paused", False):
+            # paused via POST /v1/intake/pause or /v1/drain: alive, but
+            # deliberately not taking work — not ready
             return web.json_response(
-                {"status": "ready", "active": len(orchestrator.active_jobs)}
+                {"status": "paused", "active": len(orchestrator.active_jobs)},
+                status=503,
             )
-        return web.json_response({"status": "not consuming"}, status=503)
+        return web.json_response(
+            {"status": "ready", "active": len(orchestrator.active_jobs)}
+        )
 
     async def prom(_request: web.Request) -> web.Response:
         body = metrics.render() if metrics is not None else b""
@@ -73,6 +85,9 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
     app.router.add_get("/livez", livez)
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", prom)
+    # control plane: /v1/jobs, cancel, intake pause/resume, drain
+    # (degrades to 503s against orchestrators without a registry)
+    bind_control_routes(app, orchestrator)
     return app
 
 

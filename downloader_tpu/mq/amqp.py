@@ -378,8 +378,50 @@ class AmqpQueue(MessageQueue):
                         self.CHANNEL, wire.BASIC_CANCEL, sub.consumer_tag, False),
                     wire.BASIC_CANCEL_OK,
                 )
-            except (ConnectionError, wire.ProtocolError, asyncio.TimeoutError):
+            except (ConnectionError, OSError):
+                # connection is gone: nothing is being consumed, and
+                # _consuming=False keeps the reconnect loop from
+                # restoring the subscriptions
                 break
+            # wire.ProtocolError / TimeoutError propagate: the consumer
+            # may still be live on a healthy connection, and a caller
+            # (intake pause / drain) must not report intake stopped when
+            # it wasn't — a retry re-issues the cancels idempotently
+
+    async def resume_consuming(self) -> None:
+        """Re-issue basic.consume for every registered subscription
+        (control-plane intake resume after :meth:`stop_consuming`).
+
+        The subscriptions table survives the pause, so the same queues /
+        handlers / qos come back; while disconnected, flipping
+        ``_consuming`` is enough — the reconnect loop restores consumers
+        on the next connection.
+
+        Deliberately RE-ENTRANT: each subscription is basic.cancel'd
+        (a no-op for a tag the broker doesn't know) before its consume,
+        so a resume that half-failed on a slow broker can simply be
+        retried — without this, a first attempt dying between the
+        ``_consuming`` flip and the consume would make every retry a
+        silent no-op and leave intake dead until the next reconnect.
+        """
+        if self._closing:
+            raise RuntimeError("resume on closed queue connection")
+        self._consuming = True
+        if not self._connected.is_set():
+            return  # reconnect loop restores consumers on connect
+        for sub in list(self._subscriptions.values()):
+            try:
+                await self._rpc(
+                    wire.encode_method(
+                        self.CHANNEL, wire.BASIC_CANCEL,
+                        sub.consumer_tag, False),
+                    wire.BASIC_CANCEL_OK,
+                )
+                await self._start_consumer(sub)
+            except (ConnectionError, OSError):
+                return  # connection died: reconnect restores everything
+            # wire.ProtocolError / TimeoutError propagate: the caller's
+            # retry re-runs the cancel+consume pair idempotently
 
     async def close(self) -> None:
         self._closing = True

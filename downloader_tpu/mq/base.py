@@ -73,6 +73,17 @@ class MessageQueue(abc.ABC):
         Used by graceful shutdown: drain-then-close instead of cancelling
         handlers mid-stage."""
 
+    async def resume_consuming(self) -> None:
+        """Re-start the consumers registered via :meth:`listen` after a
+        :meth:`stop_consuming` (control-plane intake pause/resume).
+
+        Optional capability: the bundled backends implement it; the
+        default raises so a backend that silently dropped subscriptions
+        can't fake a resume."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support resuming consumers"
+        )
+
     @abc.abstractmethod
     async def publish(self, queue: str, body: bytes,
                       headers: Optional[dict] = None) -> None:

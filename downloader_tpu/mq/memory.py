@@ -171,6 +171,10 @@ class MemoryQueue(MessageQueue):
         self._broker = broker
         self._consume_loops: Set[asyncio.Task] = set()
         self._handlers: Set[asyncio.Task] = set()
+        # subscriptions survive stop_consuming so resume_consuming can
+        # re-spawn them (control-plane intake pause/resume); the shared
+        # semaphore keeps unsettled deliveries counted across the pause
+        self._subscriptions: list = []
         self._connected = False
 
     async def connect(self) -> None:
@@ -185,6 +189,14 @@ class MemoryQueue(MessageQueue):
             except (asyncio.CancelledError, Exception):
                 pass
         self._consume_loops.clear()
+
+    async def resume_consuming(self) -> None:
+        if not self._connected:
+            raise RuntimeError("resume on closed queue connection")
+        if self._consume_loops:
+            return  # already consuming
+        for sub in self._subscriptions:
+            self._spawn_consumer(*sub)
 
     async def close(self) -> None:
         self._connected = False
@@ -220,11 +232,22 @@ class MemoryQueue(MessageQueue):
         if not self._connected:
             raise RuntimeError("listen on closed queue connection")
         sem = asyncio.Semaphore(prefetch)
+        self._subscriptions.append((queue, handler, sem))
+        self._spawn_consumer(queue, handler, sem)
 
+    def _spawn_consumer(self, queue: str, handler: Handler,
+                        sem: asyncio.Semaphore) -> None:
         async def _consume() -> None:
             while True:
                 await sem.acquire()
-                msg = await self._broker.pop(queue)
+                try:
+                    msg = await self._broker.pop(queue)
+                except asyncio.CancelledError:
+                    # stop_consuming cancelled us while parked on an empty
+                    # queue: give the permit back or every pause/resume
+                    # cycle would shrink the effective prefetch by one
+                    sem.release()
+                    raise
                 delivery = _MemoryDelivery(msg, self._broker, queue, sem)
 
                 async def _run(d: _MemoryDelivery = delivery) -> None:

@@ -26,12 +26,17 @@ Capability-equivalent to /root/reference/lib/main.js:40-205:
 from __future__ import annotations
 
 import asyncio
-import datetime
 import os
+import shutil
 import time
 from typing import Dict, List, Optional
 
-from . import schemas
+from . import control, schemas
+from .control.cancel import CancelToken, JobCancelled
+from .control.registry import JobRecord, JobRegistry
+from .control.scheduler import (PriorityScheduler, aging_from_config,
+                                backlog_from_config, priority_name,
+                                priority_rank)
 from .mq.base import Delivery, MessageQueue
 from .platform.config import cfg_get
 from .platform.logging import Logger, get_logger
@@ -40,18 +45,30 @@ from .platform.telemetry import NullTelemetry, Telemetry
 from .platform.tracing import (NullTracer, Tracer, format_traceparent,
                                parse_traceparent)
 from .stages.base import STAGES, Job, StageContext, load_stages
+from .stages.download import job_download_dir
 from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
 from .store.cache import ContentCache
-from .utils import EventEmitter
+from .utils import EventEmitter, utcnow_iso as _utcnow_iso
 
 
-def _utcnow_iso() -> str:
-    return (
-        datetime.datetime.now(datetime.timezone.utc)
-        .isoformat(timespec="milliseconds")
-        .replace("+00:00", "Z")
-    )
+class _RecordingTelemetry:
+    """Per-job telemetry facade: forwards to the real client while
+    sampling progress percent into the job's registry record, so
+    ``GET /v1/jobs/{id}`` shows live progress without a new event path."""
+
+    def __init__(self, inner: Telemetry, record: JobRecord):
+        self._inner = inner
+        self._record = record
+
+    async def emit_status(self, media_id: str, status: int) -> None:
+        await self._inner.emit_status(media_id, status)
+
+    async def emit_progress(self, media_id: str, status: int,
+                            percent: int) -> None:
+        if media_id == self._record.job_id:
+            self._record.note_progress(percent)
+        await self._inner.emit_progress(media_id, status, percent)
 
 
 class Orchestrator:
@@ -104,6 +121,29 @@ class Orchestrator:
         if prefetch < 1:
             raise ValueError(f"max_concurrent_jobs must be >= 1, got {prefetch}")
         self.prefetch = prefetch
+
+        # control plane (control/): every delivery is registered at
+        # receipt and steered through the lifecycle state machine;
+        # admitted jobs take a run slot from the priority scheduler.
+        # scheduler_backlog > 0 widens the consumer prefetch past the run
+        # slots so the scheduler has deliveries to reorder (default 0 =
+        # exact pre-control-plane behavior).
+        self.registry = JobRegistry(metrics=metrics, logger=self.logger)
+        self.scheduler = PriorityScheduler(
+            prefetch, aging_seconds=aging_from_config(config)
+        )
+        self.consumer_prefetch = prefetch + backlog_from_config(config)
+        # intake pause (POST /v1/intake/pause | /v1/drain): stop pulling
+        # deliveries without dropping in-flight work; /readyz -> 503
+        self.intake_paused = False
+        # telemetry status emitted for a cancelled job: CANCELLED (=7) by
+        # default; config `control.errored_on_cancel: true` keeps legacy
+        # consumers that only know the reference's enum range on ERRORED
+        self._cancel_status = schemas.TelemetryStatus.Value(
+            "ERRORED"
+            if cfg_get(config, "control.errored_on_cancel", False)
+            else "CANCELLED"
+        )
 
         # content-addressed staging cache (store/cache.py): shared with
         # the download stage via stage_resources, consulted by the
@@ -160,10 +200,55 @@ class Orchestrator:
         except NotImplementedError:
             self._convert_fanout = False
         await self.mq.listen(
-            schemas.DOWNLOAD_QUEUE, self.processor, prefetch=self.prefetch
+            schemas.DOWNLOAD_QUEUE, self.processor,
+            prefetch=self.consumer_prefetch,
         )
         self.consuming = True
         self.logger.info("successfully connected to queue")
+
+    # -- control plane: intake steering --------------------------------
+    async def pause_intake(self) -> None:
+        """Stop pulling deliveries; in-flight jobs keep running.
+
+        The prefetch window's unsettled deliveries stay assigned to this
+        worker (they are already in ``processor``); nothing new arrives
+        until :meth:`resume_intake`.  ``/readyz`` answers 503 while
+        paused so load-aware orchestrators stop routing to the replica.
+        """
+        if self.intake_paused:
+            return
+        # consumers first, flag after: if the broker-side cancel fails
+        # (AMQP stop_consuming propagates protocol errors on a healthy
+        # connection), the pause must FAIL — reporting "paused" while
+        # deliveries still flow would make /v1/drain lie to operators
+        await self.mq.stop_consuming()
+        self.intake_paused = True
+        self.logger.info("intake paused")
+
+    async def resume_intake(self) -> None:
+        if not self.intake_paused:
+            return
+        await self.mq.resume_consuming()
+        self.intake_paused = False
+        self.logger.info("intake resumed")
+
+    async def drain(self, grace_seconds: float = 30.0) -> bool:
+        """Pause intake and wait (bounded) for in-flight jobs to settle.
+
+        The programmatic form of :meth:`shutdown`'s grace loop, minus the
+        teardown: the service stays up (resumable) after a drain.
+        Returns True when everything settled within the grace period.
+        """
+        await self.pause_intake()
+        try:
+            async with asyncio.timeout(grace_seconds):
+                while self.active_jobs:
+                    await asyncio.sleep(0.05)
+        except TimeoutError:
+            self.logger.warn("drain grace period expired with active jobs",
+                             active=len(self.active_jobs))
+            return False
+        return True
 
     async def shutdown(self, grace_seconds: float = 30.0) -> None:
         """Stop consuming; wait for in-flight jobs to settle.
@@ -173,7 +258,13 @@ class Orchestrator:
         first, then actually drain the in-flight jobs.
         """
         self.consuming = False
-        await self.mq.stop_consuming()
+        try:
+            await self.mq.stop_consuming()
+        except Exception as err:
+            # shutdown is best-effort here: close() below tears down the
+            # connection (and any consumer with it) regardless
+            self.logger.warn("stop_consuming failed during shutdown",
+                             error=str(err))
         try:
             async with asyncio.timeout(grace_seconds):
                 while self.active_jobs:
@@ -196,9 +287,22 @@ class Orchestrator:
     # ------------------------------------------------------------------
     async def processor(self, delivery: Delivery) -> None:
         """Handle one ``v1.download`` delivery (reference lib/main.js:62-170)."""
-        msg = schemas.decode(schemas.Download, delivery.body)
+        try:
+            msg = schemas.decode(schemas.Download, delivery.body)
+        except Exception as err:
+            # malformed delivery: ack + count instead of letting the
+            # decode error escape the handler — both MQ backends would
+            # nack-requeue it and hot-loop forever (the poison guard
+            # needs a job id a body that can't decode can never provide)
+            self.logger.error("dropping malformed delivery",
+                              error=str(err), bytes=len(delivery.body))
+            if self.metrics is not None:
+                self.metrics.jobs_failed.labels(reason="malformed").inc()
+            await delivery.ack()
+            return
         file_id = msg.media.creator_id  # (reference lib/main.js:64)
         job_id = msg.media.id           # (reference lib/main.js:65)
+        priority = priority_name(msg.priority)
 
         if self.metrics is not None:
             self.metrics.jobs_consumed.inc()
@@ -206,17 +310,13 @@ class Orchestrator:
         job_entry = {"cardId": file_id, "jobId": job_id}
         child = self.logger.child(jobId=job_id, fileId=file_id)
 
-        # admission control: a new job only starts once the cache volume
-        # has its configured disk headroom — LRU entries are evicted to
-        # make room, and if nothing is evictable the job waits (bounded)
-        # for in-flight work to free space.  The delivery stays unsettled
-        # while we wait, so the broker's prefetch window provides the
-        # backpressure.
-        await self._admit_job(child)
-
-        # all bookkeeping after this point is undone in the finally, so a
-        # failure anywhere (even in the status emit) can't leak the gauge or
-        # the active-jobs entry
+        # registered + counted from RECEIPT: a job waiting in admission
+        # or the priority queue is visible to /health, GET /v1/jobs,
+        # drain, and shutdown (pre-control-plane blind spot).  All
+        # bookkeeping after this point is undone in the finally, so a
+        # failure anywhere can't leak the gauge or the active-jobs entry.
+        record = self.registry.register(job_id, file_id, priority=priority)
+        token = record.cancel
         self.active_jobs.append(job_entry)
         if self.metrics is not None:
             self.metrics.jobs_active.inc()
@@ -224,9 +324,29 @@ class Orchestrator:
         # creator/file id (lib/main.js:81), which collides when two jobs from
         # the same creator run concurrently
         emitter = self.emitter_table[job_id] = EventEmitter()
+        granted = False
 
         try:
-            # set DOWNLOADING status (reference lib/main.js:68)
+            # admission control: a new job only starts once the cache
+            # volume has its configured disk headroom — LRU entries are
+            # evicted to make room, and if nothing is evictable the job
+            # waits (bounded) for in-flight work to free space.  The
+            # delivery stays unsettled while we wait, so the broker's
+            # prefetch window provides the backpressure.  The token
+            # guard makes a parked job cancellable.
+            await token.guard(self._admit_job(child))
+            self.registry.transition(record, control.ADMITTED)
+            # priority scheduling: wait for one of the run slots, queued
+            # by class (HIGH before NORMAL before BULK) with aging
+            await token.guard(
+                self.scheduler.acquire(priority_rank(priority))
+            )
+            granted = True
+            # set DOWNLOADING status (reference lib/main.js:68) — only
+            # once the job actually holds a run slot: a job parked in
+            # admission or the priority queue must not tell telemetry
+            # consumers it is transferring (its queued/admitted state is
+            # visible via GET /v1/jobs instead)
             await self.telemetry.emit_status(
                 job_id, schemas.TelemetryStatus.Value("DOWNLOADING")
             )
@@ -236,8 +356,13 @@ class Orchestrator:
             remote = parse_traceparent(delivery.headers.get("traceparent"))
             with self.tracer.span("job", remote_parent=remote,
                                   jobId=job_id, fileId=file_id):
-                await self._run_job(msg, delivery, child, emitter)
+                await self._run_job(msg, delivery, child, emitter,
+                                    record, token)
+        except JobCancelled:
+            await self._settle_cancelled(msg, delivery, child, record, token)
         finally:
+            if granted:
+                self.scheduler.release()
             # remove the finished job (fixes reference lib/main.js:169,
             # which called Array.slice — a no-op — so activeJobs only grew)
             try:
@@ -247,6 +372,47 @@ class Orchestrator:
             self.emitter_table.pop(job_id, None)
             if self.metrics is not None:
                 self.metrics.jobs_active.dec()
+            if not record.terminal:
+                # the handler unwound without settling the record (an
+                # unexpected error, or task teardown at shutdown): the
+                # MQ layer requeues the delivery; close this record
+                self.registry.transition(record, control.FAILED,
+                                         reason="handler_exit")
+
+    async def _settle_cancelled(self, msg: schemas.Download,
+                                delivery: Delivery, logger: Logger,
+                                record: JobRecord,
+                                token: CancelToken) -> None:
+        """Settle a cooperatively-cancelled job.
+
+        ``ack`` (an operator decision is final — no requeue), telemetry
+        CANCELLED (or ERRORED under ``control.errored_on_cancel``),
+        partial staging files removed, registry record closed.  A
+        cancelled singleflight leader already rejected its flight on the
+        way here, so coalesced waiters have failed over.
+        """
+        job_id = msg.media.id
+        logger.warn("job cancelled", reason=token.reason or "cancelled")
+        # the job owns <download_path>/<id>: remove partial files BEFORE
+        # settling, so "delivery settled" implies "disk reclaimed" (the
+        # cancel-latency bench and any operator automation can treat the
+        # ack as the single completion signal)
+        try:
+            await asyncio.to_thread(
+                shutil.rmtree, job_download_dir(self.config, job_id), True
+            )
+        except OSError as err:
+            logger.warn("cancelled-job cleanup failed", error=str(err))
+        await delivery.ack()
+        self._failure_counts.pop(job_id, None)
+        if self.metrics is not None:
+            self.metrics.jobs_cancelled.inc()
+        try:
+            await self.telemetry.emit_status(job_id, self._cancel_status)
+        except Exception as err:
+            logger.warn("cancel status emit failed", error=str(err))
+        self.registry.transition(record, control.CANCELLED,
+                                 reason=token.reason or "cancelled")
 
     async def _admit_job(self, logger: Logger) -> None:
         """Gate job start on cache-volume disk headroom.
@@ -288,6 +454,8 @@ class Orchestrator:
         delivery: Delivery,
         logger: Logger,
         emitter: EventEmitter,
+        record: JobRecord,
+        token: CancelToken,
     ) -> None:
         job_id = msg.media.id
 
@@ -296,12 +464,14 @@ class Orchestrator:
             config=self.config,
             emitter=emitter,
             logger=logger,
-            telemetry=self.telemetry,
+            telemetry=_RecordingTelemetry(self.telemetry, record),
             metrics=self.metrics,
             store=self.store,
             tracer=self.tracer,
             resources=self.stage_resources,
             cleanups=self.stage_cleanups,
+            cancel=token,
+            record=record,
         )
         stage_table = await load_stages(ctx, self.stage_names)
 
@@ -318,11 +488,20 @@ class Orchestrator:
             last_stage_data: object = {}
             try:
                 for name in self.stage_names:
+                    self.registry.transition(record, control.RUNNING,
+                                             stage=name)
+                    token.raise_if_cancelled()
                     job = Job(media=msg.media, last_stage=last_stage_data)
                     logger.info("invoking stage", stage=name)
                     started = time.monotonic()
                     try:
-                        last_stage_data = await stage_table[name](job)
+                        # the guard bounds the whole stage dispatch by the
+                        # cancel token: even a stage blocked somewhere
+                        # without a cooperative check (DNS, TLS
+                        # handshake, a wedged origin) unwinds promptly
+                        last_stage_data = await token.guard(
+                            stage_table[name](job)
+                        )
                     finally:
                         if self.metrics is not None:
                             self.metrics.stage_seconds.labels(stage=name).observe(
@@ -333,6 +512,8 @@ class Orchestrator:
                     # codebase, and forwarding a hardcoded 0 to telemetry
                     # would reset real stage progress — deliberately dropped
                     # (PARITY.md "Reference bugs fixed").
+            except JobCancelled:
+                raise  # settled by the processor (ack, cleanup, CANCELLED)
             except Exception as err:
                 logger.error("failed to invoke stage", error=str(err))
 
@@ -342,6 +523,8 @@ class Orchestrator:
                         self.metrics.jobs_failed.labels(reason="stalled").inc()
                     self._failure_counts.pop(job_id, None)  # job is settled
                     await delivery.ack()
+                    self.registry.transition(record, control.FAILED,
+                                             reason="stalled")
                     return
 
                 # anything else -> ERRORED + redelivery
@@ -371,10 +554,14 @@ class Orchestrator:
                         self.metrics.jobs_failed.labels(reason="poison").inc()
                     self._failure_counts.pop(job_id, None)
                     await delivery.ack()
+                    self.registry.transition(record, control.DROPPED_POISON,
+                                             reason=f"{failures} failures")
                     return
                 if self.metrics is not None:
                     self.metrics.jobs_failed.labels(reason="stage_error").inc()
                 await delivery.nack()
+                self.registry.transition(record, control.FAILED,
+                                         reason="stage_error")
                 return
             logger.info("creating convert job")
         else:
@@ -383,7 +570,10 @@ class Orchestrator:
                 self.metrics.jobs_skipped.inc()
 
         # publish the convert message even when staging was skipped
-        # (reference lib/main.js:153-167)
+        # (reference lib/main.js:153-167).  Cancellation past this point
+        # is a no-op by design: the bytes are staged and the cheapest
+        # path for everyone is finishing the publish.
+        self.registry.transition(record, control.PUBLISHING)
         payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
         try:
             # carry the job span's context to the downstream converter so
@@ -414,6 +604,8 @@ class Orchestrator:
             # retry skip straight to re-publishing the convert message
             logger.error("failed to create job", error=str(err))
             await delivery.nack()
+            self.registry.transition(record, control.FAILED,
+                                     reason="publish_error")
             return
 
         await delivery.ack()
@@ -422,3 +614,4 @@ class Orchestrator:
         self._failure_counts.pop(job_id, None)
         if self.metrics is not None:
             self.metrics.jobs_completed.inc()
+        self.registry.transition(record, control.DONE)

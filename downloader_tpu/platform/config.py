@@ -38,7 +38,20 @@ DEFAULTS: dict = {
         # (CACHE_MIN_FREE_BYTES).
         # "cache": {"enabled": True, "path": "...", "max_bytes": ...,
         #           "min_free_bytes": ...},
+        #
+        # Control plane (control/):
+        # "scheduler_backlog": 0,        # extra consumer-prefetch
+        #     deliveries held for priority reordering (SCHEDULER_BACKLOG;
+        #     0 = FIFO parity, nothing to reorder)
+        # "scheduler_aging_seconds": 60, # starvation bump: one priority
+        #     class per interval waited (SCHEDULER_AGING_SECONDS)
+        # "upload_rate_limit": 0,        # bytes/s egress cap to the
+        #     staging store (mirror of download_rate_limit; 0=unlimited)
     },
+    # Control-plane admin API (control/api.py, mounted on the health
+    # port): "control": {"token": "..."} — bearer token gating the
+    # mutating endpoints (env CONTROL_TOKEN); "errored_on_cancel": True
+    # keeps legacy telemetry consumers on ERRORED instead of CANCELLED.
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),
         "access_key": os.environ.get("MINIO_ACCESS_KEY", ""),

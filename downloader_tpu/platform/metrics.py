@@ -57,6 +57,24 @@ class Metrics:
             "Jobs currently being processed",
             registry=self.registry,
         )
+        self.jobs_cancelled = Counter(
+            f"{ns}_jobs_cancelled_total",
+            "Jobs cancelled via the control plane (acked, not requeued)",
+            registry=self.registry,
+        )
+        self.jobs_by_state = Gauge(
+            f"{ns}_jobs_by_state",
+            "Jobs known to the control-plane registry, by lifecycle state "
+            "(live + the bounded terminal ring)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.job_state_transitions = Counter(
+            f"{ns}_job_state_transitions_total",
+            "Control-plane registry lifecycle transitions",
+            ["from_state", "to_state"],
+            registry=self.registry,
+        )
         self.stage_seconds = Histogram(
             f"{ns}_stage_seconds",
             "Wall-clock seconds per pipeline stage",

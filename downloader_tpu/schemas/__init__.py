@@ -19,6 +19,7 @@ from google.protobuf.message import Message
 from .downloader_pb2 import (  # noqa: F401  (re-exported)
     Convert,
     Download,
+    JobPriority,
     Media,
     MediaType,
     SourceType,

@@ -20,6 +20,7 @@ import importlib
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from .. import schemas
+from ..control.cancel import CancelToken
 from ..platform.logging import Logger
 from ..platform.telemetry import NullTelemetry, Telemetry
 from ..platform.tracing import NullTracer, Tracer
@@ -66,6 +67,15 @@ class StageContext:
     # that run once at orchestrator shutdown.
     resources: dict = dataclasses.field(default_factory=dict)
     cleanups: list = dataclasses.field(default_factory=list)
+    # Cooperative cancellation (control/cancel.py): the orchestrator
+    # passes the job's token; stages check it in their chunk/file loops
+    # (``ctx.cancel.raise_if_cancelled()``).  Standalone stage use gets a
+    # fresh never-fired token, so the checks are always safe to call.
+    cancel: CancelToken = dataclasses.field(default_factory=CancelToken)
+    # The job's control-plane registry record (control/registry.py), for
+    # byte-counter sampling (``record.add_bytes``); None outside the
+    # orchestrator.
+    record: Any = None
 
 StageFn = Callable[[Job], Awaitable[Any]]
 StageFactory = Callable[[StageContext], Awaitable[StageFn]]

@@ -237,6 +237,19 @@ def choose_validator(headers) -> "str | None":
     return None
 
 
+def job_download_dir(config, media_id: str) -> str:
+    """The per-job workdir ``<instance.download_path>/<media.id>``, with
+    relative paths resolved against the repo root exactly like the stage
+    itself resolves them (reference lib/download.js:234-240).  Shared
+    with the orchestrator's cancelled-job cleanup so both sides always
+    name the same directory."""
+    configured = getattr(
+        getattr(config, "instance", None), "download_path", "downloading"
+    )
+    prefix = "" if os.path.isabs(configured) else _REPO_ROOT
+    return os.path.join(prefix, configured, media_id)
+
+
 def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
                        ssl: bool = True):
     """Default factory for the ``bucket`` method's ad-hoc client
@@ -270,12 +283,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     telemetry = ctx.telemetry
     downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
     bucket_client_factory = getattr(ctx, "bucket_client_factory", None) or make_bucket_client
+    # cooperative cancellation (control/cancel.py): checked at every
+    # chunk/piece loop below so a cancelled job unwinds within one chunk
+    cancel = ctx.cancel
 
     # service-wide ingress cap (bytes/s), shared by every job's transfers
-    # regardless of protocol; unset = unlimited (reference behavior)
-    from ..utils.ratelimit import bucket_from_config
+    # regardless of protocol; unset = unlimited (reference behavior).
+    # Memoized across jobs via ctx.resources so concurrency can't
+    # multiply the cap.
+    from ..utils.ratelimit import shared_bucket
 
-    limiter = bucket_from_config(ctx.config, "download_rate_limit")
+    limiter = shared_bucket(ctx.resources, ctx.config, "download_rate_limit")
 
     # Parallel ranged HTTP: HTTP_SEGMENTS / instance.http_segments
     # connections per download (default 1 = the reference's single
@@ -435,7 +453,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             on_progress=on_progress,
             seed_linger=seed_linger,
             stats_out=stats,
+            cancel=cancel,
         )
+        if ctx.record is not None and stats:
+            ctx.record.add_bytes(
+                "downloaded",
+                stats.get("bytes_from_peers", 0)
+                + stats.get("bytes_from_webseeds", 0),
+            )
         if ctx.metrics is not None and stats:
             m = ctx.metrics
             m.bytes_downloaded.labels(protocol="torrent-peer").inc(
@@ -651,6 +676,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 except OSError:
                     pass  # pipe stays at the kernel default: just slower
                 while remaining > 0:
+                    cancel.raise_if_cancelled()
                     fut = asyncio.ensure_future(asyncio.to_thread(
                         _splice_slice_blocking, sock_fd, pipe_r, pipe_w,
                         out_dup, min(remaining, _SPLICE_SLICE),
@@ -710,6 +736,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if use_splice:
                     return await _splice_body(resp, fh.fileno())
                 async for raw in resp.content.iter_any():
+                    cancel.raise_if_cancelled()
                     if limiter is not None:
                         await limiter.consume(len(raw))
                     # watchdog tracks raw network progress; ``total`` counts
@@ -848,6 +875,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
             async def _segment(seg) -> None:
                 while seg[1] < seg[2]:
+                    cancel.raise_if_cancelled()
                     before = seg[1]
                     headers = {
                         **base_headers,
@@ -881,6 +909,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             seg[1] += got
                         else:
                             async for raw in resp.content.iter_any():
+                                cancel.raise_if_cancelled()
                                 if limiter is not None:
                                     await limiter.consume(len(raw))
                                 fetched[0] += len(raw)
@@ -1012,6 +1041,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # loops until the entity is complete; every round must
                 # advance the offset or the attempt errors out
                 while True:
+                    cancel.raise_if_cancelled()
                     offset = (
                         os.path.getsize(partial)
                         if os.path.exists(partial)
@@ -1099,6 +1129,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     return fetched[0]
 
         total = await watchdog.watch(_fetch())
+        if ctx.record is not None:
+            ctx.record.add_bytes("downloaded", total)
         if ctx.metrics is not None:
             ctx.metrics.bytes_downloaded.labels(protocol="http").inc(total)
 
@@ -1135,6 +1167,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             prefix = sub_folder.rstrip("/") + "/"
             total = 0
             async for item in client.list_objects(params["bucket"], prefix):
+                cancel.raise_if_cancelled()
                 if not item.name:
                     continue
                 # strip the subFolder prefix from the local path
@@ -1151,6 +1184,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 logger.info("bucket fetch", object=item.name, to=local)
                 await client.fget_object(params["bucket"], item.name, local)
                 total += item.size
+            if ctx.record is not None:
+                ctx.record.add_bytes("downloaded", total)
             if ctx.metrics is not None:
                 ctx.metrics.bytes_downloaded.labels(protocol="bucket").inc(total)
         finally:
@@ -1328,10 +1363,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     async def download(job: Job):
         media = job.media
         file_id = media.id
+        cancel.raise_if_cancelled()
 
-        configured = ctx.config.instance.download_path
-        prefix = "" if os.path.isabs(configured) else _REPO_ROOT
-        download_path = os.path.join(prefix, configured, file_id)
+        download_path = job_download_dir(ctx.config, file_id)
 
         url = media.source_uri
         protocol = schemas.enum_to_string(schemas.SourceType, media.source)

@@ -131,6 +131,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     exts = MEDIA_EXTS | {".y4m"} if upscale_enabled(ctx.config) else MEDIA_EXTS
 
     async def process(job: Job):
+        # cooperative cancellation: the walk itself is fast local I/O,
+        # so one check before it starts is the stage's whole window
+        ctx.cancel.raise_if_cancelled()
         last = job.last_stage
         download_path = last["path"] if isinstance(last, dict) else last.path
         logger.info("processing directory", path=download_path)

@@ -79,6 +79,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         raise ValueError("upload stage requires a StageContext.store")
     downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
 
+    # service-wide egress cap (bytes/s) to the staging store, the mirror
+    # of the download stage's ingress bucket: ONE bucket shared by every
+    # job's uploads (memoized in the cross-job ctx.resources), so MinIO
+    # egress is cappable per instance
+    # (``instance.upload_rate_limit`` / 0 = unlimited, parity default)
+    from ..utils.ratelimit import shared_bucket
+
+    limiter = shared_bucket(ctx.resources, ctx.config, "upload_rate_limit")
+
     async def upload(job: Job):
         last = job.last_stage
         files = last["files"] if isinstance(last, dict) else last.files
@@ -99,6 +108,10 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 await store.make_bucket(STAGING_BUCKET)
 
             for i, file_path in enumerate(files, start=1):
+                # cooperative cancellation at the per-file loop: already
+                # staged files stay staged (redelivery/resume semantics
+                # are unchanged), the current file simply never starts
+                ctx.cancel.raise_if_cancelled()
                 logger.info("upload", file=os.path.basename(file_path))
                 if not os.path.exists(file_path):
                     logger.error("failed to upload file, not found", file=file_path)
@@ -121,6 +134,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     # ingest it by hardlink instead of a byte copy
                     await store.fput_object(
                         STAGING_BUCKET, name, file_path, consume=True)
+                    if limiter is not None:
+                        # charge AFTER the successful put: consume()
+                        # deducts immediately and sleeps off the deficit,
+                        # pacing the AVERAGE egress rate without hooks
+                        # inside the store client's transfer loop.
+                        # Charging up front would strand service-wide
+                        # tokens for bytes that never moved whenever a
+                        # job is cancelled or the put fails mid-wait —
+                        # debt every OTHER job would then sleep off.
+                        await limiter.consume(size)
+                    if ctx.record is not None:
+                        ctx.record.add_bytes("uploaded", size)
                     if ctx.metrics is not None:
                         ctx.metrics.bytes_uploaded.inc(size)
 

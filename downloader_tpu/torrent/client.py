@@ -259,6 +259,7 @@ class TorrentClient:
         listen_host: str = "0.0.0.0",
         seed_linger: float = 0.0,
         stats_out: Optional[dict] = None,
+        cancel=None,
     ) -> Metainfo:
         """Fetch the torrent behind ``uri`` into ``download_path``.
 
@@ -271,6 +272,13 @@ class TorrentClient:
         AFTER the download completes (in the background — this call still
         returns immediately), so sibling replicas mid-download don't lose
         their source; :meth:`close` reaps lingering servers early.
+
+        ``cancel`` is an optional control-plane token
+        (:class:`~..control.cancel.CancelToken`): the drive loop checks
+        it between piece batches, so a cancelled job stops requesting
+        pieces within one scheduling tick and unwinds through the same
+        orderly teardown as any other drive error (fast-resume sidecar
+        saved, workers gathered, storage closed).
         """
         meta, peers = await self._resolve(uri, peers, metadata_timeout)
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
@@ -320,7 +328,8 @@ class TorrentClient:
         try:
             await watchdog.watch(
                 self._drive(swarm, storage, peers or [], webseeds, server,
-                            progress_interval, on_progress, watchdog)
+                            progress_interval, on_progress, watchdog,
+                            cancel=cancel)
             )
             completed = True
         finally:
@@ -420,7 +429,7 @@ class TorrentClient:
                      peers: List[tracker_mod.Peer], webseeds: List[str],
                      server, progress_interval: float,
                      on_progress: Optional[ProgressCb],
-                     watchdog: StallWatchdog) -> None:
+                     watchdog: StallWatchdog, cancel=None) -> None:
         """Run the download: a dynamic worker pool (seeded from trackers/
         DHT/x.pe, grown from ut_pex gossip), HAVE re-broadcast of finished
         pieces, and a best-effort DHT announce of our serving socket."""
@@ -443,6 +452,11 @@ class TorrentClient:
         announced = set(swarm.done)  # resume pieces are in the bitfield
         try:
             while not swarm.complete:
+                # cooperative cancellation, between piece batches: the
+                # workers' in-flight block requests die with the cancel
+                # in the finally below
+                if cancel is not None:
+                    cancel.raise_if_cancelled()
                 # grow the pool from ut_pex gossip
                 while not swarm.discovered.empty():
                     host, port = swarm.discovered.get_nowait()

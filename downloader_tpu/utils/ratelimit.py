@@ -56,7 +56,9 @@ def bucket_from_config(config, key: str) -> Optional[TokenBucket]:
     uncapped — an operator who set a cap must not get unlimited ingress
     because of a typo like ``"128k"``.
     """
-    raw = getattr(config.instance, key, None)
+    from ..platform.config import cfg_get
+
+    raw = cfg_get(config, f"instance.{key}", None)
     if raw in (None, "", 0):
         return None
     try:
@@ -70,3 +72,19 @@ def bucket_from_config(config, key: str) -> Optional[TokenBucket]:
     if rate == 0:
         return None
     return TokenBucket(rate)
+
+
+def shared_bucket(resources: dict, config, key: str) -> Optional[TokenBucket]:
+    """Per-SERVICE bucket memoized in the cross-job ``resources`` dict.
+
+    Stage factories run once per job, so a bucket built inline there
+    would be per-job — N concurrent jobs would each get the full rate,
+    multiplying the configured cap by the concurrency.  Memoizing under
+    the orchestrator's shared ``stage_resources`` makes the cap genuinely
+    per instance (standalone stage use, with a fresh resources dict per
+    context, degrades to per-context — the same scope as before).
+    """
+    cache_key = f"rate_limiter:{key}"
+    if cache_key not in resources:
+        resources[cache_key] = bucket_from_config(config, key)
+    return resources[cache_key]

@@ -70,3 +70,13 @@ class StallWatchdog:
                     await task
                 except (asyncio.CancelledError, Exception):
                     pass
+            else:
+                # unwinding abnormally (external cancel) with the inner
+                # task already settled: retrieve its exception so a
+                # simultaneous inner error (e.g. a cooperative
+                # JobCancelled racing the cancel) isn't logged as a
+                # never-retrieved task exception
+                try:
+                    task.exception()
+                except (asyncio.CancelledError, Exception):
+                    pass

@@ -1,0 +1,25 @@
+"""Test-only stage that records start order and parks on a gate event.
+
+Used by the control-plane tests: the first job occupies the run slot
+until the test releases GATE, so later deliveries pile up in the
+priority scheduler and their start ORDER becomes observable.
+"""
+
+ORDER = []
+GATE = None  # test installs an asyncio.Event (or leaves None = no wait)
+
+
+def reset():
+    global GATE
+    ORDER.clear()
+    GATE = None
+
+
+async def stage_factory(ctx):
+    async def run(job):
+        ORDER.append(job.media.id)
+        if GATE is not None:
+            await GATE.wait()
+        return {}
+
+    return run

@@ -1,0 +1,737 @@
+"""Control-plane tests: registry state machine, cooperative cancellation,
+admin API (jobs/cancel/pause/drain), priority scheduling, and the
+malformed-delivery guard.
+
+The acceptance slice: an in-flight download-stage job cancelled through
+``POST /v1/jobs/{id}/cancel`` settles its delivery without requeue,
+leaves no partial files in the staging dir, and shows ``CANCELLED`` in
+``GET /v1/jobs/{id}`` — against the in-memory broker + MiniS3.
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+from minis3 import MiniS3
+
+from downloader_tpu import schemas
+from downloader_tpu.control.cancel import CancelToken, JobCancelled
+from downloader_tpu.control.registry import (
+    ADMITTED, CANCELLED, DONE, DROPPED_POISON, FAILED, PUBLISHING, RECEIVED,
+    RUNNING, IllegalTransition, JobRegistry,
+)
+from downloader_tpu.control.scheduler import PriorityScheduler, priority_rank
+from downloader_tpu.health import build_app
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import STATUS_QUEUE, Telemetry
+from downloader_tpu.stages.base import Job, StageContext, register_stage
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store.s3 import S3ObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# Registry state machine
+# ---------------------------------------------------------------------------
+
+def test_registry_legal_walk_and_timing():
+    registry = JobRegistry()
+    record = registry.register("j1", "card-1", priority="HIGH")
+    assert record.state == RECEIVED
+    registry.transition(record, ADMITTED)
+    registry.transition(record, RUNNING, stage="download")
+    registry.transition(record, RUNNING, stage="process")
+    registry.transition(record, RUNNING, stage="upload")
+    registry.transition(record, PUBLISHING)
+    registry.transition(record, DONE)
+    assert record.terminal
+    assert set(record.stage_seconds) == {"download", "process", "upload"}
+    # terminal record keeps the last stage it entered for inspection
+    assert record.stage == "upload"
+    assert registry.get("j1") is record
+    assert registry.counts() == {DONE: 1}
+
+
+def test_registry_idempotent_skip_path():
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    registry.transition(record, ADMITTED)
+    registry.transition(record, PUBLISHING)  # done marker already staged
+    registry.transition(record, DONE)
+    assert record.state == DONE
+
+
+@pytest.mark.parametrize("walk,bad", [
+    ([], PUBLISHING),                       # RECEIVED -> PUBLISHING
+    ([], DONE),                             # RECEIVED -> DONE
+    ([], RUNNING),                          # RECEIVED -> RUNNING (skips gate)
+    ([ADMITTED, RUNNING, FAILED], RUNNING),  # out of terminal
+    ([ADMITTED, PUBLISHING, DONE], CANCELLED),
+    ([ADMITTED], DROPPED_POISON),           # poison only from RUNNING
+])
+def test_registry_illegal_transitions_raise(walk, bad):
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    for state in walk:
+        registry.transition(record, state)
+    with pytest.raises(IllegalTransition):
+        registry.transition(record, bad)
+
+
+def test_registry_unknown_state_raises():
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    with pytest.raises(IllegalTransition):
+        registry.transition(record, "LIMBO")
+
+
+def test_registry_terminal_ring_is_bounded():
+    registry = JobRegistry(terminal_ring=4)
+    for i in range(10):
+        record = registry.register(f"j{i}", "c")
+        registry.transition(record, FAILED, reason="test")
+    assert len(registry.jobs()) == 4
+    # oldest evicted, newest kept
+    assert registry.get("j0") is None
+    assert registry.get("j9") is not None
+    assert registry.counts() == {FAILED: 4}
+
+
+def test_registry_cancel_only_fires_live_records():
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    fired = registry.cancel("j1", reason="op")
+    assert fired == [record]
+    assert record.cancel.cancelled and record.cancel.reason == "op"
+    assert record.state == RECEIVED  # state moves only when the job settles
+    # second cancel is a no-op; unknown job fires nothing
+    assert registry.cancel("j1") == []
+    assert registry.cancel("nope") == []
+    registry.transition(record, CANCELLED, reason="op")
+    assert registry.cancel("j1") == []  # terminal: nothing live to fire
+
+
+def test_registry_metrics_gauge_and_transitions():
+    metrics = prom.new(f"ctl{os.urandom(3).hex()}")
+    registry = JobRegistry(metrics=metrics, terminal_ring=1)
+    a = registry.register("a", "c")
+    b = registry.register("b", "c")
+    registry.transition(a, ADMITTED)
+    registry.transition(a, RUNNING, stage="download")
+    registry.transition(a, FAILED, reason="x")
+    registry.transition(b, FAILED, reason="x")  # evicts a from the ring
+
+    def gauge(state):
+        return metrics.jobs_by_state.labels(state=state)._value.get()
+
+    assert gauge(RECEIVED) == 0
+    assert gauge(FAILED) == 1  # ring holds only b
+    assert metrics.job_state_transitions.labels(
+        from_state=RECEIVED, to_state=ADMITTED)._value.get() == 1
+
+
+# ---------------------------------------------------------------------------
+# Cancel token
+# ---------------------------------------------------------------------------
+
+async def test_cancel_token_raise_and_guard():
+    token = CancelToken("j1")
+    token.raise_if_cancelled()  # live: no-op
+    assert await token.guard(asyncio.sleep(0, result=42)) == 42
+
+    async def fire_soon():
+        await asyncio.sleep(0.05)
+        token.cancel("test")
+
+    firer = asyncio.create_task(fire_soon())
+    with pytest.raises(JobCancelled) as err:
+        await token.guard(asyncio.sleep(30))
+    await firer
+    assert err.value.job_id == "j1" and err.value.reason == "test"
+    with pytest.raises(JobCancelled):
+        token.raise_if_cancelled()
+    # already-cancelled guard never runs the work
+    ran = []
+
+    async def work():
+        ran.append(1)
+
+    with pytest.raises(JobCancelled):
+        await token.guard(work())
+    assert ran == []
+
+
+async def test_cancel_token_guard_propagates_inner_error():
+    token = CancelToken("j1")
+
+    async def boom():
+        raise RuntimeError("inner")
+
+    with pytest.raises(RuntimeError, match="inner"):
+        await token.guard(boom())
+
+
+# ---------------------------------------------------------------------------
+# Priority scheduler
+# ---------------------------------------------------------------------------
+
+async def test_scheduler_grants_by_priority_class():
+    sched = PriorityScheduler(slots=1, aging_seconds=60.0)
+    await sched.acquire(priority_rank("NORMAL"))  # occupy the slot
+    order = []
+
+    async def worker(name, rank):
+        await sched.acquire(rank)
+        order.append(name)
+        sched.release()
+
+    tasks = []
+    for name, rank in [("bulk", 2), ("normal", 1), ("high", 0),
+                       ("high2", 0)]:
+        tasks.append(asyncio.create_task(worker(name, rank)))
+        await asyncio.sleep(0.01)  # deterministic enqueue order
+    assert sched.waiting == 4
+    sched.release()  # free the occupied slot -> cascade of grants
+    async with asyncio.timeout(5):
+        await asyncio.gather(*tasks)
+    assert order == ["high", "high2", "normal", "bulk"]
+
+
+async def test_scheduler_aging_beats_fresh_high_priority():
+    sched = PriorityScheduler(slots=1, aging_seconds=0.05)
+    await sched.acquire(0)  # occupy
+    order = []
+
+    async def worker(name, rank):
+        await sched.acquire(rank)
+        order.append(name)
+        sched.release()
+
+    bulk = asyncio.create_task(worker("bulk", 2))
+    await asyncio.sleep(0.2)  # bulk ages >= 3 classes
+    high = asyncio.create_task(worker("high", 0))
+    await asyncio.sleep(0.01)
+    sched.release()
+    async with asyncio.timeout(5):
+        await asyncio.gather(bulk, high)
+    assert order == ["bulk", "high"]
+
+
+async def test_scheduler_release_skips_cancelled_waiter_same_tick():
+    """A waiter cancelled in the same tick as a release (cancel token
+    guard racing a finishing job) must be dropped without consuming the
+    slot — set_result on its cancelled future would raise out of the
+    releasing job's finally and leak the slot forever."""
+    sched = PriorityScheduler(slots=1)
+    await sched.acquire(1)
+    task = asyncio.create_task(sched.acquire(1))
+    await asyncio.sleep(0.01)
+    task.cancel()        # future cancelled; waiter still queued
+    sched.release()      # same tick: must not raise, must keep the slot
+    await asyncio.gather(task, return_exceptions=True)
+    async with asyncio.timeout(1):
+        await sched.acquire(0)  # the slot is genuinely free
+
+
+async def test_scheduler_cancelled_waiter_releases_cleanly():
+    sched = PriorityScheduler(slots=1)
+    await sched.acquire(1)
+    task = asyncio.create_task(sched.acquire(1))
+    await asyncio.sleep(0.01)
+    assert sched.waiting == 1
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    assert sched.waiting == 0
+    sched.release()
+    # the slot is actually free again
+    async with asyncio.timeout(1):
+        await sched.acquire(0)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator wiring helpers
+# ---------------------------------------------------------------------------
+
+def make_download_msg(uri: str, job_id: str = "job-1",
+                      priority: str = "NORMAL") -> bytes:
+    return schemas.encode(
+        schemas.Download(
+            media=schemas.Media(
+                id=job_id,
+                creator_id="card-1",
+                name="A Show",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=uri,
+            ),
+            priority=schemas.JobPriority.Value(priority),
+        )
+    )
+
+
+async def make_orchestrator(tmp_path, broker, store, instance=None, **kwargs):
+    config_data = {"instance": {
+        "download_path": str(tmp_path / "downloads"),
+        **(instance or {}),
+    }}
+    mq = MemoryQueue(broker)
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode(config_data),
+        mq=mq,
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"ctl{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+        **kwargs,
+    )
+    await orchestrator.start()
+    return orchestrator
+
+
+async def serve_admin(orchestrator):
+    """Run the health+control app on an ephemeral port; returns
+    (session, base_url, cleanup coroutine fn)."""
+    import aiohttp
+
+    app = build_app(orchestrator, orchestrator.metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    session = aiohttp.ClientSession()
+
+    async def cleanup():
+        await session.close()
+        await runner.cleanup()
+
+    return session, f"http://127.0.0.1:{port}", cleanup
+
+
+async def start_slow_server(chunks=200, chunk=b"x" * 4096, delay=0.02,
+                            etag=None):
+    """A trickle HTTP server: GET streams chunked slowly (cancellable
+    mid-transfer); HEAD answers instantly (with a strong validator when
+    ``etag`` is set, so the content cache can key it)."""
+    gets = [0]
+
+    async def serve(request):
+        headers = {"ETag": etag} if etag else {}
+        if request.method == "HEAD":
+            return web.Response(headers=headers)
+        gets[0] += 1
+        resp = web.StreamResponse(headers=headers)
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        slow = gets[0] == 1  # later fetches (failover retries) are fast
+        for _ in range(chunks):
+            await resp.write(chunk)
+            if slow and delay:
+                await asyncio.sleep(delay)
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}", gets
+
+
+async def wait_for(predicate, timeout=10.0):
+    async with asyncio.timeout(timeout):
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Malformed-delivery guard
+# ---------------------------------------------------------------------------
+
+async def test_malformed_delivery_is_acked_not_requeued(tmp_path):
+    broker = InMemoryBroker()  # NO redelivery cap: a nack would hot-loop
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE, b"\xff\xff\xff\xff garbage")
+        async with asyncio.timeout(5):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert broker.idle(schemas.DOWNLOAD_QUEUE)
+        assert broker.dropped == []
+        assert orchestrator.metrics.jobs_failed.labels(
+            reason="malformed")._value.get() == 1
+        # never entered the registry (no job id to key it on)
+        assert orchestrator.registry.jobs() == []
+    finally:
+        await orchestrator.shutdown(grace_seconds=1)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: cancel an in-flight download via the admin API
+# ---------------------------------------------------------------------------
+
+async def test_cancel_inflight_download_via_api(tmp_path):
+    """POST /v1/jobs/{id}/cancel against a job mid-transfer: the delivery
+    settles without requeue, the staging dir holds no partial files, and
+    GET /v1/jobs/{id} reports CANCELLED — in-memory broker + MiniS3."""
+    runner, base, gets = await start_slow_server(chunks=2000, delay=0.02)
+    s3 = MiniS3()
+    await s3.start()
+    store = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/media.mkv", "job-c"))
+        # mid-transfer: the download stage is RUNNING and bytes flowed
+        await wait_for(lambda: (r := orchestrator.registry.get("job-c"))
+                       is not None and r.state == RUNNING)
+        await wait_for(lambda: gets[0] >= 1)
+        download_dir = tmp_path / "downloads" / "job-c"
+        await wait_for(lambda: download_dir.exists())
+
+        async with session.post(f"{api}/v1/jobs/job-c/cancel",
+                                json={"reason": "operator test"}) as resp:
+            assert resp.status == 202
+            body = await resp.json()
+            assert body["job"]["cancelRequested"] is True
+
+        # delivery settles (ack, no requeue), queue fully drains
+        async with asyncio.timeout(10):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert broker.idle(schemas.DOWNLOAD_QUEUE)
+        assert broker.depth(schemas.DOWNLOAD_QUEUE) == 0
+        assert broker.published(schemas.CONVERT_QUEUE) == []
+
+        # no partial files left in the staging dir
+        assert not download_dir.exists()
+
+        # the record is terminal CANCELLED, with the operator's reason
+        await wait_for(
+            lambda: orchestrator.registry.get("job-c").state == CANCELLED
+        )
+        async with session.get(f"{api}/v1/jobs/job-c") as resp:
+            assert resp.status == 200
+            job = await resp.json()
+        assert job["state"] == CANCELLED
+        assert job["reason"] == "operator test"
+        assert job["stage"] == "download"
+        assert orchestrator.metrics.jobs_cancelled._value.get() == 1
+
+        # telemetry announced the terminal CANCELLED status
+        statuses = [
+            schemas.decode(schemas.TelemetryStatusEvent, raw).status
+            for raw in broker.published(STATUS_QUEUE)
+        ]
+        assert schemas.TelemetryStatus.Value("CANCELLED") in statuses
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+        await store.close()
+        await s3.stop()
+        await runner.cleanup()
+
+
+async def test_cancel_unknown_and_terminal_jobs(tmp_path):
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore()
+    )
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        async with session.post(f"{api}/v1/jobs/ghost/cancel") as resp:
+            assert resp.status == 404
+        # a finished job is known but not cancellable
+        record = orchestrator.registry.register("done-job", "c")
+        orchestrator.registry.transition(record, FAILED, reason="x")
+        async with session.post(f"{api}/v1/jobs/done-job/cancel") as resp:
+            assert resp.status == 409
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=1)
+
+
+# ---------------------------------------------------------------------------
+# Coalesced waiter survives leader cancellation
+# ---------------------------------------------------------------------------
+
+async def test_coalesced_waiter_survives_leader_cancel(tmp_path):
+    runner, base, gets = await start_slow_server(
+        chunks=400, delay=0.02, etag='"v1"'
+    )
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store,
+        instance={"cache": {"path": str(tmp_path / "cache")},
+                  "max_concurrent_jobs": 4},
+    )
+    try:
+        uri = f"{base}/media.mkv"
+        broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(uri, "lead"))
+        # leader must be mid-fetch before the second job arrives
+        await wait_for(lambda: gets[0] >= 1)
+        broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(uri, "wait"))
+        flights = orchestrator.stage_resources["cache_singleflight"]
+        await wait_for(lambda: any(
+            f.waiters >= 1 for f in flights._inflight.values()
+        ))
+
+        assert orchestrator.registry.cancel("lead", reason="test")
+        async with asyncio.timeout(30):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        # the waiter failed over to its own fetch and completed
+        converts = [
+            schemas.decode(schemas.Convert, raw).media.id
+            for raw in broker.published(schemas.CONVERT_QUEUE)
+        ]
+        assert converts == ["wait"]
+        assert orchestrator.registry.get("lead").state == CANCELLED
+        assert orchestrator.registry.get("wait").state == DONE
+        assert gets[0] == 2  # leader's aborted GET + waiter's own
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Intake pause / resume / drain
+# ---------------------------------------------------------------------------
+
+async def test_pause_resume_drain_endpoints(tmp_path):
+    import fake_gate_stage
+
+    fake_gate_stage.reset()
+    fake_gate_stage.GATE = asyncio.Event()
+    register_stage("gate", "fake_gate_stage")
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(), stages=["gate"]
+    )
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg("http://x/", "j1"))
+        await wait_for(lambda: fake_gate_stage.ORDER == ["j1"])
+
+        # drain with the job parked on the gate: grace expires -> 504
+        async with session.post(f"{api}/v1/drain?grace=0.2") as resp:
+            assert resp.status == 504
+            body = await resp.json()
+            assert body["drained"] is False and body["intakePaused"] is True
+
+        # paused: /readyz flips to 503, new publishes stay queued
+        async with session.get(f"{api}/readyz") as resp:
+            assert resp.status == 503
+            assert (await resp.json())["status"] == "paused"
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg("http://x/", "j2"))
+        await asyncio.sleep(0.2)
+        assert broker.depth(schemas.DOWNLOAD_QUEUE) == 1
+        assert orchestrator.registry.get("j2") is None
+
+        # release the in-flight job; a second drain succeeds
+        fake_gate_stage.GATE.set()
+        async with session.post(f"{api}/v1/drain?grace=5") as resp:
+            assert resp.status == 200
+            assert (await resp.json())["drained"] is True
+        assert orchestrator.registry.get("j1").state == DONE
+
+        # resume: the queued job is picked up and completes
+        async with session.post(f"{api}/v1/intake/resume") as resp:
+            assert resp.status == 200
+        async with session.get(f"{api}/readyz") as resp:
+            assert resp.status == 200
+        async with asyncio.timeout(10):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("j2").state == DONE
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 2
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+# ---------------------------------------------------------------------------
+# Priority ordering end-to-end
+# ---------------------------------------------------------------------------
+
+async def test_priority_classes_reorder_job_starts(tmp_path):
+    import fake_gate_stage
+
+    fake_gate_stage.reset()
+    fake_gate_stage.GATE = asyncio.Event()
+    register_stage("gate", "fake_gate_stage")
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(), stages=["gate"],
+        prefetch=1, instance={"scheduler_backlog": 8},
+    )
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg("http://x/", "first"))
+        await wait_for(lambda: fake_gate_stage.ORDER == ["first"])
+        # while the slot is held, deliver one of each class (queue order
+        # deliberately worst-first)
+        for job_id, priority in [("bulk", "BULK"), ("norm", "NORMAL"),
+                                 ("high", "HIGH")]:
+            broker.publish(schemas.DOWNLOAD_QUEUE,
+                           make_download_msg("http://x/", job_id, priority))
+        await wait_for(lambda: orchestrator.scheduler.waiting == 3)
+        # all three are already visible to operators while queued
+        states = {r.job_id: r.state for r in orchestrator.registry.jobs()}
+        assert states["bulk"] == ADMITTED
+        fake_gate_stage.GATE.set()
+        async with asyncio.timeout(10):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert fake_gate_stage.ORDER == ["first", "high", "norm", "bulk"]
+        assert orchestrator.registry.get("high").priority == "HIGH"
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+# ---------------------------------------------------------------------------
+# Admin auth
+# ---------------------------------------------------------------------------
+
+async def test_mutating_endpoints_require_bearer_token(tmp_path, monkeypatch):
+    monkeypatch.setenv("CONTROL_TOKEN", "sekrit")
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore()
+    )
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        # reads stay open (like /metrics)
+        async with session.get(f"{api}/v1/jobs") as resp:
+            assert resp.status == 200
+        # mutations: 401 without/with a wrong token, through with the right
+        async with session.post(f"{api}/v1/jobs/x/cancel") as resp:
+            assert resp.status == 401
+        async with session.post(
+            f"{api}/v1/intake/pause",
+            headers={"Authorization": "Bearer wrong"},
+        ) as resp:
+            assert resp.status == 401
+        assert orchestrator.intake_paused is False
+        async with session.post(
+            f"{api}/v1/jobs/x/cancel",
+            headers={"Authorization": "Bearer sekrit"},
+        ) as resp:
+            assert resp.status == 404  # authorized; job just doesn't exist
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=1)
+
+
+# ---------------------------------------------------------------------------
+# Stage-level cooperative checks (process/upload)
+# ---------------------------------------------------------------------------
+
+class _SlowStore:
+    """Store wrapper whose per-file put is slow enough to cancel into."""
+
+    def __init__(self, inner, delay=0.2):
+        self._inner = inner
+        self.delay = delay
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def fput_object(self, *args, **kwargs):
+        await asyncio.sleep(self.delay)
+        return await self._inner.fput_object(*args, **kwargs)
+
+
+def _media(job_id="u1"):
+    return schemas.Media(id=job_id, creator_id="c", name="n",
+                         type=schemas.MediaType.Value("MOVIE"),
+                         source=schemas.SourceType.Value("HTTP"),
+                         source_uri="http://x/")
+
+
+async def test_upload_stage_cancels_between_files(tmp_path):
+    from downloader_tpu.stages.upload import STAGING_BUCKET, stage_factory
+    from downloader_tpu.utils import EventEmitter
+
+    files = []
+    for i in range(3):
+        path = tmp_path / f"f{i}.mkv"
+        path.write_bytes(b"v" * 64)
+        files.append(str(path))
+    inner = InMemoryObjectStore()
+    token = CancelToken("u1")
+    ctx = StageContext(
+        config=ConfigNode({"instance": {}}),
+        emitter=EventEmitter(), logger=NullLogger(),
+        store=_SlowStore(inner), cancel=token,
+    )
+    upload = await stage_factory(ctx)
+    job = Job(media=_media(), last_stage={
+        "files": files, "downloadPath": str(tmp_path)})
+    task = asyncio.create_task(upload(job))
+    # cancel once the first file landed
+    await wait_for(lambda: inner._buckets.get(STAGING_BUCKET))
+    token.cancel("test")
+    with pytest.raises(JobCancelled):
+        async with asyncio.timeout(5):
+            await task
+    staged = inner._buckets.get(STAGING_BUCKET, {})
+    assert 0 < len(staged) < 3
+    assert "u1/original/done" not in staged  # never sealed
+
+
+async def test_process_stage_checks_token(tmp_path):
+    from downloader_tpu.stages.process import stage_factory
+    from downloader_tpu.utils import EventEmitter
+
+    (tmp_path / "show.mkv").write_bytes(b"v")
+    token = CancelToken("p1")
+    token.cancel("test")
+    ctx = StageContext(
+        config=ConfigNode({"instance": {}}),
+        emitter=EventEmitter(), logger=NullLogger(), cancel=token,
+    )
+    process = await stage_factory(ctx)
+    with pytest.raises(JobCancelled):
+        await process(Job(media=_media("p1"),
+                          last_stage={"path": str(tmp_path)}))
+
+
+# ---------------------------------------------------------------------------
+# Jobs listing shape
+# ---------------------------------------------------------------------------
+
+async def test_jobs_listing_and_state_filter(tmp_path):
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore()
+    )
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        record = orchestrator.registry.register("jz", "card-z", "BULK")
+        orchestrator.registry.transition(record, ADMITTED)
+        async with session.get(f"{api}/v1/jobs") as resp:
+            body = await resp.json()
+        assert body["counts"] == {ADMITTED: 1}
+        assert body["intakePaused"] is False
+        (job,) = body["jobs"]
+        assert job["id"] == "jz" and job["priority"] == "BULK"
+        async with session.get(f"{api}/v1/jobs?state=RUNNING") as resp:
+            assert (await resp.json())["jobs"] == []
+        async with session.get(f"{api}/v1/jobs?state=BOGUS") as resp:
+            assert resp.status == 400
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=1)

@@ -92,7 +92,11 @@ def test_field_numbers_frozen():
     expected = {
         "Media": {"id": 1, "creator_id": 2, "name": 3, "type": 4,
                   "source": 5, "source_uri": 6},
-        "Download": {"media": 1, "created_at": 2},
+        # priority=3 added by the control-plane PR (deliberate, additive
+        # migration: proto3 implicit presence, absent = NORMAL, so the
+        # golden bytes above — which predate the field — still decode
+        # identically and old producers are untouched)
+        "Download": {"media": 1, "created_at": 2, "priority": 3},
         "Convert": {"created_at": 1, "media": 2},
     }
     for message_name, fields in expected.items():
@@ -101,11 +105,32 @@ def test_field_numbers_frozen():
         assert actual == fields, f"{message_name} field layout changed"
 
 
+def test_priority_field_wire_semantics():
+    """The control-plane priority field is additive: golden (pre-field)
+    bytes decode as NORMAL, and a priority-carrying encode round-trips."""
+    old = schemas.decode(schemas.Download, bytes.fromhex(GOLDEN_DOWNLOAD))
+    assert old.priority == schemas.JobPriority.Value("NORMAL")
+    # NORMAL = 0 is implicit-presence default: encoding it adds NO bytes,
+    # so a NORMAL producer is byte-identical with a pre-field producer
+    msg = schemas.Download(
+        media=_media(), created_at="2026-01-02T03:04:05.678Z",
+        priority=schemas.JobPriority.Value("NORMAL"),
+    )
+    assert schemas.encode(msg).hex() == GOLDEN_DOWNLOAD
+    msg.priority = schemas.JobPriority.Value("HIGH")
+    again = schemas.decode(schemas.Download, schemas.encode(msg))
+    assert again.priority == schemas.JobPriority.Value("HIGH")
+    assert {v.name: v.number for v in schemas.JobPriority.DESCRIPTOR.values} \
+        == {"NORMAL": 0, "HIGH": 1, "BULK": 2}
+
+
 def test_observable_enum_constants():
     """The reference's observable integers (lib/main.js:68,149): these are
     the values real telemetry consumers key on."""
     assert schemas.TelemetryStatus.Value("DOWNLOADING") == 2
     assert schemas.TelemetryStatus.Value("ERRORED") == 6
+    # control-plane addition: terminal status for cancelled jobs
+    assert schemas.TelemetryStatus.Value("CANCELLED") == 7
     # dispatch enums: decode must map to the stage methods
     # (lib/download.js:243,256 / lib/process.js:53)
     assert schemas.SourceType.Value("TORRENT") == 0
