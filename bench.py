@@ -75,7 +75,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  new control-plane microbench only: cancel_latency_ms (POST /cancel of
 #  a mid-transfer job -> delivery settled + temp files gone) and
 #  registry_overhead_ms (full lifecycle walk per job; guard < 1 ms).
-HARNESS_VERSION = 9
+# v10 (r8): registry_overhead_ms now INCLUDES the flight-recorder events
+#  the registry emits on every transition (platform/obs.py) — the same
+#  walk, so the series stays comparable and the guard catches recorder
+#  regressions too.  New: recorder_overhead_ms — the explicit per-job
+#  recorder traffic the orchestrator/stages add on top of transitions
+#  (~11 events + 3 live transfer samples against a wrapping ring, the
+#  worst case); guard < 1 ms/job (recorder_overhead_ok).
+HARNESS_VERSION = 10
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -424,9 +431,15 @@ async def bench_control() -> dict:
       the job's temp files gone (the orchestrator removes the workdir
       before acking, so broker idle == disk reclaimed).
     - ``registry_overhead_ms``: per-job cost of the full registry walk
-      (register + 6 transitions + terminal retirement), measured over
-      2000 synthetic jobs; the guard bar is < 1 ms/job
-      (``registry_overhead_ok``).
+      (register + 6 transitions + terminal retirement, each now also
+      appending a flight-recorder event), measured over 2000 synthetic
+      jobs; the guard bar is < 1 ms/job (``registry_overhead_ok``).
+    - ``recorder_overhead_ms`` (harness v10): per-job cost of the
+      EXPLICIT flight-recorder traffic a fully-instrumented job adds
+      beyond the transitions — delivered/span/waits/throughput/publish
+      events plus live transfer counters, recorded against a ring that
+      wraps (the worst case); guard < 1 ms/job
+      (``recorder_overhead_ok``).
     """
     import statistics
     import tempfile
@@ -458,6 +471,27 @@ async def bench_control() -> dict:
         registry.transition(record, PUBLISHING)
         registry.transition(record, DONE)
     registry_ms = (time.perf_counter() - t0) * 1000.0 / jobs
+
+    # -- flight-recorder overhead (harness v10) -------------------------
+    # one long-lived record whose ring wraps: every append past the
+    # bound pays the drop-count branch too, the recorder's worst case
+    recorder_registry = JobRegistry()
+    record = recorder_registry.register("recorder-bench", "card")
+    t0 = time.perf_counter()
+    for _ in range(jobs):
+        record.event("delivered", redelivered=False)
+        record.event("span", name="job", traceId="t" * 32, spanId="s" * 16)
+        record.event("queue_wait", seconds=0.001)
+        record.event("sched_wait", seconds=0.001)
+        for stage in ("download", "process", "upload"):
+            record.note_transfer(stage, 1 << 20)
+            record.event("throughput", stage=stage, bytes=1 << 20,
+                         bps=1048576.0, total=1 << 20, percent=None)
+        record.event("cache", outcome="miss", key="deadbeef")
+        record.event("retry", failures=1, threshold=5)
+        record.event("publish", queue="v1.convert", fanout=True)
+        record.event("settle", mode="ack", why="done")
+    recorder_ms = (time.perf_counter() - t0) * 1000.0 / jobs
 
     # -- cancel latency -------------------------------------------------
     async def serve(request):
@@ -536,6 +570,8 @@ async def bench_control() -> dict:
         "cancel_latency_ms": round(statistics.median(latencies), 1),
         "registry_overhead_ms": round(registry_ms, 4),
         "registry_overhead_ok": registry_ms < 1.0,
+        "recorder_overhead_ms": round(recorder_ms, 4),
+        "recorder_overhead_ok": recorder_ms < 1.0,
     }
 
 
@@ -1185,6 +1221,7 @@ HEADLINE_KEYS = [
     "cache_fanin_error",          # present only on failure — visible
     "cancel_latency_ms",          # r7 control plane: cancel -> settled+clean
     "registry_overhead_ms",       # r7 guard: must stay < 1 ms/job
+    "recorder_overhead_ms",       # r8 guard: flight recorder < 1 ms/job
     "control_bench_error",        # present only on failure — visible
     "utp_vs_tcp",
     "mfu",

@@ -36,6 +36,9 @@ def build_service(config=None, broker=None, store=None):
     logger = get_logger("downloader")
     tracer = init_tracer("downloader", logger, config)
     metrics = prom.new("downloader")
+    # exporter health on /metrics: a down OTLP collector shows up as
+    # climbing drop/error gauges instead of silently missing traces
+    metrics.bind_tracer(tracer)
 
     # optional field-number reconciliation with a real triton-core
     # deployment (schemas/remap.py); bad tables fail here, at boot
@@ -96,6 +99,19 @@ async def run(config=None) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, _on_signal)
+
+    # SIGUSR1: dump every thread/task stack to the log — the "what is
+    # this wedged worker doing" escape hatch when even the admin port
+    # is unreachable (same payload as GET /debug/stacks)
+    if hasattr(signal, "SIGUSR1"):
+        def _on_dump() -> None:
+            from .platform.obs import dump_stacks
+
+            dump = dump_stacks()
+            logger.warn("SIGUSR1 stack dump",
+                        threads=dump["threads"], tasks=dump["tasks"])
+
+        loop.add_signal_handler(signal.SIGUSR1, _on_dump)
 
     await stop.wait()
     await orchestrator.shutdown()

@@ -12,7 +12,9 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli magnet media.torrent
     python -m downloader_tpu.cli scrape media.torrent
     python -m downloader_tpu.cli status [--url http://host:3401]
-    python -m downloader_tpu.cli jobs list|show ID|cancel ID [--url ...]
+    python -m downloader_tpu.cli jobs list|show ID|events ID|cancel ID \
+        [--url ...]
+    python -m downloader_tpu.cli debug tasks|stacks [--url ...]
     python -m downloader_tpu.cli watch [--id my-movie]
     python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
     python -m downloader_tpu.cli train --data media/ --steps 500 \
@@ -132,6 +134,16 @@ def _build_parser() -> argparse.ArgumentParser:
     _jobs_common(jobs_show)
     jobs_show.add_argument("id", help="media/job id")
 
+    jobs_events = jobs_sub.add_parser(
+        "events", help="one job's flight-recorder timeline (state "
+                       "transitions, waits, throughput samples, cache/"
+                       "retry/settle decisions, correlation ids)"
+    )
+    _jobs_common(jobs_events)
+    jobs_events.add_argument("id", help="media/job id")
+    jobs_events.add_argument("--json", action="store_true",
+                             help="raw JSON instead of the timeline view")
+
     jobs_cancel = jobs_sub.add_parser(
         "cancel", help="cooperatively cancel a job (settled, not requeued)"
     )
@@ -139,6 +151,22 @@ def _build_parser() -> argparse.ArgumentParser:
     jobs_cancel.add_argument("id", help="media/job id")
     jobs_cancel.add_argument("--reason", default="cli",
                              help="recorded in the job's terminal state")
+
+    debug = sub.add_parser(
+        "debug", help="runtime introspection against a running service"
+    )
+    debug_sub = debug.add_subparsers(dest="debug_command", required=True)
+    debug_tasks = debug_sub.add_parser(
+        "tasks", help="live asyncio tasks + event-loop lag stats"
+    )
+    debug_tasks.add_argument("--url", default="http://127.0.0.1:3401",
+                             help="service base URL")
+    debug_stacks = debug_sub.add_parser(
+        "stacks", help="every thread's and task's current stack "
+                       "(the SIGUSR1 dump, over HTTP)"
+    )
+    debug_stacks.add_argument("--url", default="http://127.0.0.1:3401",
+                              help="service base URL")
 
     watch = sub.add_parser(
         "watch", help="tail job status/progress telemetry from the queue"
@@ -395,6 +423,28 @@ async def _jobs(args) -> int:
                     body = await resp.json()
                     print(json.dumps(body, indent=2, sort_keys=True))
                     return 0 if resp.status == 200 else 1
+            if args.jobs_command == "events":
+                async with session.get(
+                    f"{base}/v1/jobs/{args.id}/events"
+                ) as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+                if args.json:
+                    print(json.dumps(body, indent=2, sort_keys=True))
+                    return 0
+                print(f"# {body['id']}\tstate={body['state']}\t"
+                      f"traceId={body.get('traceId')}")
+                if body.get("eventsDropped"):
+                    print(f"# {body['eventsDropped']} older events "
+                          "dropped (ring bound)", file=sys.stderr)
+                for event in body.get("events", []):
+                    ts = event.pop("t", "")
+                    kind = event.pop("kind", "?")
+                    rest = " ".join(f"{k}={v}" for k, v in event.items())
+                    print(f"{ts}\t{kind}\t{rest}")
+                return 0
             # cancel
             async with session.post(
                 f"{base}/v1/jobs/{args.id}/cancel",
@@ -406,6 +456,44 @@ async def _jobs(args) -> int:
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
             print(f"{base}: unreachable ({err})", file=sys.stderr)
             return 2
+
+
+async def _debug(args) -> int:
+    """Drive the runtime-introspection endpoints (/debug/*)."""
+    import json
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    timeout = aiohttp.ClientTimeout(total=10)  # diagnostics must not hang
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        try:
+            async with session.get(
+                f"{base}/debug/{args.debug_command}"
+            ) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    print(json.dumps(body), file=sys.stderr)
+                    return 1
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+    if args.debug_command == "tasks":
+        lag = body.get("loopLag") or {}
+        print(f"# loop lag: last={lag.get('last')} max={lag.get('max')}")
+        for task in body.get("tasks", []):
+            top = task["stack"][-1] if task.get("stack") else "-"
+            print(f"{task['name']}\t{task['coro']}\t{top}")
+        return 0
+    for thread in body.get("threads", []):
+        print(f"== thread {thread['name']} ({thread['threadId']})")
+        for line in thread.get("stack", []):
+            print(line)
+    for task in body.get("tasks", []):
+        print(f"== task {task['name']} ({task['coro']})")
+        for line in task.get("stack", []):
+            print(f"  {line}")
+    return 0
 
 
 async def _watch(args) -> int:
@@ -609,6 +697,8 @@ def main(argv=None) -> int:
         return asyncio.run(_status(args))
     if args.command == "jobs":
         return asyncio.run(_jobs(args))
+    if args.command == "debug":
+        return asyncio.run(_debug(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
     if args.command == "upscale":

@@ -5,12 +5,20 @@ serves probes, metrics, and operations:
 
     GET  /v1/jobs                   list live + recently-terminal jobs
     GET  /v1/jobs/{id}              one job's record
+    GET  /v1/jobs/{id}/events       the job's flight-recorder timeline
+                                    (state transitions, waits, throughput
+                                    samples, cache/retry/settle decisions,
+                                    correlation ids)
     POST /v1/jobs/{id}/cancel       fire the job's cancel token
     POST /v1/intake/pause           stop pulling deliveries (in-flight
                                     work keeps running; /readyz -> 503)
     POST /v1/intake/resume          start pulling again
     POST /v1/drain?grace=30         pause intake + wait for in-flight
                                     jobs (programmatic shutdown grace)
+    GET  /debug/tasks               live asyncio tasks (name, coroutine,
+                                    stack top) + loop-lag stats
+    GET  /debug/stacks              every thread's and task's current
+                                    stack (the SIGUSR1 dump, over HTTP)
 
 Mutating endpoints (POST) are gated by an optional bearer token from
 ``control.token`` / env ``CONTROL_TOKEN``; reads stay open like
@@ -27,6 +35,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..platform.config import cfg_get
+from ..platform.obs import dump_stacks, dump_tasks
 from . import registry as reg
 
 
@@ -90,6 +99,38 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
         if record is None:
             return web.json_response({"error": "unknown job"}, status=404)
         return web.json_response(record.to_dict())
+
+    async def job_events(request: web.Request) -> web.Response:
+        """The job's flight-recorder timeline — the one endpoint that
+        answers "why is job X slow / stuck / dead" without shelling in."""
+        registry = _registry()
+        if registry is None:
+            return _unavailable()
+        record = registry.get(request.match_info["id"])
+        if record is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response({
+            "id": record.job_id,
+            "state": record.state,
+            "stage": record.stage,
+            "traceId": record.trace_id,
+            "spanId": record.span_id,
+            "eventsDropped": record.recorder.dropped,
+            "events": record.recorder.events(),
+        })
+
+    async def debug_tasks(_request: web.Request) -> web.Response:
+        monitor = getattr(orchestrator, "loop_monitor", None)
+        return web.json_response({
+            "tasks": dump_tasks(),
+            "loopLag": {
+                "last": getattr(monitor, "last_lag", None),
+                "max": getattr(monitor, "max_lag", None),
+            },
+        })
+
+    async def debug_stacks(_request: web.Request) -> web.Response:
+        return web.json_response(dump_stacks())
 
     async def job_cancel(request: web.Request) -> web.Response:
         if not _authorized(request):
@@ -158,7 +199,11 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
 
     app.router.add_get("/v1/jobs", jobs_list)
     app.router.add_get("/v1/jobs/{id}", job_show)
+    app.router.add_get("/v1/jobs/{id}/events", job_events)
     app.router.add_post("/v1/jobs/{id}/cancel", job_cancel)
+    # runtime introspection: reads, open like /metrics
+    app.router.add_get("/debug/tasks", debug_tasks)
+    app.router.add_get("/debug/stacks", debug_stacks)
     app.router.add_post("/v1/intake/pause", intake_pause)
     app.router.add_post("/v1/intake/resume", intake_resume)
     app.router.add_post("/v1/drain", drain)

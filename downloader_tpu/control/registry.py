@@ -19,6 +19,15 @@ for recently finished work without growing forever).
 
 Metrics: ``jobs_by_state`` gauge (every record the registry knows, by
 state) and ``job_state_transitions_total`` counter (from/to labels).
+
+Observability (platform/obs.py): every record carries a
+:class:`~..platform.obs.FlightRecorder` — a bounded ring of structured
+events (state transitions with per-stage timing, throughput samples,
+cache/retry/cancel/settle decisions, span references) served live by
+``GET /v1/jobs/{id}/events``.  A record closing as FAILED or
+DROPPED_POISON logs a debug bundle (the tail of its timeline + its
+trace id), so a dead job's post-mortem is one log line away even after
+the terminal ring evicts it.
 """
 
 from __future__ import annotations
@@ -26,8 +35,9 @@ from __future__ import annotations
 import collections
 import itertools
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
+from ..platform.obs import DEFAULT_EVENT_LIMIT, FlightRecorder
 from ..utils import utcnow_iso as _utcnow_iso
 from .cancel import CancelToken
 
@@ -61,6 +71,8 @@ LEGAL_TRANSITIONS: Dict[str, frozenset] = {
 }
 
 DEFAULT_TERMINAL_RING = 256
+# flight-recorder events kept in a terminal debug bundle log line
+DEBUG_BUNDLE_EVENTS = 20
 
 
 class IllegalTransition(RuntimeError):
@@ -74,9 +86,11 @@ class JobRecord:
         "uid", "job_id", "file_id", "priority", "state", "stage", "reason",
         "percent", "bytes", "cancel", "created_at", "updated_at",
         "stage_seconds", "_entered_mono", "_created_mono",
+        "recorder", "trace_id", "span_id", "transferred",
     )
 
-    def __init__(self, uid: int, job_id: str, file_id: str, priority: str):
+    def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
+                 recorder_events: int = DEFAULT_EVENT_LIMIT):
         self.uid = uid
         self.job_id = job_id
         self.file_id = file_id
@@ -92,15 +106,36 @@ class JobRecord:
         self.stage_seconds: Dict[str, float] = {}
         self._created_mono = time.monotonic()
         self._entered_mono = self._created_mono
+        # per-job flight recorder (platform/obs.py): the job's bounded
+        # event timeline, served by GET /v1/jobs/{id}/events
+        self.recorder = FlightRecorder(recorder_events)
+        # correlation ids: the job span's W3C trace/span id, also bound
+        # into the job's child logger — one id joins log lines, the
+        # OTLP span, and this record's timeline
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        # live mid-transfer byte counters (absolute, per kind), fed by
+        # the stages' chunk loops and sampled by the TransferProfiler;
+        # unlike ``bytes`` (committed at stage completion) these move
+        # WHILE a transfer runs, so a stalled job is visibly flat
+        self.transferred: Dict[str, int] = {}
 
     @property
     def terminal(self) -> bool:
         return self.state in TERMINAL_STATES
 
+    def event(self, kind: str, **fields: Any) -> None:
+        """Append one flight-recorder event to this job's timeline."""
+        self.recorder.record(kind, **fields)
+
     def add_bytes(self, kind: str, count: int) -> None:
         """Stage-side byte sampling (downloaded/uploaded so far)."""
         if count:
             self.bytes[kind] = self.bytes.get(kind, 0) + int(count)
+
+    def note_transfer(self, kind: str, total: int) -> None:
+        """Live absolute transfer counter (cheap: called per chunk)."""
+        self.transferred[kind] = int(total)
 
     def note_progress(self, percent: int) -> None:
         self.percent = int(percent)
@@ -118,6 +153,8 @@ class JobRecord:
             "percent": self.percent,
             "bytes": dict(self.bytes),
             "cancelRequested": self.cancel.cancelled,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
             "createdAt": self.created_at,
             "updatedAt": self.updated_at,
             "ageSeconds": round(time.monotonic() - self._created_mono, 3),
@@ -135,9 +172,10 @@ class JobRegistry:
     """
 
     def __init__(self, metrics=None, terminal_ring: int = DEFAULT_TERMINAL_RING,
-                 logger=None):
+                 logger=None, recorder_events: int = DEFAULT_EVENT_LIMIT):
         self.metrics = metrics
         self.logger = logger
+        self.recorder_events = max(int(recorder_events), 1)
         self.terminal_ring = max(int(terminal_ring), 0)
         self._active: "collections.OrderedDict[int, JobRecord]" = (
             collections.OrderedDict()
@@ -154,9 +192,11 @@ class JobRegistry:
     def register(self, job_id: str, file_id: str,
                  priority: str = "NORMAL") -> JobRecord:
         """Open a record at delivery receipt (state RECEIVED)."""
-        record = JobRecord(next(self._seq), job_id, file_id, priority)
+        record = JobRecord(next(self._seq), job_id, file_id, priority,
+                           recorder_events=self.recorder_events)
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
+        record.event("received", priority=priority)
         return record
 
     def transition(self, record: JobRecord, state: str,
@@ -171,8 +211,10 @@ class JobRegistry:
                 f"legal lifecycle transition"
             )
         now = time.monotonic()
+        stage_closed = None
         # close the timing of the stage (or state) being left
         if record.state == RUNNING and record.stage:
+            stage_closed = round(now - record._entered_mono, 6)
             record.stage_seconds[record.stage] = (
                 record.stage_seconds.get(record.stage, 0.0)
                 + (now - record._entered_mono)
@@ -181,22 +223,45 @@ class JobRegistry:
             self.metrics.job_state_transitions.labels(
                 from_state=record.state, to_state=state
             ).inc()
+        event_fields: Dict[str, Any] = {"from": record.state, "to": state}
+        if stage_closed is not None:
+            # the CLOSED stage rides its own key: on a RUNNING->RUNNING
+            # stage hop, "stage" below names the stage being ENTERED, and
+            # the closed stage's timing must not be attributed to it
+            event_fields["stage_closed"] = record.stage
+            event_fields["stage_s"] = stage_closed
         self._gauge(record.state, -1)
         self._gauge(state, +1)
         record.state = state
         if state == RUNNING:
             record.stage = stage
+            event_fields["stage"] = stage
         # non-RUNNING states keep the last stage entered: a terminal
         # record should still say which stage the job died/cancelled in
         if reason is not None:
             record.reason = reason
+            event_fields["reason"] = reason
         record.updated_at = _utcnow_iso()
         record._entered_mono = now
+        record.event("state", **event_fields)
         if state in TERMINAL_STATES:
             self._retire(record)
         return record
 
     def _retire(self, record: JobRecord) -> None:
+        if (record.state in (FAILED, DROPPED_POISON)
+                and self.logger is not None):
+            # terminal debug bundle: the timeline's tail + correlation
+            # ids, in one log line — a dead job stays diagnosable after
+            # the terminal ring evicts its record
+            self.logger.warn(
+                "job debug bundle", jobId=record.job_id, state=record.state,
+                reason=record.reason, stage=record.stage,
+                traceId=record.trace_id, spanId=record.span_id,
+                bytes=dict(record.bytes),
+                eventsDropped=record.recorder.dropped,
+                events=record.recorder.tail(DEBUG_BUNDLE_EVENTS),
+            )
         self._active.pop(record.uid, None)
         self._ring.append(record)
         while len(self._ring) > self.terminal_ring:
@@ -217,6 +282,7 @@ class JobRegistry:
         for record in self._active.values():
             if record.job_id == job_id and record.cancel.cancel(reason):
                 record.updated_at = _utcnow_iso()
+                record.event("cancel_requested", reason=reason)
                 fired.append(record)
         if fired and self.logger is not None:
             self.logger.info("job cancellation requested",

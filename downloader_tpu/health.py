@@ -1,8 +1,10 @@
 """HTTP surface: probes + metrics + the control plane's admin API.
 
 ``GET /health`` + ``/livez`` + ``/readyz`` + ``/metrics``, plus the
-``/v1/jobs`` / cancel / intake / drain endpoints from ``control/api.py``
-mounted on the same app (one port for probes, metrics, and operations).
+``/v1/jobs`` (list / show / events / cancel), intake, drain, and
+``/debug/tasks`` / ``/debug/stacks`` endpoints from ``control/api.py``
+mounted on the same app (one port for probes, metrics, operations, and
+runtime introspection).
 
 ``/health`` has behavioral parity with /root/reference/lib/main.js:174-194,
 including the reference's deliberate inverted semantics: a worker with zero

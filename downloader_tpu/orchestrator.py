@@ -29,6 +29,7 @@ import asyncio
 import os
 import shutil
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from . import control, schemas
@@ -41,6 +42,9 @@ from .mq.base import Delivery, MessageQueue
 from .platform.config import cfg_get
 from .platform.logging import Logger, get_logger
 from .platform.metrics import Metrics
+from .platform.obs import (DEFAULT_EVENT_LIMIT, DEFAULT_LAG_INTERVAL,
+                           DEFAULT_PROFILE_INTERVAL, LoopLagMonitor,
+                           TransferProfiler)
 from .platform.telemetry import NullTelemetry, Telemetry
 from .platform.tracing import (NullTracer, Tracer, format_traceparent,
                                parse_traceparent)
@@ -128,7 +132,27 @@ class Orchestrator:
         # scheduler_backlog > 0 widens the consumer prefetch past the run
         # slots so the scheduler has deliveries to reorder (default 0 =
         # exact pre-control-plane behavior).
-        self.registry = JobRegistry(metrics=metrics, logger=self.logger)
+        self.registry = JobRegistry(
+            metrics=metrics, logger=self.logger,
+            recorder_events=int(cfg_get(
+                config, "obs.recorder_events", DEFAULT_EVENT_LIMIT
+            )),
+        )
+        # runtime introspection (platform/obs.py): loop-lag sampling
+        # into /metrics, and the transfer profiler feeding throughput /
+        # stall_suspect events into each RUNNING job's flight recorder
+        self.loop_monitor = LoopLagMonitor(
+            metrics=metrics, logger=self.logger,
+            interval=float(cfg_get(
+                config, "obs.loop_lag_interval", DEFAULT_LAG_INTERVAL
+            )),
+        )
+        self.profiler = TransferProfiler(
+            self.registry, logger=self.logger,
+            interval=float(cfg_get(
+                config, "obs.profile_interval", DEFAULT_PROFILE_INTERVAL
+            )),
+        )
         self.scheduler = PriorityScheduler(
             prefetch, aging_seconds=aging_from_config(config)
         )
@@ -204,6 +228,8 @@ class Orchestrator:
             prefetch=self.consumer_prefetch,
         )
         self.consuming = True
+        self.loop_monitor.start()
+        self.profiler.start()
         self.logger.info("successfully connected to queue")
 
     # -- control plane: intake steering --------------------------------
@@ -274,6 +300,8 @@ class Orchestrator:
                 "shutdown grace period expired with active jobs",
                 active=len(self.active_jobs),
             )
+        await self.profiler.stop()
+        await self.loop_monitor.stop()
         await self.mq.close()
         await self.telemetry.close()
         for cleanup in self.stage_cleanups:
@@ -308,7 +336,18 @@ class Orchestrator:
             self.metrics.jobs_consumed.inc()
 
         job_entry = {"cardId": file_id, "jobId": job_id}
-        child = self.logger.child(jobId=job_id, fileId=file_id)
+
+        # correlation ids, allocated at RECEIPT: the job span's W3C
+        # trace/span id (inheriting the submitter's trace when the
+        # delivery carries a traceparent header) goes into the child
+        # logger's bindings, the registry record, and — below — the span
+        # itself, so a log line, an OTLP span, and a flight-recorder
+        # timeline entry for the same job are joinable on one id
+        remote = parse_traceparent(delivery.headers.get("traceparent"))
+        trace_id = remote.trace_id if remote is not None else uuid.uuid4().hex
+        span_id = uuid.uuid4().hex[:16]
+        child = self.logger.child(jobId=job_id, fileId=file_id,
+                                  traceId=trace_id, spanId=span_id)
 
         # registered + counted from RECEIPT: a job waiting in admission
         # or the priority queue is visible to /health, GET /v1/jobs,
@@ -316,6 +355,11 @@ class Orchestrator:
         # bookkeeping after this point is undone in the finally, so a
         # failure anywhere can't leak the gauge or the active-jobs entry.
         record = self.registry.register(job_id, file_id, priority=priority)
+        record.trace_id = trace_id
+        record.span_id = span_id
+        record.event("delivered", redelivered=delivery.redelivered)
+        record.event("span", name="job", traceId=trace_id, spanId=span_id,
+                     remoteParent=remote.span_id if remote else None)
         token = record.cancel
         self.active_jobs.append(job_entry)
         if self.metrics is not None:
@@ -334,14 +378,26 @@ class Orchestrator:
             # delivery stays unsettled while we wait, so the broker's
             # prefetch window provides the backpressure.  The token
             # guard makes a parked job cancellable.
-            await token.guard(self._admit_job(child))
+            await token.guard(self._admit_job(child, record))
+            # queue wait (RECEIPT -> ADMITTED): PR 2 made it visible
+            # per-job via the registry timestamps; the histogram finally
+            # aggregates it
+            queue_wait = time.monotonic() - record._created_mono
             self.registry.transition(record, control.ADMITTED)
+            record.event("queue_wait", seconds=round(queue_wait, 6))
+            if self.metrics is not None:
+                self.metrics.queue_wait_seconds.observe(queue_wait)
+            admitted_mono = time.monotonic()
             # priority scheduling: wait for one of the run slots, queued
             # by class (HIGH before NORMAL before BULK) with aging
             await token.guard(
                 self.scheduler.acquire(priority_rank(priority))
             )
             granted = True
+            sched_wait = time.monotonic() - admitted_mono
+            record.event("sched_wait", seconds=round(sched_wait, 6))
+            if self.metrics is not None:
+                self.metrics.scheduler_wait_seconds.observe(sched_wait)
             # set DOWNLOADING status (reference lib/main.js:68) — only
             # once the job actually holds a run slot: a job parked in
             # admission or the priority queue must not tell telemetry
@@ -352,9 +408,11 @@ class Orchestrator:
             )
             # parent the job span to the submitter's span when the
             # message carries W3C trace context (triton's design intent,
-            # /root/reference/lib/main.js:20 — unused there; live here)
-            remote = parse_traceparent(delivery.headers.get("traceparent"))
+            # /root/reference/lib/main.js:20 — unused there; live here),
+            # under the ids pre-allocated at receipt so logger bindings
+            # and recorder events already reference this exact span
             with self.tracer.span("job", remote_parent=remote,
+                                  trace_id=trace_id, span_id=span_id,
                                   jobId=job_id, fileId=file_id):
                 await self._run_job(msg, delivery, child, emitter,
                                     record, token)
@@ -403,6 +461,8 @@ class Orchestrator:
             )
         except OSError as err:
             logger.warn("cancelled-job cleanup failed", error=str(err))
+        record.event("settle", mode="ack", why="cancelled",
+                     reason=token.reason or "cancelled")
         await delivery.ack()
         self._failure_counts.pop(job_id, None)
         if self.metrics is not None:
@@ -414,7 +474,8 @@ class Orchestrator:
         self.registry.transition(record, control.CANCELLED,
                                  reason=token.reason or "cancelled")
 
-    async def _admit_job(self, logger: Logger) -> None:
+    async def _admit_job(self, logger: Logger,
+                         record: Optional[JobRecord] = None) -> None:
         """Gate job start on cache-volume disk headroom.
 
         No cache -> no gate (the download stage's ensure_disk_space
@@ -438,6 +499,9 @@ class Orchestrator:
                     free_bytes=self.cache.free_disk_bytes(),
                     min_free_bytes=self.cache.min_free_bytes,
                 )
+                if record is not None:
+                    record.event("admission_forced",
+                                 free_bytes=self.cache.free_disk_bytes())
                 return
             if not warned:
                 warned = True
@@ -446,6 +510,10 @@ class Orchestrator:
                     free_bytes=self.cache.free_disk_bytes(),
                     min_free_bytes=self.cache.min_free_bytes,
                 )
+                if record is not None:
+                    record.event("admission_wait",
+                                 free_bytes=self.cache.free_disk_bytes(),
+                                 min_free_bytes=self.cache.min_free_bytes)
             await asyncio.sleep(0.25)
 
     async def _run_job(
@@ -516,12 +584,15 @@ class Orchestrator:
                 raise  # settled by the processor (ack, cleanup, CANCELLED)
             except Exception as err:
                 logger.error("failed to invoke stage", error=str(err))
+                record.event("error", stage=record.stage,
+                             type=type(err).__name__, error=str(err)[:300])
 
                 # permanent stall -> drop the job (reference lib/main.js:144-146)
                 if getattr(err, "code", None) == "ERRDLSTALL":
                     if self.metrics is not None:
                         self.metrics.jobs_failed.labels(reason="stalled").inc()
                     self._failure_counts.pop(job_id, None)  # job is settled
+                    record.event("settle", mode="ack", why="stalled")
                     await delivery.ack()
                     self.registry.transition(record, control.FAILED,
                                              reason="stalled")
@@ -536,6 +607,8 @@ class Orchestrator:
                 # re-insert at the back: dict eviction below then drops the
                 # LEAST-recently-failing job, never an actively hot one
                 self._failure_counts[job_id] = failures
+                record.event("retry", failures=failures,
+                             threshold=self.poison_threshold)
                 # bound the counter dict: jobs whose redeliveries land on
                 # other replicas (or get dead-lettered) would otherwise
                 # leak one entry each for the process lifetime
@@ -553,12 +626,15 @@ class Orchestrator:
                     if self.metrics is not None:
                         self.metrics.jobs_failed.labels(reason="poison").inc()
                     self._failure_counts.pop(job_id, None)
+                    record.event("settle", mode="ack", why="poison",
+                                 failures=failures)
                     await delivery.ack()
                     self.registry.transition(record, control.DROPPED_POISON,
                                              reason=f"{failures} failures")
                     return
                 if self.metrics is not None:
                     self.metrics.jobs_failed.labels(reason="stage_error").inc()
+                record.event("settle", mode="nack", why="stage_error")
                 await delivery.nack()
                 self.registry.transition(record, control.FAILED,
                                          reason="stage_error")
@@ -566,6 +642,7 @@ class Orchestrator:
             logger.info("creating convert job")
         else:
             logger.warn("skipping download due to files existing in triton-staging")
+            record.event("idempotent_skip")
             if self.metrics is not None:
                 self.metrics.jobs_skipped.inc()
 
@@ -593,6 +670,8 @@ class Orchestrator:
                     schemas.CONVERT_QUEUE, schemas.encode(payload),
                     headers=headers,
                 )
+            record.event("publish", queue=schemas.CONVERT_QUEUE,
+                         fanout=bool(getattr(self, "_convert_fanout", False)))
             if self.metrics is not None:
                 self.metrics.messages_published.labels(
                     queue=schemas.CONVERT_QUEUE
@@ -603,11 +682,15 @@ class Orchestrator:
             # the message is redelivered — the idempotency marker makes the
             # retry skip straight to re-publishing the convert message
             logger.error("failed to create job", error=str(err))
+            record.event("error", type=type(err).__name__,
+                         error=str(err)[:300])
+            record.event("settle", mode="nack", why="publish_error")
             await delivery.nack()
             self.registry.transition(record, control.FAILED,
                                      reason="publish_error")
             return
 
+        record.event("settle", mode="ack", why="done")
         await delivery.ack()
         # success clears the poison counter: transient-failure retries that
         # eventually succeed must not count against a later redelivery

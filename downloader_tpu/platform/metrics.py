@@ -81,6 +81,58 @@ class Metrics:
             ["stage"],
             registry=self.registry,
         )
+        self.queue_wait_seconds = Histogram(
+            f"{ns}_queue_wait_seconds",
+            "Seconds from delivery receipt (RECEIVED) to admission "
+            "(ADMITTED) — the disk-headroom gate's wait",
+            registry=self.registry,
+        )
+        self.scheduler_wait_seconds = Histogram(
+            f"{ns}_scheduler_wait_seconds",
+            "Seconds from ADMITTED to acquiring a priority-scheduler "
+            "run slot",
+            registry=self.registry,
+        )
+        self.event_loop_lag = Gauge(
+            f"{ns}_event_loop_lag_seconds",
+            "Most recent event-loop scheduling lag sample (how late the "
+            "loop woke the lag monitor's timer)",
+            registry=self.registry,
+        )
+        self.event_loop_lag_hist = Histogram(
+            f"{ns}_event_loop_lag",
+            "Event-loop scheduling lag distribution, seconds",
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0),
+        )
+        self.tracer_buffer_spans = Gauge(
+            f"{ns}_tracer_buffer_spans",
+            "Finished spans held in the tracer's in-process buffer",
+            registry=self.registry,
+        )
+        self.otlp_spans_exported = Gauge(
+            f"{ns}_otlp_spans_exported",
+            "Spans successfully shipped to the OTLP collector "
+            "(monotonic; gauge because it is read from the exporter)",
+            registry=self.registry,
+        )
+        self.otlp_spans_dropped = Gauge(
+            f"{ns}_otlp_spans_dropped",
+            "Spans dropped by the OTLP exporter (full queue or failed "
+            "batches) — nonzero means traces are silently missing",
+            registry=self.registry,
+        )
+        self.otlp_export_errors = Gauge(
+            f"{ns}_otlp_export_errors",
+            "Failed OTLP batch POSTs (collector down/unreachable)",
+            registry=self.registry,
+        )
+        self.otlp_queue_depth = Gauge(
+            f"{ns}_otlp_queue_depth",
+            "Spans waiting in the OTLP exporter's send queue",
+            registry=self.registry,
+        )
         self.bytes_downloaded = Counter(
             f"{ns}_bytes_downloaded_total",
             "Bytes fetched by the download stage",
@@ -153,6 +205,30 @@ class Metrics:
             "Bytes served back to the swarm while leeching/seeding",
             registry=self.registry,
         )
+
+    def bind_tracer(self, tracer) -> None:
+        """Surface tracer/OTLP-exporter internals on ``/metrics``.
+
+        The exporter deliberately swallows failures in-flight (tracing
+        must never fail the pipeline), which made them invisible; these
+        gauges read its counters at scrape time, so a down collector
+        shows up as climbing ``otlp_export_errors``/``otlp_spans_dropped``
+        instead of silently missing traces.
+        """
+        self.tracer_buffer_spans.set_function(
+            lambda: float(tracer.buffer_depth())
+        )
+        exporter = getattr(tracer, "exporter", None)
+        if exporter is None:
+            return
+        self.otlp_spans_exported.set_function(
+            lambda: float(exporter.exported))
+        self.otlp_spans_dropped.set_function(
+            lambda: float(exporter.dropped))
+        self.otlp_export_errors.set_function(
+            lambda: float(exporter.errors))
+        self.otlp_queue_depth.set_function(
+            lambda: float(exporter._queue.qsize()))
 
     def render(self) -> bytes:
         """Prometheus text exposition of the registry."""

@@ -91,19 +91,29 @@ def parse_traceparent(value: Any) -> Optional[RemoteSpanContext]:
 class Span:
     __slots__ = (
         "tracer", "name", "trace_id", "span_id", "parent_id",
-        "start", "end", "tags", "error",
+        "start", "end", "tags", "error", "_mono",
     )
 
     def __init__(self, tracer: "Tracer", name: str,
                  parent: "Optional[Span | RemoteSpanContext]" = None,
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
                  **tags: Any):
         self.tracer = tracer
         self.name = name
-        # W3C/OTLP sizes: 16-byte trace id, 8-byte span id (hex)
-        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex
-        self.span_id = uuid.uuid4().hex[:16]
+        # W3C/OTLP sizes: 16-byte trace id, 8-byte span id (hex).
+        # Explicit ids win (the orchestrator pre-allocates a job's ids so
+        # its child logger and flight recorder carry them from receipt,
+        # before the span opens); otherwise inherit/generate as before.
+        self.trace_id = trace_id or (parent.trace_id if parent
+                                     else uuid.uuid4().hex)
+        self.span_id = span_id or uuid.uuid4().hex[:16]
         self.parent_id = parent.span_id if parent else None
+        # wall-clock anchors the OTLP start/end nanos; the duration is
+        # measured on the monotonic clock (an NTP step mid-span would
+        # otherwise skew — or negate — every timing derived from it)
         self.start = time.time()
+        self._mono = time.monotonic()
         self.end: Optional[float] = None
         self.tags: Dict[str, Any] = dict(tags)
         self.error: Optional[str] = None
@@ -114,14 +124,18 @@ class Span:
     def finish(self, error: Optional[BaseException] = None) -> None:
         if self.end is not None:
             return
-        self.end = time.time()
+        # end = wall start + monotonic elapsed: OTLP nanos stay
+        # wall-anchored while the span's duration is NTP-step-immune
+        self.end = self.start + (time.monotonic() - self._mono)
         if error is not None:
             self.error = f"{type(error).__name__}: {error}"
         self.tracer._record(self)
 
     @property
     def duration(self) -> float:
-        return (self.end or time.time()) - self.start
+        if self.end is None:
+            return time.monotonic() - self._mono
+        return self.end - self.start
 
     def to_dict(self) -> dict:
         return {
@@ -259,15 +273,25 @@ class Tracer:
         self.service = service
         self.export_path = export_path or os.environ.get("TRACE_EXPORT")
         self.exporter = exporter
+        # optional structured logger (init_tracer attaches it): used to
+        # report exporter health once at the shutdown flush
+        self.logger = None
         self.finished: List[Span] = []
         self._max_buffer = max_buffer
         self._lock = threading.Lock()
 
+    def buffer_depth(self) -> int:
+        """Finished spans currently held in the in-process buffer."""
+        with self._lock:
+            return len(self.finished)
+
     @contextlib.contextmanager
     def span(self, name: str, remote_parent: Optional[RemoteSpanContext] = None,
+             trace_id: Optional[str] = None, span_id: Optional[str] = None,
              **tags: Any):
         parent = remote_parent or _current_span.get()
-        span = Span(self, name, parent, **tags)
+        span = Span(self, name, parent, trace_id=trace_id, span_id=span_id,
+                    **tags)
         token = _current_span.set(span)
         try:
             yield span
@@ -291,9 +315,24 @@ class Tracer:
                 fh.write(line + "\n")
 
     def close(self) -> None:
-        """Flush the OTLP exporter, if any."""
+        """Flush the OTLP exporter, if any, and report its health.
+
+        Export failures are deliberately silent in-flight (a down
+        collector must never fail the pipeline), so the shutdown flush
+        is where their tally surfaces: one log line with
+        exported/dropped/errors — the operator's signal that traces
+        were (or were not) actually leaving the process.
+        """
         if self.exporter is not None:
             self.exporter.close()
+            if self.logger is not None:
+                self.logger.info(
+                    "otlp exporter flushed",
+                    exported=self.exporter.exported,
+                    dropped=self.exporter.dropped,
+                    errors=self.exporter.errors,
+                    queued=self.exporter._queue.qsize(),
+                )
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -324,6 +363,7 @@ def init_tracer(service: str, logger=None, config=None) -> Tracer:
     )
     exporter = OtlpExporter(endpoint, service) if endpoint else None
     tracer = Tracer(service, exporter=exporter)
+    tracer.logger = logger
     if logger is not None:
         logger.debug(
             "tracer initialized", service=service,

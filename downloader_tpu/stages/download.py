@@ -444,6 +444,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     report(percent)
 
         stats: dict = {}
+        record = ctx.record
         await client.download(
             resource_url,
             download_path,
@@ -454,6 +455,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             seed_linger=seed_linger,
             stats_out=stats,
             cancel=cancel,
+            # live verified-byte counter for the transfer profiler's
+            # per-job throughput/stall sampling (rides the client's own
+            # watchdog feeds)
+            progress_sink=(None if record is None else
+                           lambda n: record.note_transfer("download",
+                                                          int(n))),
         )
         if ctx.record is not None and stats:
             ctx.record.add_bytes(
@@ -505,7 +512,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         partial = output + ".partial"
         meta = partial + ".meta"
 
-        watchdog = StallWatchdog(STALL_TIMEOUT_SECONDS)
+        # the watchdog's feed taps double as the flight recorder's live
+        # transfer counter: the profiler samples it into per-job
+        # throughput events (a stalled transfer is visibly flat in
+        # GET /v1/jobs/{id}/events minutes before this watchdog fires)
+        record = ctx.record
+        watchdog = StallWatchdog(
+            STALL_TIMEOUT_SECONDS,
+            on_feed=(None if record is None
+                     else lambda n: record.note_transfer("download", n)),
+        )
         # identity: a Content-Encoding-compressed body would be written to
         # disk raw (the session doesn't decompress), and byte-range offsets
         # are only meaningful against the unencoded entity
@@ -1184,6 +1200,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 logger.info("bucket fetch", object=item.name, to=local)
                 await client.fget_object(params["bucket"], item.name, local)
                 total += item.size
+                if ctx.record is not None:
+                    ctx.record.note_transfer("download", total)
             if ctx.record is not None:
                 ctx.record.add_bytes("downloaded", total)
             if ctx.metrics is not None:
@@ -1296,9 +1314,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             return False
         with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
             got = await cache.materialize(key, download_path)
-            span.set_tag("outcome",
-                         "lost" if got is None
-                         else ("coalesced" if coalesced else "hit"))
+            outcome = ("lost" if got is None
+                       else ("coalesced" if coalesced else "hit"))
+            span.set_tag("outcome", outcome)
+        if ctx.record is not None:
+            ctx.record.event("cache", outcome=outcome, key=key[:16],
+                             bytes=got or 0)
         if got is None:
             return False  # evicted between lookup and link: treat as miss
         if ctx.metrics is not None:
@@ -1326,6 +1347,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 ctx.metrics.cache_misses.inc()
             with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
                 span.set_tag("outcome", "miss")
+            if ctx.record is not None:
+                ctx.record.event("cache", outcome="miss", key=key[:16])
             job.cache_report = report  # torrent progress feeds waiters
             try:
                 report(0)
@@ -1339,9 +1362,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # partial workdir is never inserted.  A fill failure (disk)
             # must not fail a job that already has its bytes.
             try:
-                await cache.insert(key, download_path)
+                entry = await cache.insert(key, download_path)
+                if ctx.record is not None:
+                    ctx.record.event("cache", outcome="fill", key=key[:16],
+                                     bytes=entry.size if entry else 0)
             except OSError as err:
                 logger.warn("cache fill failed", error=str(err))
+                if ctx.record is not None:
+                    ctx.record.event("cache", outcome="fill_failed",
+                                     key=key[:16], error=str(err)[:120])
 
         async def waiter_progress(percent: int) -> None:
             await telemetry.emit_progress(file_id, downloading, percent)
@@ -1358,6 +1387,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # cacheable, fill error, instant eviction): fetch alone
                 logger.warn("coalesced fetch left no cache entry; "
                             "falling back to own download", key=key[:16])
+                if ctx.record is not None:
+                    ctx.record.event("cache", outcome="fallback",
+                                     key=key[:16])
                 await method(url, file_id, download_path, job)
 
     async def download(job: Job):

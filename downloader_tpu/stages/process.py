@@ -147,6 +147,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             raise NoMediaFilesError("Failed to find any suitable media files")
 
         logger.info("found media files", count=len(found))
+        if ctx.record is not None:
+            ctx.record.event("process", files=len(found))
         return {"files": found, "downloadPath": download_path}
 
     return process

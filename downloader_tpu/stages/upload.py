@@ -103,6 +103,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         logger.info("starting file upload", count=len(files))
         media_id = job.media.id
 
+        uploaded_total = 0
         with ctx.tracer.span("stage.upload", mediaId=media_id, files=len(files)):
             if not await store.bucket_exists(STAGING_BUCKET):
                 await store.make_bucket(STAGING_BUCKET)
@@ -144,8 +145,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         # job is cancelled or the put fails mid-wait —
                         # debt every OTHER job would then sleep off.
                         await limiter.consume(size)
+                    uploaded_total += size
                     if ctx.record is not None:
                         ctx.record.add_bytes("uploaded", size)
+                        # live counter for the transfer profiler's
+                        # per-job throughput/stall sampling
+                        ctx.record.note_transfer("upload", uploaded_total)
                     if ctx.metrics is not None:
                         ctx.metrics.bytes_uploaded.inc(size)
 

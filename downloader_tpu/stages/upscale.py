@@ -202,6 +202,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 logger.info(
                     "upscaled", path=os.path.basename(dst), frames=frames
                 )
+                if ctx.record is not None:
+                    ctx.record.event("upscale", frames=frames,
+                                     file=os.path.basename(dst))
                 if ctx.metrics is not None and hasattr(
                     ctx.metrics, "frames_upscaled"
                 ):

@@ -260,6 +260,7 @@ class TorrentClient:
         seed_linger: float = 0.0,
         stats_out: Optional[dict] = None,
         cancel=None,
+        progress_sink=None,
     ) -> Metainfo:
         """Fetch the torrent behind ``uri`` into ``download_path``.
 
@@ -279,6 +280,10 @@ class TorrentClient:
         pieces within one scheduling tick and unwinds through the same
         orderly teardown as any other drive error (fast-resume sidecar
         saved, workers gathered, storage closed).
+
+        ``progress_sink`` is an optional callable fed the cumulative
+        verified byte count on every watchdog feed — the download
+        stage's live flight-recorder transfer counter rides it.
         """
         meta, peers = await self._resolve(uri, peers, metadata_timeout)
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
@@ -321,7 +326,7 @@ class TorrentClient:
                 self._log("listen socket failed; leech-only", error=str(err))
                 server = None
 
-        watchdog = StallWatchdog(stall_timeout)
+        watchdog = StallWatchdog(stall_timeout, on_feed=progress_sink)
         watchdog.feed(swarm.bytes_done)
 
         completed = False
@@ -332,6 +337,9 @@ class TorrentClient:
                             cancel=cancel)
             )
             completed = True
+            # close the live counter: a fast download can finish between
+            # reporter ticks, and the final total must reach the sink
+            watchdog.feed(swarm.bytes_done)
         finally:
             if server is not None:
                 if completed and seed_linger > 0:
@@ -862,10 +870,20 @@ class TorrentClient:
     async def _report_progress(self, swarm: _Swarm, watchdog: StallWatchdog,
                                interval: float, on_progress: Optional[ProgressCb]):
         total = swarm.meta.total_length or 1
+        # the watchdog (and any progress_sink riding its feed) ticks on a
+        # short cadence: the stall check only compares across its own
+        # 240 s windows, but the flight-recorder profiler samples the
+        # fed counters every few seconds and must not see a 30 s-flat
+        # counter as a stalled transfer.  on_progress keeps the
+        # reference's coarser telemetry cadence (lib/download.js:88).
+        tick = min(interval, 1.0)
+        elapsed = 0.0
         while True:
-            await asyncio.sleep(interval)
+            await asyncio.sleep(tick)
+            elapsed += tick
             watchdog.feed(swarm.bytes_done)
-            if on_progress is not None:
+            if on_progress is not None and elapsed + 1e-9 >= interval:
+                elapsed = 0.0
                 await on_progress(swarm.bytes_done / total)
 
     # -- peer plumbing ---------------------------------------------------

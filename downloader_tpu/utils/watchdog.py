@@ -45,12 +45,21 @@ class StallWatchdog:
     (lib/download.js:92-100).
     """
 
-    def __init__(self, timeout: float = STALL_TIMEOUT_SECONDS):
+    def __init__(self, timeout: float = STALL_TIMEOUT_SECONDS,
+                 on_feed=None):
         self.timeout = timeout
+        # optional per-feed tap: every transfer loop already feeds the
+        # watchdog its cumulative byte count, which makes this the one
+        # cheap place to mirror live progress into the job's
+        # control-plane record (flight-recorder throughput sampling)
+        # without touching each chunk loop
+        self._on_feed = on_feed
         self._progress: Optional[float] = None
 
     def feed(self, progress: float) -> None:
         self._progress = progress
+        if self._on_feed is not None:
+            self._on_feed(progress)
 
     async def watch(self, coro):
         task = asyncio.ensure_future(coro)

@@ -1,0 +1,425 @@
+"""Observability-layer tests: per-job flight recorder, runtime
+introspection, and trace/log/metric correlation.
+
+The acceptance slice: a job that fails mid-transfer yields a retrievable
+timeline via ``GET /v1/jobs/{id}/events`` containing its state
+transitions, at least one throughput sample, and the trace_id that also
+appears in that job's log lines; loop-lag and exporter-health metrics
+render on ``/metrics``.
+"""
+
+import asyncio
+import io
+import json
+import time
+
+import pytest
+from aiohttp import web
+
+from test_control import make_download_msg, serve_admin, wait_for
+
+from downloader_tpu import schemas
+from downloader_tpu.control.registry import (
+    ADMITTED, DONE, FAILED, PUBLISHING, RUNNING, JobRegistry,
+)
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import Logger, NullLogger
+from downloader_tpu.platform.obs import (
+    FlightRecorder, LoopLagMonitor, TransferProfiler, dump_stacks,
+    dump_tasks,
+)
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.platform.tracing import OtlpExporter, Tracer
+from downloader_tpu.store import InMemoryObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder ring
+# ---------------------------------------------------------------------------
+
+def test_recorder_ring_is_bounded():
+    recorder = FlightRecorder(limit=8)
+    for i in range(100):
+        recorder.record("throughput", i=i)
+    events = recorder.events()
+    assert len(events) == 8
+    assert recorder.dropped == 92
+    # newest kept, oldest dropped
+    assert [e["i"] for e in events] == list(range(92, 100))
+    assert recorder.tail(3) == events[-3:]
+
+
+def test_retry_looping_job_events_stay_bounded():
+    """A job hammered with events (the retry-loop shape) never grows its
+    record past the configured ring."""
+    registry = JobRegistry(recorder_events=16)
+    record = registry.register("j1", "c")
+    for i in range(5000):
+        record.event("retry", failures=i)
+        record.event("error", type="RuntimeError", error="boom")
+    assert len(record.recorder) == 16
+    assert record.recorder.dropped > 0
+
+
+def test_registry_transitions_feed_recorder():
+    registry = JobRegistry()
+    record = registry.register("j1", "c", priority="HIGH")
+    registry.transition(record, ADMITTED)
+    registry.transition(record, RUNNING, stage="download")
+    registry.transition(record, RUNNING, stage="process")
+    registry.transition(record, PUBLISHING)
+    registry.transition(record, DONE)
+    kinds = [e["kind"] for e in record.recorder.events()]
+    assert kinds[0] == "received"
+    assert kinds.count("state") == 5
+    states = [e for e in record.recorder.events() if e["kind"] == "state"]
+    assert states[0]["from"] == "RECEIVED" and states[0]["to"] == "ADMITTED"
+    # a stage hop names BOTH sides: the stage entered and the closed
+    # stage whose timing it carries (they must never be conflated)
+    hop = states[2]
+    assert hop["stage"] == "process"
+    assert hop["stage_closed"] == "download" and "stage_s" in hop
+    # cancel token firing is recorded too
+    record2 = registry.register("j2", "c")
+    registry.cancel("j2", reason="op")
+    assert any(e["kind"] == "cancel_requested" and e["reason"] == "op"
+               for e in record2.recorder.events())
+
+
+def test_debug_bundle_logged_for_failed_job():
+    stream = io.StringIO()
+    registry = JobRegistry(logger=Logger("test", stream=stream))
+    record = registry.register("j1", "card-1")
+    record.trace_id = "t" * 32
+    registry.transition(record, FAILED, reason="stage_error")
+    lines = [json.loads(line) for line in
+             stream.getvalue().strip().splitlines()]
+    bundle = [l for l in lines if l["msg"] == "job debug bundle"]
+    assert len(bundle) == 1
+    assert bundle[0]["jobId"] == "j1"
+    assert bundle[0]["traceId"] == "t" * 32
+    assert any(e["kind"] == "state" for e in bundle[0]["events"])
+    # DONE jobs get no bundle
+    record2 = registry.register("j2", "c")
+    registry.transition(record2, ADMITTED)
+    registry.transition(record2, PUBLISHING)
+    registry.transition(record2, DONE)
+    assert stream.getvalue().count("job debug bundle") == 1
+
+
+# ---------------------------------------------------------------------------
+# Tracing: monotonic durations, id injection, exporter health
+# ---------------------------------------------------------------------------
+
+def test_span_duration_is_monotonic_and_otlp_stays_wall():
+    tracer = Tracer("test")
+    with tracer.span("op") as span:
+        wall_start = span.start
+        time.sleep(0.01)
+    assert span.end is not None and span.end >= span.start
+    assert span.duration >= 0.009
+    # the OTLP anchor is still wall-clock epoch seconds
+    assert abs(wall_start - time.time()) < 60
+
+
+def test_tracer_span_accepts_explicit_ids():
+    tracer = Tracer("test")
+    with tracer.span("job", trace_id="ab" * 16, span_id="cd" * 8) as span:
+        assert span.trace_id == "ab" * 16
+        assert span.span_id == "cd" * 8
+    assert tracer.spans("job")[0].trace_id == "ab" * 16
+
+
+def test_exporter_and_buffer_gauges_render():
+    metrics = prom.new("obsgauge")
+    exporter = OtlpExporter("http://127.0.0.1:9", "svc", interval=0.05)
+    tracer = Tracer("svc", exporter=exporter)
+    try:
+        metrics.bind_tracer(tracer)
+        with tracer.span("op"):
+            pass
+        text = metrics.render().decode()
+        assert "obsgauge_tracer_buffer_spans 1.0" in text
+        assert "obsgauge_otlp_spans_exported" in text
+        assert "obsgauge_otlp_spans_dropped" in text
+        assert "obsgauge_otlp_export_errors" in text
+        assert "obsgauge_otlp_queue_depth" in text
+    finally:
+        tracer.close()
+
+
+def test_tracer_close_logs_exporter_tally():
+    stream = io.StringIO()
+    exporter = OtlpExporter("http://127.0.0.1:9", "svc", interval=0.05)
+    tracer = Tracer("svc", exporter=exporter)
+    tracer.logger = Logger("svc", stream=stream)
+    tracer.close()
+    lines = [json.loads(line) for line in
+             stream.getvalue().strip().splitlines()]
+    flushed = [l for l in lines if l["msg"] == "otlp exporter flushed"]
+    assert len(flushed) == 1
+    assert {"exported", "dropped", "errors", "queued"} <= set(flushed[0])
+
+
+# ---------------------------------------------------------------------------
+# Loop-lag monitor
+# ---------------------------------------------------------------------------
+
+async def test_loop_lag_monitor_detects_blocked_loop():
+    metrics = prom.new("obslag")
+    monitor = LoopLagMonitor(metrics=metrics, interval=0.05)
+    monitor.start()
+    try:
+        await asyncio.sleep(0.12)  # a couple of clean samples
+        time.sleep(0.3)            # deliberately block the loop
+        await asyncio.sleep(0.12)  # let the monitor observe the lag
+    finally:
+        await monitor.stop()
+    assert monitor.max_lag >= 0.2
+    assert metrics.event_loop_lag_hist._sum.get() >= 0.2
+    text = metrics.render().decode()
+    assert "obslag_event_loop_lag_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# Transfer profiler
+# ---------------------------------------------------------------------------
+
+def test_transfer_profiler_samples_throughput_and_stalls():
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    registry.transition(record, ADMITTED)
+    registry.transition(record, RUNNING, stage="download")
+    profiler = TransferProfiler(registry, interval=0.01, stall_samples=2)
+
+    profiler.sample()  # baseline
+    record.note_transfer("download", 1 << 20)
+    profiler.sample()  # movement -> throughput event
+    samples = [e for e in record.recorder.events()
+               if e["kind"] == "throughput"]
+    assert len(samples) == 1
+    assert samples[0]["stage"] == "download"
+    assert samples[0]["bytes"] == 1 << 20
+    assert samples[0]["bps"] > 0
+
+    profiler.sample()  # flat 1
+    profiler.sample()  # flat 2 -> stall_suspect
+    profiler.sample()  # stays flat: no duplicate event
+    stalls = [e for e in record.recorder.events()
+              if e["kind"] == "stall_suspect"]
+    assert len(stalls) == 1
+    # terminal records stop being tracked
+    registry.transition(record, FAILED, reason="test")
+    profiler.sample()
+    assert record.uid not in profiler._last
+
+
+def test_transfer_profiler_never_flags_compute_stages():
+    """A RUNNING stage that feeds no live counters (upscale/process —
+    device work, not a transfer) must never read as a stalled transfer,
+    no matter how long it stays flat."""
+    registry = JobRegistry()
+    record = registry.register("j1", "c")
+    registry.transition(record, ADMITTED)
+    registry.transition(record, RUNNING, stage="upscale")
+    profiler = TransferProfiler(registry, interval=0.01, stall_samples=2)
+    for _ in range(10):
+        profiler.sample()
+    assert not [e for e in record.recorder.events()
+                if e["kind"] == "stall_suspect"]
+
+
+# ---------------------------------------------------------------------------
+# Task / stack dumps
+# ---------------------------------------------------------------------------
+
+async def test_dump_tasks_and_stacks():
+    async def parked():
+        await asyncio.sleep(30)
+
+    task = asyncio.get_running_loop().create_task(parked())
+    task.set_name("obs-parked-task")
+    await asyncio.sleep(0.01)
+    try:
+        tasks = dump_tasks()
+        names = [t["name"] for t in tasks]
+        assert "obs-parked-task" in names
+        parked_dump = next(t for t in tasks if t["name"] == "obs-parked-task")
+        assert any("parked" in line for line in parked_dump["stack"])
+        stacks = dump_stacks()
+        assert any(t["name"] == "MainThread" for t in stacks["threads"])
+        assert any(t["name"] == "obs-parked-task" for t in stacks["tasks"])
+    finally:
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: failed mid-transfer job -> joinable timeline/logs/ids
+# ---------------------------------------------------------------------------
+
+async def start_failing_server(chunks=30, chunk=b"x" * 8192, delay=0.02):
+    """Streams ``chunks`` then drops the connection mid-body (chunked
+    encoding never terminated), so the client errors mid-transfer."""
+    async def serve(request):
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        for _ in range(chunks):
+            await resp.write(chunk)
+            await asyncio.sleep(delay)
+        request.transport.close()  # mid-body: a truncated chunked stream
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_failed_midtransfer_job_timeline_logs_and_metrics(tmp_path):
+    log_stream = io.StringIO()
+    broker = InMemoryBroker(max_redeliveries=0)  # one attempt, then drop
+    server, base = await start_failing_server()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "downloads")},
+            # fast profiler/lag cadences so the short transfer is sampled
+            "obs": {"profile_interval": 0.03, "loop_lag_interval": 0.05},
+        }),
+        mq=MemoryQueue(broker),
+        store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new("obsaccept"),
+        logger=Logger("downloader", stream=log_stream),
+    )
+    await orchestrator.start()
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/media.mkv", "job-f"))
+        async with asyncio.timeout(30):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        record = orchestrator.registry.get("job-f")
+        await wait_for(lambda: record is not None and record.terminal)
+        assert record.state == FAILED and record.reason == "stage_error"
+
+        # -- the timeline is retrievable over the admin API ------------
+        async with session.get(f"{api}/v1/jobs/job-f/events") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+        kinds = [e["kind"] for e in body["events"]]
+        assert "state" in kinds            # lifecycle transitions
+        assert "throughput" in kinds       # >= 1 mid-transfer sample
+        assert "error" in kinds and "settle" in kinds
+        samples = [e for e in body["events"] if e["kind"] == "throughput"]
+        assert any(s["bytes"] > 0 for s in samples)
+
+        # -- the trace id joins the timeline and the log lines ---------
+        trace_id = body["traceId"]
+        assert trace_id and len(trace_id) == 32
+        job_logs = [json.loads(line) for line in
+                    log_stream.getvalue().strip().splitlines()
+                    if '"jobId": "job-f"' in line]
+        assert job_logs and all(l["traceId"] == trace_id for l in job_logs)
+        span_events = [e for e in body["events"] if e["kind"] == "span"]
+        assert span_events[0]["traceId"] == trace_id
+        # the failed job's debug bundle rode the logs too
+        assert any(l["msg"] == "job debug bundle" for l in job_logs)
+
+        # -- wait histograms aggregated the two registry latencies -----
+        metrics = orchestrator.metrics
+        assert metrics.queue_wait_seconds._sum.get() >= 0.0
+        text = metrics.render().decode()
+        assert "obsaccept_queue_wait_seconds_count 1.0" in text
+        assert "obsaccept_scheduler_wait_seconds_count 1.0" in text
+        assert "obsaccept_event_loop_lag_seconds" in text
+
+        # -- debug endpoints answer ------------------------------------
+        async with session.get(f"{api}/debug/tasks") as resp:
+            assert resp.status == 200
+            tasks_body = await resp.json()
+        assert "loopLag" in tasks_body and tasks_body["tasks"]
+        async with session.get(f"{api}/debug/stacks") as resp:
+            assert resp.status == 200
+            stacks_body = await resp.json()
+        assert stacks_body["threads"]
+
+        # unknown job still 404s
+        async with session.get(f"{api}/v1/jobs/nope/events") as resp:
+            assert resp.status == 404
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+        await server.cleanup()
+
+
+async def test_events_endpoint_for_successful_job(tmp_path):
+    """A clean end-to-end job's timeline closes with publish + DONE, and
+    GET /v1/jobs/{id} carries the correlation ids."""
+    payload = b"m" * (1 << 18)
+
+    async def serve(_request):
+        return web.Response(body=payload)
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "downloads")},
+        }),
+        mq=MemoryQueue(broker),
+        store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new("obsdone"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        broker.publish(
+            schemas.DOWNLOAD_QUEUE,
+            make_download_msg(f"http://127.0.0.1:{port}/media.mkv", "job-ok"),
+        )
+        async with asyncio.timeout(30):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        async with session.get(f"{api}/v1/jobs/job-ok/events") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+        kinds = [e["kind"] for e in body["events"]]
+        for expected in ("received", "delivered", "span", "queue_wait",
+                         "sched_wait", "state", "publish", "settle"):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+        settle = [e for e in body["events"] if e["kind"] == "settle"][-1]
+        assert settle["mode"] == "ack" and settle["why"] == "done"
+        async with session.get(f"{api}/v1/jobs/job-ok") as resp:
+            show = await resp.json()
+        assert show["traceId"] == body["traceId"]
+        assert show["spanId"] == body["spanId"]
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
