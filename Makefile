@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench proto lint run docker integration
+.PHONY: test bench bench-overlap proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -18,6 +18,11 @@ lint:
 
 bench:
 	python bench.py
+
+# standalone streaming-vs-barrier stage-overlap bench (one JSON line:
+# stage_overlap_speedup must stay >= 1.25, time_to_staged_ms alongside)
+bench-overlap:
+	python bench.py --overlap
 
 # regenerate protobuf gencode after editing downloader.proto
 proto:

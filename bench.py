@@ -82,7 +82,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  recorder traffic the orchestrator/stages add on top of transitions
 #  (~11 events + 3 live transfer samples against a wrapping ring, the
 #  worst case); guard < 1 ms/job (recorder_overhead_ok).
-HARNESS_VERSION = 10
+# v11 (r9): staging/compute/torrent/fan-in/control measurements are
+#  identical to v10 (the staging bench runs whatever dispatch mode the
+#  service defaults to — now the streaming pipeline; its single-file
+#  HTTP jobs have no overlap to exploit, so the series stays
+#  comparable).  New stage-overlap workload: ONE synthetic multi-file
+#  torrent job (loopback seeder + tracker, MiniS3 staging store, both
+#  rate buckets pacing ingress and egress to the same budget so the
+#  measured wall is sleep-dominated and host-noise-immune) run
+#  pipelined vs barrier — stage_overlap_speedup = barrier wall /
+#  pipelined wall (median of 3 interleaved rounds, guard >= 1.25) and
+#  time_to_staged_ms = the pipelined job's publish -> done-marker wall.
+#  ``python bench.py --overlap`` runs this workload standalone
+#  (`make bench-overlap`).
+HARNESS_VERSION = 11
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -581,6 +594,154 @@ def _bench_control_safe() -> dict:
         return asyncio.run(bench_control())
     except Exception as err:
         return {"control_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+async def bench_stage_overlap() -> dict:
+    """Streaming stage overlap (harness v11): pipelined vs barrier.
+
+    One synthetic multi-file torrent job — loopback seeder + tracker,
+    MiniS3 staging store (the real SigV4 driver) — run twice per round:
+    ``instance.pipeline: barrier`` (the historical strict stage barrier)
+    and ``streaming`` (per-file download ∥ filter ∥ upload).  Ingress
+    AND egress ride token buckets with the same byte budget, so each
+    phase's wall is dominated by deterministic pacing sleeps rather than
+    loopback CPU — on this shared host that makes the ratio the
+    noise-robust comparator (same de-noising as the fan-in/torrent
+    benches).  Barrier pays download + upload serially; the pipeline
+    overlaps them, so the ratio trends toward 2 as file count grows.
+
+    - ``stage_overlap_speedup`` = barrier wall / pipelined wall, median
+      of 3 interleaved rounds; guard ``stage_overlap_ok`` >= 1.25.
+    - ``time_to_staged_ms`` = the pipelined job's publish -> settled
+      wall (every file staged + done marker + convert published).
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    # the hermetic S3/tracker fixtures live with the tests (MiniS3 is
+    # the acceptance store the ISSUE names); they are plain modules
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from minis3 import MiniS3
+    from minitracker import MiniTracker
+
+    from downloader_tpu import schemas
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store.s3 import S3ObjectStore
+    from downloader_tpu.torrent import Seeder, make_metainfo
+    from downloader_tpu.torrent.magnet import make_magnet
+
+    file_count = int(os.environ.get("BENCH_OVERLAP_FILES", 8))
+    mib_per_file = int(os.environ.get("BENCH_OVERLAP_MIB_PER_FILE", 2))
+    # 4 MiB/s: low enough that pacing sleeps dominate the wall on both
+    # arms (the single-core host's CPU contention then cancels in the
+    # ratio), high enough to keep the whole workload under ~1 minute
+    rate = int(os.environ.get("BENCH_OVERLAP_RATE", 4 << 20))  # bytes/s
+    reps = int(os.environ.get("BENCH_OVERLAP_REPS", 3))
+    # env knobs outrank per-instance config (repo convention, like
+    # MAX_CONCURRENT_JOBS) — an exported PIPELINE_MODE would pin BOTH
+    # arms to one mode (speedup ~1.0), an exported CACHE_DIR would serve
+    # every run after the first from the content cache (all six runs
+    # share one torrent info-hash), and UPLOAD_CONCURRENCY would change
+    # the streaming arm's pool from the default being measured
+    for knob in ("PIPELINE_MODE", "CACHE_DIR", "CACHE_ENABLED",
+                 "UPLOAD_CONCURRENCY"):
+        os.environ.pop(knob, None)
+
+    tmp = tempfile.mkdtemp()
+    src = os.path.join(tmp, "seed", "Bench Movie")
+    os.makedirs(src)
+    for i in range(file_count):
+        with open(os.path.join(src, f"ep{i}.mkv"), "wb") as fh:
+            fh.write(os.urandom(mib_per_file << 20))
+    meta = make_metainfo(src, piece_length=1 << 18)
+    seeder = Seeder(meta, os.path.join(tmp, "seed"))
+    port = await seeder.start()
+    tracker = MiniTracker([("127.0.0.1", port)])
+    tracker_url = await tracker.start()
+    magnet = make_magnet(meta.info_hash, meta.name, [tracker_url])
+    s3 = MiniS3()
+    await s3.start()
+
+    async def run_mode(tag: str, mode: str) -> float:
+        store = S3ObjectStore(f"http://127.0.0.1:{s3.port}",
+                              "AKIA", "SECRET")
+        work = os.path.join(tmp, f"work-{tag}")
+        broker = InMemoryBroker()
+        orchestrator = Orchestrator(
+            config=ConfigNode({"instance": {
+                "download_path": os.path.join(work, "dl"),
+                "pipeline": mode,
+                "download_rate_limit": rate,
+                "upload_rate_limit": rate,
+            }}),
+            mq=MemoryQueue(broker),
+            store=store,
+            telemetry=Telemetry(MemoryQueue(broker)),
+            logger=NullLogger(),
+        )
+        await orchestrator.start()
+        started = time.monotonic()
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(
+            schemas.Download(media=schemas.Media(
+                id=f"overlap-{tag}", creator_id="bench",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("TORRENT"),
+                source_uri=magnet,
+            ))
+        ))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=600)
+        elapsed = time.monotonic() - started
+        converts = len(broker.published(schemas.CONVERT_QUEUE))
+        assert converts == 1, f"{tag}: {converts}/1 jobs completed"
+        await orchestrator.shutdown(grace_seconds=5)
+        await store.close()
+        shutil.rmtree(work, ignore_errors=True)
+        return elapsed
+
+    ratios, barrier_walls, staged_walls = [], [], []
+    try:
+        # interleaved rounds, per-round ratio: cross-round ratios would
+        # mix host states (BASELINE.md de-noising discipline)
+        for rep in range(reps):
+            barrier_s = await run_mode(f"b{rep}", "barrier")
+            pipelined_s = await run_mode(f"s{rep}", "streaming")
+            ratios.append(barrier_s / pipelined_s)
+            barrier_walls.append(barrier_s)
+            staged_walls.append(pipelined_s)
+    finally:
+        await seeder.stop()
+        await tracker.stop()
+        await s3.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    speedup = statistics.median(ratios)
+    return {
+        "stage_overlap_speedup": round(speedup, 2),
+        "stage_overlap_ok": speedup >= 1.25,
+        "time_to_staged_ms": round(
+            statistics.median(staged_walls) * 1000, 1),
+        "time_to_staged_barrier_ms": round(
+            statistics.median(barrier_walls) * 1000, 1),
+        "stage_overlap_files": file_count,
+        "stage_overlap_mib": file_count * mib_per_file,
+        "stage_overlap_rate_mibps": round(rate / (1 << 20), 1),
+        "stage_overlap_reps": reps,
+    }
+
+
+def _bench_stage_overlap_safe() -> dict:
+    """An overlap-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_stage_overlap())
+    except Exception as err:
+        return {"stage_overlap_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 _COMPUTE_SNIPPET = """
@@ -1223,6 +1384,9 @@ HEADLINE_KEYS = [
     "registry_overhead_ms",       # r7 guard: must stay < 1 ms/job
     "recorder_overhead_ms",       # r8 guard: flight recorder < 1 ms/job
     "control_bench_error",        # present only on failure — visible
+    "stage_overlap_speedup",      # r9 pipeline vs barrier bar: >= 1.25
+    "time_to_staged_ms",          # r9: pipelined multi-file job wall
+    "stage_overlap_error",        # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1248,6 +1412,11 @@ def compact_final_line(metric: dict, extra: dict) -> str:
 
 
 def main() -> None:
+    if "--overlap" in sys.argv:
+        # standalone stage-overlap run (`make bench-overlap`): one JSON
+        # line, no other workloads
+        print(json.dumps(_bench_stage_overlap_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -1266,6 +1435,7 @@ def main() -> None:
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
         **_bench_control_safe(),
+        **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),
         **bench_upscale_pipeline(),

@@ -10,6 +10,13 @@ spot where a job parked in the admission gate was invisible to
     RECEIVED -> ADMITTED -> RUNNING(stage) -> PUBLISHING
                                  -> DONE | FAILED | CANCELLED | DROPPED_POISON
 
+``stage`` is the sequential stage name under the barrier dispatch
+(download/process/upload[/upscale]); the streaming dispatch runs all
+three logical stages overlapped and carries one combined
+``RUNNING("pipeline")`` attribution instead — per-file detail rides the
+flight recorder's ``file_complete``/``upload_start``/``upload_done``
+events, and ``stage_seconds`` accumulates under ``"pipeline"``.
+
 Illegal transitions raise :class:`IllegalTransition` (a lifecycle bug
 must fail loudly, not corrupt operator-facing state).  Each record keeps
 per-stage wall timing, byte counters sampled from stage progress, and

@@ -50,6 +50,8 @@ from .platform.tracing import (NullTracer, Tracer, format_traceparent,
                                parse_traceparent)
 from .stages.base import STAGES, Job, StageContext, load_stages
 from .stages.download import job_download_dir
+from .stages.streaming import (PIPELINE_STAGE, pipeline_mode,
+                               run_streaming_job)
 from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
 from .store.cache import ContentCache
@@ -125,6 +127,19 @@ class Orchestrator:
         if prefetch < 1:
             raise ValueError(f"max_concurrent_jobs must be >= 1, got {prefetch}")
         self.prefetch = prefetch
+
+        # stage dispatch mode (stages/streaming.py): "streaming" (the
+        # default) overlaps download/filter/upload per file for the
+        # standard three-stage chain; "barrier" (instance.pipeline /
+        # PIPELINE_MODE) keeps the exact sequential stage loop.  Custom
+        # stage chains (e.g. the config-gated upscale stage) always run
+        # the barrier loop — the streaming runner models only the
+        # default download -> process -> upload topology.
+        self.pipeline_mode = pipeline_mode(config)
+        self.streaming_enabled = (
+            self.pipeline_mode == "streaming"
+            and self.stage_names == list(STAGES)
+        )
 
         # control plane (control/): every delivery is registered at
         # receipt and steered through the lifecycle state machine;
@@ -541,7 +556,11 @@ class Orchestrator:
             cancel=token,
             record=record,
         )
-        stage_table = await load_stages(ctx, self.stage_names)
+        # the streaming dispatch builds what it needs itself (the download
+        # stage against a merged-progress facade, the per-file Uploader);
+        # only the barrier loop wants the full stage table
+        stage_table = (None if self.streaming_enabled
+                       else await load_stages(ctx, self.stage_names))
 
         # idempotency probe (reference lib/main.js:119-124)
         already_staged = True
@@ -555,31 +574,54 @@ class Orchestrator:
             logger.info("starting main processor after successful stage init")
             last_stage_data: object = {}
             try:
-                for name in self.stage_names:
+                if self.streaming_enabled:
+                    # pipelined dispatch (stages/streaming.py): one
+                    # combined RUNNING("pipeline") attribution — the
+                    # three logical stages run overlapped, and the
+                    # per-file detail rides the flight recorder's
+                    # file_complete/upload_start/upload_done events
                     self.registry.transition(record, control.RUNNING,
-                                             stage=name)
+                                             stage=PIPELINE_STAGE)
                     token.raise_if_cancelled()
-                    job = Job(media=msg.media, last_stage=last_stage_data)
-                    logger.info("invoking stage", stage=name)
+                    logger.info("invoking streaming pipeline")
                     started = time.monotonic()
                     try:
-                        # the guard bounds the whole stage dispatch by the
-                        # cancel token: even a stage blocked somewhere
-                        # without a cooperative check (DNS, TLS
-                        # handshake, a wedged origin) unwinds promptly
-                        last_stage_data = await token.guard(
-                            stage_table[name](job)
-                        )
+                        await token.guard(run_streaming_job(ctx, msg.media))
                     finally:
                         if self.metrics is not None:
-                            self.metrics.stage_seconds.labels(stage=name).observe(
-                                time.monotonic() - started
+                            self.metrics.stage_seconds.labels(
+                                stage=PIPELINE_STAGE
+                            ).observe(time.monotonic() - started)
+                else:
+                    for name in self.stage_names:
+                        self.registry.transition(record, control.RUNNING,
+                                                 stage=name)
+                        token.raise_if_cancelled()
+                        job = Job(media=msg.media,
+                                  last_stage=last_stage_data)
+                        logger.info("invoking stage", stage=name)
+                        started = time.monotonic()
+                        try:
+                            # the guard bounds the whole stage dispatch
+                            # by the cancel token: even a stage blocked
+                            # somewhere without a cooperative check (DNS,
+                            # TLS handshake, a wedged origin) unwinds
+                            # promptly
+                            last_stage_data = await token.guard(
+                                stage_table[name](job)
                             )
-                    # NOTE: the reference emits ``emitter.emit('progress', 0)``
-                    # here (lib/main.js:139) but no listener exists in either
-                    # codebase, and forwarding a hardcoded 0 to telemetry
-                    # would reset real stage progress — deliberately dropped
-                    # (PARITY.md "Reference bugs fixed").
+                        finally:
+                            if self.metrics is not None:
+                                self.metrics.stage_seconds.labels(
+                                    stage=name
+                                ).observe(time.monotonic() - started)
+                        # NOTE: the reference emits
+                        # ``emitter.emit('progress', 0)`` here
+                        # (lib/main.js:139) but no listener exists in
+                        # either codebase, and forwarding a hardcoded 0
+                        # to telemetry would reset real stage progress —
+                        # deliberately dropped (PARITY.md "Reference
+                        # bugs fixed").
             except JobCancelled:
                 raise  # settled by the processor (ack, cleanup, CANCELLED)
             except Exception as err:

@@ -54,6 +54,12 @@ DEFAULT_PROFILE_INTERVAL = 5.0
 # consecutive flat profiler samples before a RUNNING transfer is flagged
 DEFAULT_STALL_SAMPLES = 3
 
+# the streaming dispatch's combined RUNNING-stage attribution
+# (stages/streaming.py runs download ∥ process ∥ upload as one stage).
+# A string literal here, not an import — this module must not import the
+# stages package (stages -> control -> this module would cycle).
+PIPELINE_STAGE = "pipeline"
+
 
 class FlightRecorder:
     """Bounded ring of structured events for one job.
@@ -213,9 +219,17 @@ class TransferProfiler:
                 # only flag stages whose live counter was actually
                 # flowing (a "download"/"upload" key exists for THIS
                 # stage): compute stages (upscale/process) feed no
-                # counters and must never read as stalled transfers
+                # counters and must never read as stalled transfers.
+                # The streaming dispatch's combined "pipeline" stage is
+                # flagged on any LIVE counter — the runner retires both
+                # counters once ingress completes (moving uploads
+                # reinstall theirs), so its CPU-only reconciliation
+                # phases carry no counters and stay exempt, matching
+                # the barrier stages' behavior.
                 if (flat == self.stall_samples
-                        and record.stage in record.transferred):
+                        and (record.stage in record.transferred
+                             or (record.stage == PIPELINE_STAGE
+                                 and record.transferred))):
                     record.event(
                         "stall_suspect", stage=record.stage, total=total,
                         flat_s=round(self.interval * flat, 2),

@@ -11,12 +11,23 @@ lib/upload.js:14-17).  The orchestrator loads stages by name from the
 Differences from the reference, per SURVEY.md §7 step 6 (bug fixes):
 - telemetry is an explicit ``StageContext`` field, not a ``global.telem``
 - the tracer is threaded through and actually used
+
+Streaming hand-off (beyond reference): alongside the ``last_stage``
+barrier contract, a job may carry a :class:`FileStream` — the download
+stage announces each durably-complete file into it (``FileEvent``) the
+moment its bytes are final, so the streaming pipeline
+(stages/streaming.py) can filter and upload that file while later files
+are still downloading.  Stages that ignore ``job.file_stream`` keep
+working unchanged: the pipeline reconciles against the authoritative
+directory walk when the download completes.
 """
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import importlib
+import os
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from .. import schemas
@@ -31,6 +42,71 @@ STAGES = ["download", "process", "upload"]
 
 
 @dataclasses.dataclass
+class FileEvent:
+    """One durably-complete file, announced by the download stage while the
+    rest of the job may still be transferring.
+
+    "Durable" means the file's bytes are final on disk: torrent files whose
+    every overlapping piece is SHA-1-verified and written, HTTP downloads at
+    promote time (``.partial`` renamed onto the output name), bucket objects
+    after their ``fget`` completes.  Downstream consumers (the streaming
+    pipeline's filter + upload pool) may read the file immediately.
+    """
+
+    path: str
+    size: int = 0
+
+
+class FileStream:
+    """Bounded hand-off channel from the download stage to the streaming
+    pipeline (stages/streaming.py).
+
+    ``emit`` applies backpressure when the consumer lags (the producer's
+    transfer loop slows instead of buffering unboundedly) and becomes a
+    no-op once the stream is closed, so late announcements — e.g. from a
+    source that keeps calling back after the consumer gave up — never
+    error the producer.  ``next`` returns ``None`` when the stream is
+    closed and drained.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, maxsize: int = 1024):
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize)
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def emit(self, path: str, size: Optional[int] = None) -> None:
+        if self._closed:
+            return
+        if size is None:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+        await self._queue.put(FileEvent(path=path, size=int(size)))
+
+    async def close(self) -> None:
+        """Append the end-of-stream sentinel (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        await self._queue.put(self._SENTINEL)
+
+    async def next(self) -> Optional[FileEvent]:
+        """Next event, or None once the stream is closed and drained."""
+        item = await self._queue.get()
+        if item is self._SENTINEL:
+            # keep the sentinel visible for any other reader
+            self._queue.put_nowait(self._SENTINEL)
+            return None
+        return item
+
+
+@dataclasses.dataclass
 class Job:
     """What a stage receives: the decoded message plus the previous stage's
     result (reference ``_.create(msg, {lastStage})``, lib/main.js:131-133)."""
@@ -41,6 +117,12 @@ class Job:
     # (store/cache.py): a ``report(percent)`` callable whose updates are
     # re-emitted through each coalesced waiter's own telemetry
     cache_report: Any = None
+    # streaming hand-off (stages/streaming.py): when the orchestrator runs
+    # the pipelined dispatch it sets a FileStream here, and the download
+    # stage announces each durably-complete file into it the moment its
+    # bytes are final — None (barrier mode / standalone stage use) keeps
+    # the exact pre-streaming behavior
+    file_stream: Optional[FileStream] = None
 
 
 @dataclasses.dataclass

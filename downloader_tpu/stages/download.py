@@ -310,6 +310,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     if seg_count < 1 or seg_count > 64:
         raise ValueError(f"http_segments must be in [1, 64], got {seg_count}")
 
+    async def _announce_file(job: Job, path: str, size=None) -> None:
+        """Streaming hand-off: tell the pipeline this file's bytes are
+        final (stages/base.py FileStream).  No-op in barrier mode and in
+        standalone stage use (``job.file_stream`` is None there).
+        getattr, not attribute access: jobs are duck-typed here, like
+        ``cache_report`` below."""
+        stream = getattr(job, "file_stream", None)
+        if stream is not None:
+            await stream.emit(path, size)
+
     # One long-lived DHT node shared by every torrent job the orchestrator
     # runs (webtorrent likewise keeps a single bundled DHT instance for the
     # client's lifetime, lib/download.js:19).  Created lazily on the first
@@ -445,6 +455,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         stats: dict = {}
         record = ctx.record
+
+        async def _file_done(path: str, entry) -> None:
+            # per-file completion out of the client's drive loop: the
+            # file's last overlapping piece is verified and on disk
+            await _announce_file(job, path, entry.length)
+
         await client.download(
             resource_url,
             download_path,
@@ -461,6 +477,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             progress_sink=(None if record is None else
                            lambda n: record.note_transfer("download",
                                                           int(n))),
+            on_file_complete=(None if getattr(job, "file_stream", None)
+                              is None else _file_done),
         )
         if ctx.record is not None and stats:
             ctx.record.add_bytes(
@@ -1149,6 +1167,10 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             ctx.record.add_bytes("downloaded", total)
         if ctx.metrics is not None:
             ctx.metrics.bytes_downloaded.labels(protocol="http").inc(total)
+        # promote time: every _fetch exit path leaves the complete entity
+        # at ``output`` (fresh promote, resumed promote, or a previous
+        # attempt's validated file), so this IS the file's durable moment
+        await _announce_file(job, output)
 
     async def file(resource_url: str, file_id: str, download_path: str, job: Job):
         # (reference lib/download.js:177-189)
@@ -1168,6 +1190,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             ctx.metrics.bytes_downloaded.labels(protocol="file").inc(
                 os.path.getsize(output)
             )
+        await _announce_file(job, output)
 
     async def bucket(resource_url: str, file_id: str, download_path: str, job: Job):
         # (reference lib/download.js:199-227)
@@ -1182,6 +1205,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             sub_folder = params["sub_folder"]
             prefix = sub_folder.rstrip("/") + "/"
             total = 0
+            # materialize the listing — and pre-create every local parent
+            # directory — BEFORE the first byte moves: the streaming
+            # filter's directory verdicts (notably the sole-top-level
+            # rule) need the tree shape to be final when the first
+            # per-object completion event fires
+            items = []
             async for item in client.list_objects(params["bucket"], prefix):
                 cancel.raise_if_cancelled()
                 if not item.name:
@@ -1196,12 +1225,29 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 ]
                 if not parts:
                     continue
-                local = os.path.join(download_path, *parts)
+                items.append((item, os.path.join(download_path, *parts)))
+            for _item, local in items:
+                os.makedirs(os.path.dirname(local), exist_ok=True)
+                # zero-byte placeholder: the media filter's
+                # sole-top-level rule counts root-level FILES in its
+                # directory listing too, so every local path — not just
+                # the directories — must exist before the first event or
+                # an incremental verdict could diverge from the
+                # authoritative walk's.  fget truncates on write, and
+                # events only fire for fully-fetched objects, so a
+                # placeholder is never read as content.
+                with open(local, "ab"):
+                    pass
+            for item, local in items:
+                cancel.raise_if_cancelled()
                 logger.info("bucket fetch", object=item.name, to=local)
                 await client.fget_object(params["bucket"], item.name, local)
                 total += item.size
                 if ctx.record is not None:
                     ctx.record.note_transfer("download", total)
+                # per-object completion: the fget streamed to completion,
+                # so this object's local file is durable
+                await _announce_file(job, local, item.size)
             if ctx.record is not None:
                 ctx.record.add_bytes("downloaded", total)
             if ctx.metrics is not None:

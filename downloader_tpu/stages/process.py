@@ -75,6 +75,61 @@ def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
     return bool(_SEASON_RE.search(name))
 
 
+def stage_exts(config):
+    """The extension whitelist the stage actually runs with: the parity
+    set, plus raw ``.y4m`` when the upscale stage is enabled (shared by
+    the barrier stage below and the streaming pipeline's filter)."""
+    from .upscale import upscale_enabled
+
+    return MEDIA_EXTS | {".y4m"} if upscale_enabled(config) else MEDIA_EXTS
+
+
+def incremental_filter(root: str, media: schemas.Media, logger,
+                       exts=MEDIA_EXTS):
+    """Per-file media predicate for the streaming pipeline.
+
+    Returns ``allow(path) -> bool`` giving, for any file under ``root``,
+    the same verdict :func:`find_media_files` reaches for it — a file is
+    kept iff its extension is whitelisted, it is not a transcode temp,
+    and every ancestor directory up to ``root`` passes
+    :func:`_dir_allowed`.  Directory verdicts are memoized, which is
+    only sound while the tree *shape* is stable; every streaming source
+    guarantees that before its first event (torrents preallocate the
+    full layout, the bucket method pre-creates all directories from the
+    materialized listing, HTTP/file sources are a single file at the
+    root).  The authoritative post-download walk reconciles any
+    divergence regardless.
+    """
+    is_movie = media.type == schemas.MediaType.Value("MOVIE")
+    root = os.path.abspath(root)
+    verdicts = {root: True}
+
+    def _ancestors_allowed(dir_path: str) -> bool:
+        dir_path = os.path.abspath(dir_path)
+        cached = verdicts.get(dir_path)
+        if cached is not None:
+            return cached
+        if not dir_path.startswith(root + os.sep):
+            # outside the job workdir: never ours to stage
+            verdicts[dir_path] = False
+            return False
+        allowed = _ancestors_allowed(os.path.dirname(dir_path)) and (
+            _dir_allowed(root, dir_path, is_movie, logger)
+        )
+        verdicts[dir_path] = allowed
+        return allowed
+
+    def allow(path: str) -> bool:
+        name = os.path.basename(path)
+        if _PART_TEMP_RE.search(name):
+            return False
+        if os.path.splitext(name)[1] not in exts:
+            return False
+        return _ancestors_allowed(os.path.dirname(path))
+
+    return allow
+
+
 def find_media_files(root: str, media: schemas.Media, logger,
                      exts=MEDIA_EXTS) -> List[str]:
     """Depth-first walk honoring the filter; returns kept file paths.
@@ -126,9 +181,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     # config-gated divergence: with the upscale stage enabled, raw .y4m
     # streams (what a decode front-end emits) count as media too.  The
     # parity default stays the reference's exact whitelist.
-    from .upscale import upscale_enabled
-
-    exts = MEDIA_EXTS | {".y4m"} if upscale_enabled(ctx.config) else MEDIA_EXTS
+    exts = stage_exts(ctx.config)
 
     async def process(job: Job):
         # cooperative cancellation: the walk itself is fast local I/O,

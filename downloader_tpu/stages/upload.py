@@ -3,7 +3,9 @@
 Behavioral parity with /root/reference/lib/upload.js:
 
 - validates ``files`` is a list (lib/upload.js:21-23)
-- ensures bucket ``triton-staging`` exists (lib/upload.js:29-31)
+- ensures bucket ``triton-staging`` exists (lib/upload.js:29-31) — now
+  memoized per service in the cross-job ``ctx.resources``, so the
+  existence round trip is paid once per process, not once per job
 - object name = ``<media.id>/original/<base64(basename)>``
   (lib/upload.js:43-44)
 - per-file existence check; missing file is an error (lib/upload.js:38-41)
@@ -11,15 +13,24 @@ Behavioral parity with /root/reference/lib/upload.js:
 - writes ``<media.id>/original/done`` = ``"true"`` — the idempotency marker
   the orchestrator probes (lib/upload.js:55, lib/main.js:120)
 - best-effort removal of the download directory (lib/upload.js:60-64)
+
+The per-file machinery lives in :class:`Uploader` so the streaming
+pipeline (stages/streaming.py) can stage individual files from its
+bounded worker pool while the download is still running; the barrier
+stage below drives the same object through the reference's serial loop,
+so resume (`_already_staged`), pacing, metrics, and recorder events are
+one code path in both modes.
 """
 
 from __future__ import annotations
 
 import asyncio
 import base64
+import inspect
 import os
 import posixpath
 import shutil
+import time
 
 from .. import schemas
 from ..utils.hashing import md5_file_hex, multipart_etag_hex
@@ -72,21 +83,160 @@ async def _already_staged(store, name: str, file_path: str) -> bool:
     return info.etag == await asyncio.to_thread(md5_file_hex, file_path)
 
 
+class Uploader:
+    """Per-file staging engine, shared by the barrier stage and the
+    streaming pipeline.
+
+    One instance per job context; cross-job state (the egress token
+    bucket, the staging-bucket existence memo) lives in the orchestrator's
+    shared ``ctx.resources``.
+    """
+
+    def __init__(self, ctx: StageContext):
+        if ctx.store is None:
+            raise ValueError("upload stage requires a StageContext.store")
+        self.ctx = ctx
+        self.store = ctx.store
+        self.logger = ctx.logger
+        # service-wide egress cap (bytes/s) to the staging store, the
+        # mirror of the download stage's ingress bucket: ONE bucket shared
+        # by every job's uploads (memoized in the cross-job ctx.resources),
+        # so MinIO egress is cappable per instance
+        # (``instance.upload_rate_limit`` / 0 = unlimited, parity default)
+        from ..utils.ratelimit import shared_bucket
+
+        self.limiter = shared_bucket(ctx.resources, ctx.config,
+                                     "upload_rate_limit")
+        self.uploaded_total = 0
+
+    async def ensure_bucket(self) -> None:
+        """Staging-bucket existence, checked once per service.
+
+        The result memoizes in the cross-job ``ctx.resources`` only on
+        success, so a transient failure retries on the next job; two jobs
+        racing the first check both probe — make_bucket tolerates
+        already-exists, so the race is harmless.
+        """
+        if self.ctx.resources.get("staging_bucket_ready"):
+            return
+        if not await self.store.bucket_exists(STAGING_BUCKET):
+            await self.store.make_bucket(STAGING_BUCKET)
+        self.ctx.resources["staging_bucket_ready"] = True
+
+    def _put_supports_progress(self) -> bool:
+        """Whether the store's fput_object takes a per-part ``progress``
+        callback (store/s3.py does; tests monkeypatch fput freely, so the
+        probe runs per call, not at construction)."""
+        try:
+            return "progress" in inspect.signature(
+                self.store.fput_object
+            ).parameters
+        except (TypeError, ValueError):
+            return False
+
+    async def upload_file(self, media_id: str, file_path: str) -> int:
+        """Stage one file; returns the bytes uploaded (0 = resume skip).
+
+        Egress pacing is charged per multipart part when the store
+        reports upload progress (so a single 10 GiB file cannot burst the
+        instance's whole egress budget before the bucket pushes back),
+        and after the whole put otherwise.  Either way tokens are charged
+        only for bytes that actually moved — no refunds on failure, and
+        no up-front charge that a failed put would strand.
+        """
+        ctx = self.ctx
+        ctx.cancel.raise_if_cancelled()
+        basename = os.path.basename(file_path)
+        self.logger.info("upload", file=basename)
+        if not os.path.exists(file_path):
+            self.logger.error("failed to upload file, not found",
+                              file=file_path)
+            raise FileNotFoundError(f"{file_path} not found.")
+
+        name = object_name(media_id, file_path)
+        # file-level resume: a redelivered job (crash/nack before the
+        # done marker was written) skips files whose bytes are provably
+        # already staged — the reference re-uploads everything from
+        # scratch (lib/upload.js:34-52)
+        if await _already_staged(self.store, name, file_path):
+            self.logger.info("already staged, skipping", file=file_path)
+            if ctx.record is not None:
+                ctx.record.event("upload_done", file=basename, bytes=0,
+                                 skipped=True)
+            return 0
+
+        # size BEFORE the put: consume=True permits the backend to take
+        # the path destructively
+        size = os.path.getsize(file_path)
+        if ctx.record is not None:
+            ctx.record.event("upload_start", file=basename, bytes=size)
+        started = time.monotonic()
+        charged = 0
+
+        async def _paced(moved: int) -> None:
+            # per-part pacing + live transfer counter: the store calls
+            # this after each part (or the single put) lands
+            nonlocal charged
+            charged += moved
+            self.uploaded_total += moved
+            if ctx.record is not None:
+                ctx.record.note_transfer("upload", self.uploaded_total)
+            if self.limiter is not None:
+                await self.limiter.consume(moved)
+
+        # consume=True: the file's bytes are final (the download stage
+        # only announces durable files; the barrier stage runs last) and
+        # the whole download dir is deleted after the job settles
+        # (reference lib/upload.js:60-64), so the store may ingest by
+        # hardlink instead of a byte copy.  The contract permits
+        # aliasing only — the path stays on disk, which the streaming
+        # pipeline's post-download walk and the torrent serve path rely
+        # on (store/base.py fput_object).
+        if self._put_supports_progress():
+            await self.store.fput_object(
+                STAGING_BUCKET, name, file_path, consume=True,
+                progress=_paced,
+            )
+        else:
+            await self.store.fput_object(
+                STAGING_BUCKET, name, file_path, consume=True)
+            # charge AFTER the successful put: consume() deducts
+            # immediately and sleeps off the deficit, pacing the AVERAGE
+            # egress rate without hooks inside the store client's
+            # transfer loop.  Charging up front would strand service-wide
+            # tokens for bytes that never moved whenever a job is
+            # cancelled or the put fails mid-wait — debt every OTHER job
+            # would then sleep off.
+            await _paced(size)
+        if ctx.record is not None:
+            ctx.record.add_bytes("uploaded", size)
+            ctx.record.event(
+                "upload_done", file=basename, bytes=size,
+                seconds=round(time.monotonic() - started, 3),
+            )
+        if ctx.metrics is not None:
+            ctx.metrics.bytes_uploaded.inc(size)
+        return size
+
+    async def write_done_marker(self, media_id: str) -> None:
+        """Seal the staging set: the idempotency marker the orchestrator
+        probes — written only once EVERY file is staged."""
+        await self.store.put_object(
+            STAGING_BUCKET, done_marker_name(media_id), b"true"
+        )
+
+    async def cleanup_workdir(self, download_path: str) -> None:
+        """Best-effort download-dir removal (reference lib/upload.js:60-64)."""
+        try:
+            await asyncio.to_thread(shutil.rmtree, download_path)
+        except OSError as err:
+            self.logger.warn("failed to clean up directory", error=str(err))
+
+
 async def stage_factory(ctx: StageContext) -> StageFn:
     logger = ctx.logger
-    store = ctx.store
-    if store is None:
-        raise ValueError("upload stage requires a StageContext.store")
+    uploader = Uploader(ctx)
     downloading = schemas.TelemetryStatus.Value("DOWNLOADING")
-
-    # service-wide egress cap (bytes/s) to the staging store, the mirror
-    # of the download stage's ingress bucket: ONE bucket shared by every
-    # job's uploads (memoized in the cross-job ctx.resources), so MinIO
-    # egress is cappable per instance
-    # (``instance.upload_rate_limit`` / 0 = unlimited, parity default)
-    from ..utils.ratelimit import shared_bucket
-
-    limiter = shared_bucket(ctx.resources, ctx.config, "upload_rate_limit")
 
     async def upload(job: Job):
         last = job.last_stage
@@ -103,73 +253,25 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         logger.info("starting file upload", count=len(files))
         media_id = job.media.id
 
-        uploaded_total = 0
         with ctx.tracer.span("stage.upload", mediaId=media_id, files=len(files)):
-            if not await store.bucket_exists(STAGING_BUCKET):
-                await store.make_bucket(STAGING_BUCKET)
+            await uploader.ensure_bucket()
 
             for i, file_path in enumerate(files, start=1):
                 # cooperative cancellation at the per-file loop: already
                 # staged files stay staged (redelivery/resume semantics
                 # are unchanged), the current file simply never starts
-                ctx.cancel.raise_if_cancelled()
-                logger.info("upload", file=os.path.basename(file_path))
-                if not os.path.exists(file_path):
-                    logger.error("failed to upload file, not found", file=file_path)
-                    raise FileNotFoundError(f"{file_path} not found.")
-
-                name = object_name(media_id, file_path)
-                # file-level resume: a redelivered job (crash/nack before the
-                # done marker was written) skips files whose bytes are
-                # provably already staged — the reference re-uploads
-                # everything from scratch (lib/upload.js:34-52)
-                if await _already_staged(store, name, file_path):
-                    logger.info("already staged, skipping", file=file_path)
-                else:
-                    # size BEFORE the put: consume=True permits the
-                    # backend to take the path destructively
-                    size = os.path.getsize(file_path)
-                    # consume=True: the staged file is deleted with the
-                    # whole download dir right after this stage
-                    # (reference lib/upload.js:60-64), so the store may
-                    # ingest it by hardlink instead of a byte copy
-                    await store.fput_object(
-                        STAGING_BUCKET, name, file_path, consume=True)
-                    if limiter is not None:
-                        # charge AFTER the successful put: consume()
-                        # deducts immediately and sleeps off the deficit,
-                        # pacing the AVERAGE egress rate without hooks
-                        # inside the store client's transfer loop.
-                        # Charging up front would strand service-wide
-                        # tokens for bytes that never moved whenever a
-                        # job is cancelled or the put fails mid-wait —
-                        # debt every OTHER job would then sleep off.
-                        await limiter.consume(size)
-                    uploaded_total += size
-                    if ctx.record is not None:
-                        ctx.record.add_bytes("uploaded", size)
-                        # live counter for the transfer profiler's
-                        # per-job throughput/stall sampling
-                        ctx.record.note_transfer("upload", uploaded_total)
-                    if ctx.metrics is not None:
-                        ctx.metrics.bytes_uploaded.inc(size)
+                await uploader.upload_file(media_id, file_path)
 
                 # upload occupies the 50-100% progress band
                 # (reference lib/upload.js:48)
                 percent = (i / len(files) * 50) + 50
                 await ctx.telemetry.emit_progress(media_id, downloading, int(percent))
 
-            await store.put_object(
-                STAGING_BUCKET, done_marker_name(media_id), b"true"
-            )
+            await uploader.write_done_marker(media_id)
 
         logger.info("finished uploading all files")
 
-        # best-effort cleanup (reference lib/upload.js:60-64)
-        try:
-            await asyncio.to_thread(shutil.rmtree, download_path)
-        except OSError as err:
-            logger.warn("failed to clean up directory", error=str(err))
+        await uploader.cleanup_workdir(download_path)
         return {}
 
     return upload

@@ -42,13 +42,24 @@ def new_client(config) -> ObjectStore:
     if backend == "memory":
         return InMemoryObjectStore()
     if backend == "s3":
+        from ..platform.config import cfg_get
         from .s3 import S3ObjectStore
 
+        # multipart knobs (``store.multipart_part_size`` /
+        # ``store.multipart_concurrency``): deployment-tunable instead of
+        # the historical hard-coded 64 MiB / 3 — bad values fail here, at
+        # boot, with the S3 API's constraints spelled out
         return S3ObjectStore.from_endpoint(
             minio_cfg.get("endpoint", "localhost:9000"),
             minio_cfg.get("access_key", ""),
             minio_cfg.get("secret_key", ""),
             ssl=minio_cfg.get("ssl", False),
             region=minio_cfg.get("region", "us-east-1"),
+            multipart_part_size=cfg_get(
+                config, "store.multipart_part_size", None
+            ),
+            multipart_concurrency=cfg_get(
+                config, "store.multipart_concurrency", None
+            ),
         )
     raise ValueError(f"unknown object-store backend {backend!r}")

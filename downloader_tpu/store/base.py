@@ -65,10 +65,14 @@ class ObjectStore(abc.ABC):
                           *, consume: bool = False) -> None:
         """Upload a local file as an object (reference lib/upload.js:45).
 
-        ``consume=True`` is the caller's promise that it will neither
-        mutate nor rely on ``file_path`` after the call — backends may
-        then ingest destructively (e.g. by hardlink) instead of copying.
-        The default is the safe byte copy."""
+        ``consume=True`` is the caller's promise that it will not MUTATE
+        ``file_path``'s bytes after the call — backends may then ingest
+        by aliasing (e.g. hardlink) instead of copying.  The path itself
+        must remain on disk, unchanged, until the caller removes it: the
+        streaming pipeline uploads files mid-download and still needs
+        them afterwards (the authoritative post-download walk, torrent
+        piece serving, cache fills), so a backend must never DELETE or
+        move the source.  The default is the safe byte copy."""
 
     @abc.abstractmethod
     def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:

@@ -124,6 +124,13 @@ class SigV4Signer:
 class S3ObjectStore(ObjectStore):
     """Path-style S3 client: ``<endpoint>/<bucket>/<key>``."""
 
+    # defaults for the multipart knobs (config: store.multipart_part_size /
+    # store.multipart_concurrency); 64 MiB parts match the common S3 client
+    # defaults, 5 MiB is the API's hard minimum part size
+    DEFAULT_PART_SIZE = 64 << 20
+    DEFAULT_MULTIPART_CONCURRENCY = 3
+    MIN_PART_SIZE = 5 << 20
+
     @classmethod
     def from_endpoint(
         cls,
@@ -132,13 +139,17 @@ class S3ObjectStore(ObjectStore):
         secret_key: str = "",
         ssl: bool = True,
         region: str = "us-east-1",
+        multipart_part_size: Optional[int] = None,
+        multipart_concurrency: Optional[int] = None,
     ) -> "S3ObjectStore":
         """Build from a host[:port] or full URL; an explicit scheme wins,
         otherwise ``ssl`` picks https/http."""
         if "://" not in endpoint:
             scheme = "https" if ssl else "http"
             endpoint = f"{scheme}://{endpoint}"
-        return cls(endpoint, access_key, secret_key, region)
+        return cls(endpoint, access_key, secret_key, region,
+                   multipart_part_size=multipart_part_size,
+                   multipart_concurrency=multipart_concurrency)
 
     def __init__(
         self,
@@ -147,17 +158,38 @@ class S3ObjectStore(ObjectStore):
         secret_key: str = "",
         region: str = "us-east-1",
         session: Optional[aiohttp.ClientSession] = None,
+        multipart_part_size: Optional[int] = None,
+        multipart_concurrency: Optional[int] = None,
     ):
         self.endpoint = endpoint.rstrip("/")
         parsed = urllib.parse.urlparse(self.endpoint)
         self._host = parsed.netloc
         self._signer = SigV4Signer(access_key, secret_key, region)
         self._session = session
-        # multipart kicks in above the threshold; 64 MiB parts match the
-        # common S3 client defaults (min part size is 5 MiB per the API)
-        self.multipart_threshold = 64 << 20
-        self.multipart_part_size = 64 << 20
-        self.multipart_concurrency = 3
+        # multipart kicks in above the threshold (= the part size, so no
+        # object ever uploads as a single part bigger than a part).
+        # Misconfiguration fails loudly, like the rate-limit knobs: a
+        # part size under the S3 API's 5 MiB floor would be rejected by
+        # the server at complete time with a far less obvious error.
+        # None = unset; an explicit 0 must hit the validation below, not
+        # silently coerce to the default
+        part_size = (self.DEFAULT_PART_SIZE if multipart_part_size is None
+                     else int(multipart_part_size))
+        if part_size < self.MIN_PART_SIZE:
+            raise ValueError(
+                f"multipart_part_size must be >= {self.MIN_PART_SIZE} "
+                f"(S3 minimum part size), got {part_size}"
+            )
+        concurrency = (self.DEFAULT_MULTIPART_CONCURRENCY
+                       if multipart_concurrency is None
+                       else int(multipart_concurrency))
+        if concurrency < 1:
+            raise ValueError(
+                f"multipart_concurrency must be >= 1, got {concurrency}"
+            )
+        self.multipart_threshold = part_size
+        self.multipart_part_size = part_size
+        self.multipart_concurrency = concurrency
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -243,7 +275,7 @@ class S3ObjectStore(ObjectStore):
             resp.release()
 
     async def fput_object(self, bucket: str, name: str, file_path: str,
-                          *, consume: bool = False) -> None:
+                          *, consume: bool = False, progress=None) -> None:
         """Upload a file from disk.
 
         Small files go up as one streaming PUT with an UNSIGNED-PAYLOAD
@@ -251,10 +283,18 @@ class S3ObjectStore(ObjectStore):
         ``multipart_threshold`` use S3 multipart upload: fixed-size parts
         with per-part retry, so one dropped connection at the 60-GB mark of
         a media file costs one part, not the whole transfer; failures abort
-        the upload server-side so no orphaned parts accrue storage."""
+        the upload server-side so no orphaned parts accrue storage.
+
+        ``progress`` is an optional ``async (bytes_moved)`` callback fired
+        after each part lands (once with the full size on the single-PUT
+        path).  The upload stage charges its egress token bucket there, so
+        pacing engages at part granularity instead of only after a whole
+        multi-GB object — and only for bytes that actually moved (a part
+        charged once on success; failed attempts charge nothing)."""
         size = os.path.getsize(file_path)
         if size > self.multipart_threshold:
-            await self._multipart_upload(bucket, name, file_path, size)
+            await self._multipart_upload(bucket, name, file_path, size,
+                                         progress=progress)
             return
         path = self._object_path(bucket, name)
         headers = self._signer.sign(
@@ -273,10 +313,13 @@ class S3ObjectStore(ObjectStore):
         body = await resp.read()
         if resp.status not in (200, 204):
             raise RuntimeError(f"fput_object failed: {resp.status} {body!r}")
+        if progress is not None:
+            await progress(size)
 
     # -- multipart upload ----------------------------------------------
     async def _multipart_upload(self, bucket: str, name: str,
-                                file_path: str, size: int) -> None:
+                                file_path: str, size: int,
+                                progress=None) -> None:
         path = self._object_path(bucket, name)
         resp = await self._request("POST", path, query={"uploads": ""})
         body = await resp.read()
@@ -290,7 +333,8 @@ class S3ObjectStore(ObjectStore):
         upload_id = match.group(1).decode()
 
         try:
-            etags = await self._upload_parts(path, upload_id, file_path, size)
+            etags = await self._upload_parts(path, upload_id, file_path, size,
+                                             progress=progress)
             manifest = "".join(
                 f"<Part><PartNumber>{num}</PartNumber>"
                 f"<ETag>{etag}</ETag></Part>"
@@ -321,7 +365,7 @@ class S3ObjectStore(ObjectStore):
             raise
 
     async def _upload_parts(self, path: str, upload_id: str,
-                            file_path: str, size: int):
+                            file_path: str, size: int, progress=None):
         """Upload fixed-size parts with bounded concurrency + per-part
         retry; returns [(part_number, etag)] in order."""
         part_size = self.multipart_part_size
@@ -365,6 +409,12 @@ class S3ObjectStore(ObjectStore):
                                     f"part {part_number}: response has no "
                                     "ETag header"
                                 )
+                            if progress is not None:
+                                # inside the semaphore on purpose: a
+                                # pacing sleep in the callback holds this
+                                # part's slot, throttling the pool to the
+                                # configured egress rate
+                                await progress(length)
                             return part_number, etag
                         last = RuntimeError(
                             f"part {part_number}: {resp.status} {body!r}"

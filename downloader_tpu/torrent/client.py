@@ -110,6 +110,43 @@ class TorrentError(RuntimeError):
     pass
 
 
+class _FileCompletion:
+    """Per-file piece accounting: which files just became fully durable.
+
+    A file is durable once every piece overlapping its byte range is
+    verified and written (each such piece's ``storage.write_piece`` —
+    including the slice that lands in this file — happens before its
+    ``finish``, and finished pieces are never rewritten, so no write can
+    touch the file afterwards).  ``mark`` is O(files overlapping the
+    piece); completed file indices queue in ``completed`` for the drive
+    loop to drain into the caller's ``on_file_complete`` callback.
+    """
+
+    __slots__ = ("_left", "_by_piece", "completed")
+
+    def __init__(self, meta: Metainfo):
+        self._left: List[int] = []
+        self._by_piece: Dict[int, List[int]] = {}
+        self.completed: deque = deque()
+        for index, entry in enumerate(meta.files):
+            if entry.length == 0:
+                self._left.append(0)
+                self.completed.append(index)  # nothing to transfer
+                continue
+            first = entry.offset // meta.piece_length
+            last = (entry.offset + entry.length - 1) // meta.piece_length
+            for piece in range(first, last + 1):
+                self._by_piece.setdefault(piece, []).append(index)
+            self._left.append(last - first + 1)
+
+    def mark(self, piece: int) -> None:
+        """Record ``piece`` done; queues any file it completed."""
+        for index in self._by_piece.pop(piece, ()):
+            self._left[index] -= 1
+            if self._left[index] == 0:
+                self.completed.append(index)
+
+
 class _Swarm:
     """Shared download state across peer workers.
 
@@ -139,6 +176,9 @@ class _Swarm:
         self.hash_failures = 0
         self.bytes_resumed = 0
         self.bytes_from_webseeds = 0
+        # optional per-file completion tracker (download(on_file_complete=)):
+        # finish() feeds it; the drive loop drains its queue
+        self.completion: "Optional[_FileCompletion]" = None
 
     @property
     def complete(self) -> bool:
@@ -181,6 +221,8 @@ class _Swarm:
         self.pending.discard(piece)
         self.done.add(piece)
         self.bytes_done += self.meta.piece_size(piece)
+        if self.completion is not None:
+            self.completion.mark(piece)
         self.piece_event.set()
         return True
 
@@ -261,6 +303,7 @@ class TorrentClient:
         stats_out: Optional[dict] = None,
         cancel=None,
         progress_sink=None,
+        on_file_complete=None,
     ) -> Metainfo:
         """Fetch the torrent behind ``uri`` into ``download_path``.
 
@@ -284,6 +327,15 @@ class TorrentClient:
         ``progress_sink`` is an optional callable fed the cumulative
         verified byte count on every watchdog feed — the download
         stage's live flight-recorder transfer counter rides it.
+
+        ``on_file_complete`` is an optional ``async (path, FileEntry)``
+        callback invoked — from the drive loop, between piece batches —
+        the moment an individual file's bytes are durable (every piece
+        overlapping it verified and written; finished pieces are never
+        rewritten).  The streaming staging pipeline rides it to upload
+        early files while later ones still download.  Resumed/already-
+        on-disk files are announced too, so a redelivered job streams
+        its whole inventory.
         """
         meta, peers = await self._resolve(uri, peers, metadata_timeout)
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
@@ -292,10 +344,20 @@ class TorrentClient:
         await asyncio.to_thread(self._preflight_disk, storage)
         await asyncio.to_thread(storage.preallocate)
         swarm = _Swarm(meta)
+        if on_file_complete is not None:
+            # installed BEFORE any piece can finish so finish() feeds it;
+            # resume-scanned pieces (added to done directly) are marked
+            # right after the scan below
+            swarm.completion = _FileCompletion(meta)
         await self._resume_from_disk(storage, swarm)
+        if swarm.completion is not None:
+            for piece in swarm.done:
+                swarm.completion.mark(piece)
 
         if swarm.complete:
             self._log("all pieces already on disk")
+            await self._drain_file_completions(swarm, storage,
+                                               on_file_complete)
             # a hash-scan proved the data: record it so the NEXT restart
             # is stat-only
             await asyncio.to_thread(
@@ -334,7 +396,7 @@ class TorrentClient:
             await watchdog.watch(
                 self._drive(swarm, storage, peers or [], webseeds, server,
                             progress_interval, on_progress, watchdog,
-                            cancel=cancel)
+                            cancel=cancel, on_file_complete=on_file_complete)
             )
             completed = True
             # close the live counter: a fast download can finish between
@@ -433,11 +495,26 @@ class TorrentClient:
             server, asyncio.create_task(_expire()), _unregister
         )
 
+    async def _drain_file_completions(self, swarm: _Swarm,
+                                      storage: TorrentStorage,
+                                      on_file_complete) -> None:
+        """Announce files whose last piece just landed (download(
+        on_file_complete=)); callback errors propagate like any other
+        drive error so a broken consumer fails the download loudly."""
+        completion = swarm.completion
+        if completion is None or on_file_complete is None:
+            return
+        while completion.completed:
+            index = completion.completed.popleft()
+            entry = swarm.meta.files[index]
+            await on_file_complete(storage.file_path(entry.path), entry)
+
     async def _drive(self, swarm: _Swarm, storage: TorrentStorage,
                      peers: List[tracker_mod.Peer], webseeds: List[str],
                      server, progress_interval: float,
                      on_progress: Optional[ProgressCb],
-                     watchdog: StallWatchdog, cancel=None) -> None:
+                     watchdog: StallWatchdog, cancel=None,
+                     on_file_complete=None) -> None:
         """Run the download: a dynamic worker pool (seeded from trackers/
         DHT/x.pe, grown from ut_pex gossip), HAVE re-broadcast of finished
         pieces, and a best-effort DHT announce of our serving socket."""
@@ -494,10 +571,20 @@ class TorrentClient:
                 except TimeoutError:
                     pass
                 swarm.piece_event.clear()
+                # stream per-file completion to the staging pipeline as
+                # soon as a file's last piece lands — the whole point of
+                # the overlap: egress starts while ingress continues
+                await self._drain_file_completions(swarm, storage,
+                                                   on_file_complete)
                 if server is not None:
                     for index in swarm.done - announced:
                         announced.add(index)
                         await server.add_piece(index)
+            # the loop exits the tick the last piece finishes, so any
+            # files it completed are still queued — announce them before
+            # returning control to the caller
+            await self._drain_file_completions(swarm, storage,
+                                               on_file_complete)
             # download complete: give the discovery registration a bounded
             # grace — a fast download must not cancel the re-announce that
             # makes the lingering seed findable by sibling replicas

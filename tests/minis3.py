@@ -246,7 +246,10 @@ class MiniS3:
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> str:
-        app = web.Application()
+        # real S3 accepts single PUTs up to 5 GiB; aiohttp's default
+        # 1 MiB body cap would 413 any realistic media object (the
+        # stage-overlap bench stages multi-MiB files as single PUTs)
+        app = web.Application(client_max_size=256 << 20)
         app.router.add_route("*", "/{tail:.*}", self.handle)
         self._runner = web.AppRunner(app)
         await self._runner.setup()

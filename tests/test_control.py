@@ -426,7 +426,9 @@ async def test_cancel_inflight_download_via_api(tmp_path):
             job = await resp.json()
         assert job["state"] == CANCELLED
         assert job["reason"] == "operator test"
-        assert job["stage"] == "download"
+        # streaming dispatch: the combined RUNNING attribution is the
+        # stage a mid-transfer cancel lands in
+        assert job["stage"] == "pipeline"
         assert orchestrator.metrics.jobs_cancelled._value.get() == 1
 
         # telemetry announced the terminal CANCELLED status

@@ -368,6 +368,75 @@ async def test_failed_midtransfer_job_timeline_logs_and_metrics(tmp_path):
         await server.cleanup()
 
 
+async def test_streaming_per_file_events_join_on_one_trace(tmp_path):
+    """The streaming pipeline's per-file timeline (``file_complete`` →
+    ``upload_start`` → ``upload_done``) rides the SAME flight recorder —
+    and therefore the same trace id — as the job's lifecycle events, so
+    logs, spans, and the per-file staging history all join on one id."""
+    payload = b"m" * (1 << 16)
+
+    async def serve(_request):
+        return web.Response(body=payload)
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "downloads")},
+        }),
+        mq=MemoryQueue(broker),
+        store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new("obsstream"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        assert orchestrator.streaming_enabled
+        broker.publish(
+            schemas.DOWNLOAD_QUEUE,
+            make_download_msg(f"http://127.0.0.1:{port}/media.mkv", "job-sp"),
+        )
+        async with asyncio.timeout(30):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        async with session.get(f"{api}/v1/jobs/job-sp/events") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+        assert body["traceId"] and len(body["traceId"]) == 32
+        events = body["events"]
+        kinds = [e["kind"] for e in events]
+        for expected in ("file_complete", "upload_start", "upload_done"):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+        # ordered per file: complete -> upload_start -> upload_done,
+        # and the combined RUNNING("pipeline") attribution brackets them
+        complete = next(e for e in events if e["kind"] == "file_complete")
+        start = next(e for e in events if e["kind"] == "upload_start")
+        done = next(e for e in events if e["kind"] == "upload_done")
+        assert complete["file"] == start["file"] == done["file"]
+        assert done["bytes"] == len(payload)
+        running = next(e for e in events
+                       if e["kind"] == "state" and e.get("to") == "RUNNING")
+        assert running["stage"] == "pipeline"
+        async with session.get(f"{api}/v1/jobs/job-sp") as resp:
+            show = await resp.json()
+        assert show["traceId"] == body["traceId"]
+        assert "pipeline" in show["stageSeconds"]
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
 async def test_events_endpoint_for_successful_job(tmp_path):
     """A clean end-to-end job's timeline closes with publish + DONE, and
     GET /v1/jobs/{id} carries the correlation ids."""

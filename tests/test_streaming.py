@@ -1,0 +1,438 @@
+"""Streaming stage-overlap pipeline tests (stages/streaming.py).
+
+Acceptance (ISSUE 4): a multi-file torrent job against the in-memory
+broker + MiniS3 starts uploading early files BEFORE the last file
+finishes downloading; cancellation mid-pipeline removes the workdir
+before the ack; redelivery after a crash skips already-staged files; and
+the ``instance.pipeline: barrier`` fallback is byte-identical to the
+sequential dispatch.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from downloader_tpu import schemas
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store.s3 import S3ObjectStore
+from downloader_tpu.torrent import Seeder, make_metainfo
+from downloader_tpu.torrent.magnet import make_magnet
+
+from minis3 import MiniS3
+from minitracker import MiniTracker
+from test_torrent import make_payload_dir
+
+pytestmark = pytest.mark.anyio
+
+
+async def start_swarm(tmp_path, sizes, piece_length=1 << 14):
+    """Seed a multi-file torrent behind a live seeder + tracker; returns
+    (magnet, files, cleanup)."""
+    src, files = make_payload_dir(tmp_path, sizes)
+    meta = make_metainfo(str(src), piece_length=piece_length)
+    seeder = Seeder(meta, str(src.parent))
+    port = await seeder.start()
+    tracker = MiniTracker([("127.0.0.1", port)])
+    tracker_url = await tracker.start()
+    magnet = make_magnet(meta.info_hash, meta.name, [tracker_url])
+
+    async def cleanup():
+        await seeder.stop()
+        await tracker.stop()
+
+    return magnet, files, cleanup
+
+
+async def make_orchestrator(tmp_path, broker, store, instance=None):
+    config = ConfigNode({"instance": {
+        "download_path": str(tmp_path / "downloads"),
+        **(instance or {}),
+    }})
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config,
+        mq=MemoryQueue(broker),
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"stream{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    return orchestrator
+
+
+def torrent_msg(magnet, job_id):
+    return schemas.encode(schemas.Download(media=schemas.Media(
+        id=job_id,
+        creator_id="card-1",
+        name="Great Show",
+        type=schemas.MediaType.Value("TV"),
+        source=schemas.SourceType.Value("TORRENT"),
+        source_uri=magnet,
+    )))
+
+
+async def wait_for(predicate, timeout=15.0):
+    async with asyncio.timeout(timeout):
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: uploads overlap the still-running download
+# ---------------------------------------------------------------------------
+
+async def test_streaming_uploads_start_before_download_finishes(tmp_path):
+    """Multi-file torrent vs memory broker + MiniS3: with the download
+    paced by the ingress token bucket, early files must be staged while
+    later files are still transferring — the flight-recorder timeline
+    proves the first upload_done precedes the last file_complete."""
+    sizes = [128 << 10] * 4
+    magnet, files, swarm_cleanup = await start_swarm(tmp_path, sizes)
+    s3 = MiniS3()
+    await s3.start()
+    store = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store,
+        # burst (= one second's worth) covers ~2 files instantly, the
+        # rest trickle at 256 KiB/s -> completions spread over ~1 s while
+        # the unpaced loopback upload takes milliseconds per file
+        instance={"download_rate_limit": 256 << 10},
+    )
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE, torrent_msg(magnet, "sj-1"))
+        async with asyncio.timeout(60):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        # every file staged + done marker + exactly one convert
+        for name, data in files.items():
+            staged = await store.get_object(
+                STAGING_BUCKET, object_name("sj-1", os.path.basename(name))
+            )
+            assert staged == data
+        assert await store.get_object(
+            STAGING_BUCKET, "sj-1/original/done") == b"true"
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+
+        record = orchestrator.registry.get("sj-1")
+        assert record.state == "DONE"
+        events = record.recorder.events()
+        completes = [e for e in events if e["kind"] == "file_complete"]
+        starts = [e for e in events if e["kind"] == "upload_start"]
+        dones = [e for e in events if e["kind"] == "upload_done"]
+        assert len(completes) == len(sizes)
+        assert len(dones) == len(sizes)
+        # THE overlap claim: egress began (and even finished a file)
+        # while ingress still had files in flight
+        last_complete = max(e["t"] for e in completes)
+        assert min(e["t"] for e in starts) < last_complete
+        assert min(e["t"] for e in dones) < last_complete
+
+        # combined RUNNING attribution closed its timing under "pipeline"
+        assert "pipeline" in record.stage_seconds
+
+        # merged progress: monotone from 0 to exactly 100
+        percents = [
+            schemas.decode(schemas.TelemetryProgressEvent, raw).percent
+            for raw in broker.published(PROGRESS_QUEUE)
+        ]
+        assert percents[0] == 0
+        assert percents == sorted(percents)
+        assert percents[-1] == 100
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await store.close()
+        await s3.stop()
+        await swarm_cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Cancellation mid-pipeline
+# ---------------------------------------------------------------------------
+
+async def test_streaming_cancel_removes_workdir_before_ack(tmp_path):
+    sizes = [256 << 10] * 2
+    magnet, _files, swarm_cleanup = await start_swarm(tmp_path, sizes)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store,
+        # tiny budget: the download crawls, leaving a wide cancel window
+        instance={"download_rate_limit": 32 << 10},
+    )
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE, torrent_msg(magnet, "sj-c"))
+        workdir = tmp_path / "downloads" / "sj-c"
+        await wait_for(lambda: (r := orchestrator.registry.get("sj-c"))
+                       is not None and r.state == "RUNNING")
+        await wait_for(workdir.exists)
+
+        assert orchestrator.registry.cancel("sj-c", reason="test")
+        async with asyncio.timeout(30):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        # settled without requeue, workdir reclaimed BEFORE the ack,
+        # no convert, no done marker sealing a partial staging set
+        assert broker.idle(schemas.DOWNLOAD_QUEUE)
+        assert not workdir.exists()
+        assert broker.published(schemas.CONVERT_QUEUE) == []
+        assert orchestrator.registry.get("sj-c").state == "CANCELLED"
+        with pytest.raises(Exception):
+            await store.get_object(STAGING_BUCKET, "sj-c/original/done")
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await swarm_cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Redelivery resume: already-staged files are skipped
+# ---------------------------------------------------------------------------
+
+async def test_streaming_redelivery_skips_already_staged(tmp_path):
+    """A crash after some files staged (no done marker) redelivers the
+    job; the pipeline re-uploads only what is missing."""
+    sizes = [96 << 10, 64 << 10]
+    magnet, files, swarm_cleanup = await start_swarm(tmp_path, sizes)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+
+    # simulate the prior attempt: first file fully staged, marker absent
+    first_name, first_data = sorted(files.items())[0]
+    staged_name = object_name("sj-r", os.path.basename(first_name))
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(STAGING_BUCKET, staged_name, first_data)
+
+    puts = []
+    original_fput = store.fput_object
+
+    async def spying_fput(bucket, name, file_path, *, consume=False):
+        puts.append(name)
+        await original_fput(bucket, name, file_path, consume=consume)
+
+    store.fput_object = spying_fput
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE, torrent_msg(magnet, "sj-r"))
+        async with asyncio.timeout(60):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        assert staged_name not in puts  # resume skipped the staged file
+        for name, data in files.items():
+            assert await store.get_object(
+                STAGING_BUCKET, object_name("sj-r", os.path.basename(name))
+            ) == data
+        assert await store.get_object(
+            STAGING_BUCKET, "sj-r/original/done") == b"true"
+        record = orchestrator.registry.get("sj-r")
+        skips = [e for e in record.recorder.events()
+                 if e["kind"] == "upload_done" and e.get("skipped")]
+        assert len(skips) == 1
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await swarm_cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Barrier fallback regression: the sequential path is intact
+# ---------------------------------------------------------------------------
+
+async def test_barrier_fallback_byte_identical(tmp_path):
+    """``instance.pipeline: barrier`` must run the exact sequential stage
+    loop: per-stage RUNNING hops in the record, the reference's upload
+    progress band, and the same staged bytes as the streaming path."""
+    sizes = [96 << 10, 64 << 10]
+    magnet, files, swarm_cleanup = await start_swarm(tmp_path, sizes)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store, instance={"pipeline": "barrier"}
+    )
+    try:
+        assert orchestrator.streaming_enabled is False
+        broker.publish(schemas.DOWNLOAD_QUEUE, torrent_msg(magnet, "sj-b"))
+        async with asyncio.timeout(60):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        for name, data in files.items():
+            assert await store.get_object(
+                STAGING_BUCKET, object_name("sj-b", os.path.basename(name))
+            ) == data
+        assert await store.get_object(
+            STAGING_BUCKET, "sj-b/original/done") == b"true"
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+
+        record = orchestrator.registry.get("sj-b")
+        stages = [e.get("stage") for e in record.recorder.events()
+                  if e["kind"] == "state" and e.get("to") == "RUNNING"]
+        assert stages == ["download", "process", "upload"]
+        # no streaming events on the barrier path
+        kinds = {e["kind"] for e in record.recorder.events()}
+        assert "file_complete" not in kinds
+
+        # the reference's (i/n*50)+50 upload band, verbatim
+        percents = [
+            schemas.decode(schemas.TelemetryProgressEvent, raw).percent
+            for raw in broker.published(PROGRESS_QUEUE)
+        ]
+        assert percents[-2:] == [75, 100]
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await swarm_cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Bucket source: incremental verdicts match the walk even with root files
+# ---------------------------------------------------------------------------
+
+async def test_streaming_bucket_filter_matches_walk(tmp_path):
+    """TV bucket job whose prefix holds a root-level media file plus a
+    non-season directory: the sole-top-level shortcut must not misfire
+    while objects are still landing (root-level FILES are pre-created as
+    placeholders alongside the directories), so the streamed verdicts
+    equal the authoritative walk's — only the root file is staged, in
+    both dispatch modes."""
+    s3 = MiniS3()
+    await s3.start()
+    source = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    payloads = {
+        # lexicographic listing order fetches Random/ before bonus.mkv,
+        # exactly the window where a live-listing verdict would misfire
+        "media/Random/ep1.mkv": b"R" * 2048,
+        "media/bonus.mkv": b"B" * 1024,
+    }
+    await source.make_bucket("src")
+    for key, data in payloads.items():
+        await source.put_object("src", key, data)
+    uri = (f"bucket://http://127.0.0.1:{s3.port},src,AKIA,SECRET,media/")
+
+    async def run(mode, job_id):
+        broker = InMemoryBroker(max_redeliveries=2)
+        store = InMemoryObjectStore()
+        orchestrator = await make_orchestrator(
+            tmp_path, broker, store, instance={"pipeline": mode})
+        try:
+            broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(
+                schemas.Download(media=schemas.Media(
+                    id=job_id, creator_id="c", name="Mixed",
+                    type=schemas.MediaType.Value("TV"),
+                    source=schemas.SourceType.Value("BUCKET"),
+                    source_uri=uri))))
+            async with asyncio.timeout(30):
+                await broker.join(schemas.DOWNLOAD_QUEUE)
+            assert orchestrator.registry.get(job_id).state == "DONE", mode
+            return {
+                info.name async for info in store.list_objects(
+                    STAGING_BUCKET, job_id)
+            }
+        finally:
+            await orchestrator.shutdown(grace_seconds=2)
+
+    try:
+        streamed = await run("streaming", "bf-s")
+        barrier = await run("barrier", "bf-b")
+        assert ({n.split("/", 1)[1] for n in streamed}
+                == {n.split("/", 1)[1] for n in barrier})
+        # the walk's verdict: root media file staged, Random/ rejected
+        assert object_name("bf-s", "bonus.mkv") in streamed
+        assert object_name("bf-s", "ep1.mkv") not in streamed
+    finally:
+        await source.close()
+        await s3.stop()
+
+
+# ---------------------------------------------------------------------------
+# Incremental filter ≡ authoritative walk
+# ---------------------------------------------------------------------------
+
+def test_incremental_filter_matches_walk(tmp_path):
+    from downloader_tpu.stages.process import (find_media_files,
+                                               incremental_filter)
+
+    root = tmp_path / "dl"
+    layout = [
+        "Great Show/S1/ep1.mkv",
+        "Great Show/S1/ep2.notmedia",
+        "Great Show/extras/bonus.mkv",
+        "Great Show/S1/clip.part-12.3.mkv",
+        "Great Show/readme.txt",
+    ]
+    for rel in layout:
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(b"x")
+
+    for media_type in ("TV", "MOVIE"):
+        media = schemas.Media(id="m", type=schemas.MediaType.Value(media_type))
+        walked = set(find_media_files(str(root), media, NullLogger()))
+        allow = incremental_filter(str(root), media, NullLogger())
+        streamed = {
+            str(root / rel) for rel in layout
+            if allow(str(root / rel))
+        }
+        assert streamed == walked, media_type
+
+
+# ---------------------------------------------------------------------------
+# Per-part egress pacing: the store reports multipart progress
+# ---------------------------------------------------------------------------
+
+async def test_s3_fput_reports_progress_per_part(tmp_path):
+    s3 = MiniS3()
+    await s3.start()
+    store = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    store.multipart_threshold = 1 << 16
+    store.multipart_part_size = 1 << 16
+    payload = os.urandom((1 << 16) * 3 + 512)  # 4 parts, last short
+    path = tmp_path / "big.bin"
+    path.write_bytes(payload)
+    moved = []
+
+    async def progress(n):
+        moved.append(n)
+
+    try:
+        await store.make_bucket("b")
+        await store.fput_object("b", "big.bin", str(path), progress=progress)
+        assert sum(moved) == len(payload)
+        assert len(moved) == 4  # one callback per part, not one per object
+        assert await store.get_object("b", "big.bin") == payload
+
+        # single-PUT path: exactly one callback with the full size
+        small = tmp_path / "small.bin"
+        small.write_bytes(b"s" * 1024)
+        moved.clear()
+        await store.fput_object("b", "small.bin", str(small),
+                                progress=progress)
+        assert moved == [1024]
+    finally:
+        await store.close()
+        await s3.stop()
+
+
+def test_pipeline_knob_validation():
+    from downloader_tpu.stages.streaming import (pipeline_mode,
+                                                 upload_concurrency)
+
+    assert pipeline_mode(ConfigNode({})) == "streaming"
+    assert pipeline_mode(
+        ConfigNode({"instance": {"pipeline": "barrier"}})) == "barrier"
+    with pytest.raises(ValueError):
+        pipeline_mode(ConfigNode({"instance": {"pipeline": "turbo"}}))
+    assert upload_concurrency(ConfigNode({})) == 3
+    assert upload_concurrency(
+        ConfigNode({"instance": {"upload_concurrency": 8}})) == 8
+    with pytest.raises(ValueError):
+        upload_concurrency(ConfigNode({"instance": {"upload_concurrency": 0}}))
+    with pytest.raises(ValueError):
+        upload_concurrency(
+            ConfigNode({"instance": {"upload_concurrency": "lots"}}))

@@ -77,13 +77,17 @@ async def test_magnet_job_end_to_end(tmp_path):
     )
     assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
 
-    # progress telemetry: 0 at start, 50 after download, 50-100 for upload
+    # progress telemetry: 0 at start, monotone to exactly 100 at the end.
+    # Under the streaming dispatch the download band (0-50) and the
+    # staged-file band (50-100) interleave into one merged percent, so an
+    # exact 50 is no longer guaranteed to be emitted — monotonicity and
+    # the endpoints are the contract.
     events = [
         schemas.decode(schemas.TelemetryProgressEvent, raw).percent
         for raw in broker.published(PROGRESS_QUEUE)
     ]
     assert events[0] == 0
-    assert 50 in events
+    assert events == sorted(events)
     assert events[-1] == 100
 
     await orchestrator.shutdown(grace_seconds=2)
