@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap proto lint run docker integration
+.PHONY: test bench bench-overlap chaos proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -12,6 +12,11 @@ test:
 # the tests auto-skip when the services are unreachable
 integration:
 	python -m pytest tests/ -m integration -v
+
+# fault-injection chaos suite: the taxonomy/retry/breaker layer proven
+# against deterministic store/publish/http/tracker/disk failures
+chaos:
+	python -m pytest tests/test_faults.py -v
 
 lint:
 	python -m pytest tests/test_lint.py -q

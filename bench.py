@@ -95,7 +95,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  time_to_staged_ms = the pipelined job's publish -> done-marker wall.
 #  ``python bench.py --overlap`` runs this workload standalone
 #  (`make bench-overlap`).
-HARNESS_VERSION = 11
+# v12 (r10): staging/compute/torrent/fan-in/control/overlap measurements
+#  identical to v11 (the fault-tolerance layer's seam hooks are no-ops
+#  without an installed plan — the new fault_check_overhead guard proves
+#  it).  New fault-tolerance workload: recovery_time_ms — wall from an
+#  injected transient store outage ENDING (last injected failure) to
+#  the job completing, exercising in-process retry + park-then-nack
+#  redelivery end to end; sanity guard recovery_ok < 1000 ms.
+HARNESS_VERSION = 12
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -594,6 +601,118 @@ def _bench_control_safe() -> dict:
         return asyncio.run(bench_control())
     except Exception as err:
         return {"control_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+async def bench_faults() -> dict:
+    """Fault-tolerance microbenches (harness v12).
+
+    - ``recovery_time_ms``: a job runs against a fault plan injecting a
+      transient store.put outage (in-process retries exhaust once, the
+      delivery parks and redelivers, the outage ends mid-redelivery);
+      measured is the wall from the LAST injected failure — the moment
+      the dependency heals — to the job completing.  The sanity guard
+      ``recovery_ok`` (< 1000 ms with the bench's fast policies) catches
+      a retry layer that oversleeps its own backoff math.
+    - ``fault_check_overhead_ms``: cost of 1000 disabled-injector seam
+      checks (the ``faults.enabled()`` guard every production call
+      pays); guard < 1 ms per 1000 checks — i.e. the hooks are free
+      when no plan is installed (same bar style as the v10/v11
+      <1 ms/job guards).
+    """
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform import faults as faults_mod
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import InMemoryObjectStore
+
+    # -- disabled-hook overhead ----------------------------------------
+    assert faults_mod.active() is None
+    checks = 100_000
+    t0 = time.perf_counter()
+    for _ in range(checks):
+        faults_mod.enabled()
+    check_ms = (time.perf_counter() - t0) * 1000.0 / (checks / 1000)
+
+    # -- recovery time --------------------------------------------------
+    payload = b"x" * (256 << 10)
+
+    async def serve(_request):
+        return web.Response(body=payload)
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    media_runner = web.AppRunner(app)
+    await media_runner.setup()
+    site = web.TCPSite(media_runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    with tempfile.TemporaryDirectory() as work:
+        broker = InMemoryBroker()
+        orchestrator = Orchestrator(
+            config=ConfigNode({
+                "instance": {"download_path": os.path.join(work, "dl")},
+                "retry": {
+                    "default": {"attempts": 3, "base": 0.02, "cap": 0.05},
+                    "redelivery": {"base": 0.02, "cap": 0.1},
+                },
+                # 4 transient put failures: delivery 1 exhausts its 3
+                # attempts and parks; the outage ends one attempt into
+                # the redelivery
+                "faults": {"plan": [
+                    {"seam": "store.put", "kind": "error", "count": 4},
+                ]},
+            }),
+            mq=MemoryQueue(broker),
+            store=InMemoryObjectStore(),
+            telemetry=Telemetry(MemoryQueue(broker)),
+            logger=NullLogger(),
+        )
+        await orchestrator.start()
+        try:
+            msg = schemas.Download(media=schemas.Media(
+                id="recovery-job", creator_id="c",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=f"http://127.0.0.1:{port}/media.mkv",
+            ))
+            broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+            async with asyncio.timeout(60):
+                while not broker.idle(schemas.DOWNLOAD_QUEUE):
+                    await asyncio.sleep(0.002)
+            done_mono = time.monotonic()
+            record = orchestrator.registry.get("recovery-job")
+            assert record is not None and record.state == "DONE", (
+                record.state if record else "no record")
+            injector = orchestrator._fault_injector
+            assert injector is not None and injector.last_fired_mono
+            assert injector.rules[0].fired == 4, injector.rules[0].fired
+            recovery_ms = (done_mono - injector.last_fired_mono) * 1000.0
+        finally:
+            await orchestrator.shutdown(grace_seconds=5)
+            await media_runner.cleanup()
+
+    return {
+        "recovery_time_ms": round(recovery_ms, 1),
+        "recovery_ok": recovery_ms < 1000.0,
+        "fault_check_overhead_ms": round(check_ms, 4),
+        "fault_check_overhead_ok": check_ms < 1.0,
+    }
+
+
+def _bench_faults_safe() -> dict:
+    """A faults-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_faults())
+    except Exception as err:
+        return {"faults_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 async def bench_stage_overlap() -> dict:
@@ -1387,6 +1506,10 @@ HEADLINE_KEYS = [
     "stage_overlap_speedup",      # r9 pipeline vs barrier bar: >= 1.25
     "time_to_staged_ms",          # r9: pipelined multi-file job wall
     "stage_overlap_error",        # present only on failure — visible
+    "recovery_time_ms",           # r10: dependency heals -> job DONE
+    "recovery_ok",                # r10 guard: < 1000 ms
+    "fault_check_overhead_ms",    # r10 guard: disabled hooks ~free
+    "faults_bench_error",         # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1435,6 +1558,7 @@ def main() -> None:
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
         **_bench_control_safe(),
+        **_bench_faults_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

@@ -24,6 +24,7 @@ from .registry import (  # noqa: F401
     DONE,
     DROPPED_POISON,
     FAILED,
+    PARKED,
     PUBLISHING,
     RECEIVED,
     RUNNING,

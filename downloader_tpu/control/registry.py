@@ -50,6 +50,7 @@ from .cancel import CancelToken
 
 # -- lifecycle states ---------------------------------------------------
 RECEIVED = "RECEIVED"
+PARKED = "PARKED"
 ADMITTED = "ADMITTED"
 RUNNING = "RUNNING"
 PUBLISHING = "PUBLISHING"
@@ -63,14 +64,27 @@ TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON})
 # RUNNING -> RUNNING models stage hops (download -> process -> upload);
 # ADMITTED -> PUBLISHING is the idempotency skip (done marker already
 # staged); FAILED is reachable from anywhere non-terminal (a handler can
-# die at any point and the record must still close).
+# die at any point and the record must still close).  PARKED is the
+# fault-tolerance layer's holding state (platform/errors.py): a job
+# waiting out an open dependency breaker at admission, or sitting in a
+# delayed-redelivery backoff before its nack — visible in
+# ``jobs_by_state`` instead of masquerading as stuck RECEIVED/RUNNING.
 LEGAL_TRANSITIONS: Dict[str, frozenset] = {
-    RECEIVED: frozenset({ADMITTED, FAILED, CANCELLED}),
-    ADMITTED: frozenset({RUNNING, PUBLISHING, FAILED, CANCELLED}),
-    RUNNING: frozenset(
-        {RUNNING, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
+    RECEIVED: frozenset({PARKED, ADMITTED, FAILED, CANCELLED}),
+    PARKED: frozenset(
+        {ADMITTED, FAILED, CANCELLED, DROPPED_POISON}
     ),
-    PUBLISHING: frozenset({DONE, FAILED, CANCELLED}),
+    ADMITTED: frozenset(
+        {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
+    ),
+    RUNNING: frozenset(
+        {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
+    ),
+    # DROPPED_POISON from PUBLISHING: publish failures count toward the
+    # poison threshold too (they used to bypass it and redeliver forever)
+    PUBLISHING: frozenset(
+        {PARKED, DONE, FAILED, CANCELLED, DROPPED_POISON}
+    ),
     DONE: frozenset(),
     FAILED: frozenset(),
     CANCELLED: frozenset(),
@@ -93,7 +107,7 @@ class JobRecord:
         "uid", "job_id", "file_id", "priority", "state", "stage", "reason",
         "percent", "bytes", "cancel", "created_at", "updated_at",
         "stage_seconds", "_entered_mono", "_created_mono",
-        "recorder", "trace_id", "span_id", "transferred",
+        "recorder", "trace_id", "span_id", "transferred", "retry",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
@@ -121,6 +135,12 @@ class JobRecord:
         # OTLP span, and this record's timeline
         self.trace_id: Optional[str] = None
         self.span_id: Optional[str] = None
+        # live retry/backoff detail (platform/errors.py): the Retrier
+        # sets it while a dependency call is between attempts, the
+        # orchestrator while the job is parked for delayed redelivery —
+        # so GET /v1/jobs/{id} and `cli jobs show` answer "is this job
+        # stuck or deliberately waiting" at a glance
+        self.retry: Optional[Dict[str, Any]] = None
         # live mid-transfer byte counters (absolute, per kind), fed by
         # the stages' chunk loops and sampled by the TransferProfiler;
         # unlike ``bytes`` (committed at stage completion) these move
@@ -159,6 +179,7 @@ class JobRecord:
             "reason": self.reason,
             "percent": self.percent,
             "bytes": dict(self.bytes),
+            "retry": dict(self.retry) if self.retry else None,
             "cancelRequested": self.cancel.cancelled,
             "traceId": self.trace_id,
             "spanId": self.span_id,

@@ -75,8 +75,30 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
                 {"status": "paused", "active": len(orchestrator.active_jobs)},
                 status=503,
             )
+        # dependency circuit breakers (platform/errors.py): an open
+        # staging-store/convert-publish breaker means new jobs park at
+        # admission — tell load-aware orchestrators to route elsewhere
+        # until the half-open probe restores service.  The payload always
+        # carries the states, so the open -> half_open -> closed cycle is
+        # observable here as well as on /metrics.
+        breakers = getattr(orchestrator, "breakers", None)
+        states = breakers.states() if breakers is not None else {}
+        # readiness keys on the ADMISSION dependencies only (store +
+        # publish): an open per-job breaker someone opted into must not
+        # pull the whole replica out of rotation
+        blocked = (breakers.blocking_dependencies(
+            getattr(orchestrator, "admission_dependencies", None))
+            if breakers is not None else [])
+        if blocked:
+            return web.json_response(
+                {"status": "breaker_open", "breakers": states,
+                 "blocked": blocked,
+                 "active": len(orchestrator.active_jobs)},
+                status=503,
+            )
         return web.json_response(
-            {"status": "ready", "active": len(orchestrator.active_jobs)}
+            {"status": "ready", "active": len(orchestrator.active_jobs),
+             "breakers": states}
         )
 
     async def prom(_request: web.Request) -> web.Response:

@@ -39,7 +39,11 @@ from .control.scheduler import (PriorityScheduler, aging_from_config,
                                 backlog_from_config, priority_name,
                                 priority_rank)
 from .mq.base import Delivery, MessageQueue
+from .platform import faults
 from .platform.config import cfg_get
+from .platform.errors import (PERMANENT, POISON, BreakerBoard, Retrier,
+                              classify)
+from .platform.faults import FaultInjector
 from .platform.logging import Logger, get_logger
 from .platform.metrics import Metrics
 from .platform.obs import (DEFAULT_EVENT_LIMIT, DEFAULT_LAG_INTERVAL,
@@ -218,6 +222,44 @@ class Orchestrator:
         self.poison_threshold = poison_threshold
         self._failure_counts: Dict[str, int] = {}
 
+        # dependency fault tolerance (platform/errors.py): per-dependency
+        # circuit breakers consulted at admission (an open staging-store
+        # or convert-publish breaker parks intake instead of burning the
+        # poison budget) and a retry executor shared with the stages via
+        # stage_resources, so every seam — store puts, the idempotency
+        # probe, convert publish, HTTP fetch — rides the same
+        # config-driven policies (``retry.<dependency>`` /
+        # ``breakers.<dependency>``).
+        self.breakers = BreakerBoard(config, metrics=metrics,
+                                     logger=self.logger)
+        self.retrier = Retrier(config, breakers=self.breakers,
+                               metrics=metrics, logger=self.logger)
+        self.stage_resources["retrier"] = self.retrier
+        # the dependencies whose open breaker pauses intake: everything a
+        # job needs to SETTLE (staging writes + convert publish) — origin
+        # fetch trouble stays per-job (a broken origin is one job's
+        # problem, not the fleet's)
+        self.admission_dependencies = ("store", "publish")
+        # delayed redelivery (park-then-nack): a transiently-failed
+        # delivery holds its unsettled slot for an exponentially-growing
+        # pause before the nack, replacing the reference's instant-nack
+        # hot loop.  ``retry.redelivery.base: 0`` restores instant nacks.
+        self._redeliver_base = float(
+            cfg_get(config, "retry.redelivery.base", 0.25)
+        )
+        self._redeliver_cap = float(
+            cfg_get(config, "retry.redelivery.cap", 15.0)
+        )
+
+        # deterministic fault injection (platform/faults.py): installed
+        # from ``faults.plan`` / env FAULT_PLAN for chaos drills; None —
+        # the production default — keeps every seam's hook a no-op.
+        self._fault_injector = FaultInjector.from_config(
+            config, logger=self.logger
+        )
+        if self._fault_injector is not None:
+            faults.install(self._fault_injector)
+
         # readiness: True between a successful start() and shutdown()
         # (surfaced by /readyz, health.py)
         self.consuming = False
@@ -326,6 +368,10 @@ class Orchestrator:
                 self.logger.warn("stage cleanup failed", error=str(err))
         self.stage_cleanups.clear()
         self.stage_resources.clear()
+        # remove only OUR injector: a test that installed its own plan
+        # around this orchestrator keeps it
+        if self._fault_injector is not None:
+            faults.uninstall(self._fault_injector)
 
     # ------------------------------------------------------------------
     async def processor(self, delivery: Delivery) -> None:
@@ -384,8 +430,41 @@ class Orchestrator:
         # the same creator run concurrently
         emitter = self.emitter_table[job_id] = EventEmitter()
         granted = False
+        released = [False]
+
+        def release_slot() -> None:
+            # idempotent: the delayed-redelivery park gives the run slot
+            # back BEFORE its backoff sleep (a healthy queued job must
+            # not wait behind a parked one), and the finally below must
+            # not double-release
+            if granted and not released[0]:
+                released[0] = True
+                self.scheduler.release()
 
         try:
+            # dependency breakers gate intake BEFORE admission: when the
+            # staging store or convert publish is hard-down (breaker
+            # open), starting the job would only burn its poison budget
+            # against a dependency that cannot answer — park it instead,
+            # visibly (jobs_by_state{state="PARKED"}, /readyz 503), until
+            # the breaker's half-open window opens
+            blocked = self.breakers.blocking_dependencies(
+                self.admission_dependencies
+            )
+            if blocked:
+                child.warn("parking job: dependency breaker open",
+                           dependencies=blocked)
+                record.event("breaker_parked", dependencies=blocked)
+                if self.metrics is not None:
+                    self.metrics.jobs_parked.labels(reason="breaker").inc()
+                self.registry.transition(
+                    record, control.PARKED,
+                    reason="breaker_open: " + ",".join(blocked),
+                )
+                await token.guard(
+                    self.breakers.wait_ready(self.admission_dependencies)
+                )
+                record.event("breaker_cleared")
             # admission control: a new job only starts once the cache
             # volume has its configured disk headroom — LRU entries are
             # evicted to make room, and if nothing is evictable the job
@@ -430,12 +509,11 @@ class Orchestrator:
                                   trace_id=trace_id, span_id=span_id,
                                   jobId=job_id, fileId=file_id):
                 await self._run_job(msg, delivery, child, emitter,
-                                    record, token)
+                                    record, token, release_slot)
         except JobCancelled:
             await self._settle_cancelled(msg, delivery, child, record, token)
         finally:
-            if granted:
-                self.scheduler.release()
+            release_slot()
             # remove the finished job (fixes reference lib/main.js:169,
             # which called Array.slice — a no-op — so activeJobs only grew)
             try:
@@ -531,6 +609,154 @@ class Orchestrator:
                                  min_free_bytes=self.cache.min_free_bytes)
             await asyncio.sleep(0.25)
 
+    # -- classified failure settlement ---------------------------------
+    def _note_failure(self, job_id: str) -> int:
+        """Advance the poison counter for one failed delivery attempt.
+
+        Re-inserts at the back so the bound below evicts the LEAST-
+        recently-failing job, never an actively hot one; the 10 000-entry
+        cap stops jobs whose redeliveries land on other replicas (or get
+        dead-lettered) from leaking one entry each for the process
+        lifetime.
+        """
+        failures = self._failure_counts.pop(job_id, 0) + 1
+        self._failure_counts[job_id] = failures
+        if len(self._failure_counts) > 10_000:
+            self._failure_counts.pop(next(iter(self._failure_counts)))
+        return failures
+
+    def _redelivery_delay(self, failures: int) -> float:
+        """Exponential park-then-nack pause for the Nth failure."""
+        if self._redeliver_base <= 0:
+            return 0.0
+        return min(self._redeliver_cap,
+                   self._redeliver_base * (2 ** (max(failures, 1) - 1)))
+
+    async def _park(self, record: JobRecord, token: CancelToken,
+                    delay: float, release_slot, reason: str,
+                    failures: Optional[int] = None) -> None:
+        """Hold the unsettled delivery for ``delay`` seconds before its
+        nack — the broker's prefetch window is the park bench, so the
+        redelivery arrives *after* the backoff instead of instantly.
+        The run slot is released first and the wait is cancellable."""
+        if delay <= 0:
+            return
+        if release_slot is not None:
+            release_slot()
+        retry_info = {"why": reason, "nackDelayS": round(delay, 3)}
+        if failures is not None:
+            retry_info["failures"] = failures
+        record.retry = retry_info
+        record.event("park", why=reason, delay_s=round(delay, 3))
+        if self.metrics is not None:
+            label = "breaker" if reason.startswith("breaker") else "backoff"
+            self.metrics.jobs_parked.labels(reason=label).inc()
+        self.registry.transition(
+            record, control.PARKED,
+            reason=f"{reason}: redeliver in {delay:.2f}s",
+        )
+        await token.guard(asyncio.sleep(delay))
+
+    async def _settle_failed_attempt(
+        self,
+        job_id: str,
+        delivery: Delivery,
+        logger: Logger,
+        record: JobRecord,
+        token: CancelToken,
+        err: Exception,
+        release_slot,
+        why: str,
+        emit_errored: bool = True,
+    ) -> None:
+        """Settle one failed attempt under the error taxonomy
+        (platform/errors.py):
+
+        - breaker-open: park + nack WITHOUT advancing the poison counter
+          (the job never reached the dependency)
+        - PERMANENT: ack + FAILED immediately — retrying a 4xx/bad-config
+          error re-runs the same deterministic outcome
+        - POISON (bad content): ack + DROPPED_POISON immediately
+        - TRANSIENT/unclassified: advance the poison counter (the seams'
+          in-process retry budget is already spent), then park-then-nack
+          with exponential backoff so the broker redelivers after the
+          blip, not into it
+        """
+        fault = classify(err)
+        seam = getattr(err, "fault_seam", None)
+        if getattr(err, "counts_toward_poison", True) is False:
+            # the job never got to fail the dependency (BreakerOpen is
+            # the in-tree case): park + redeliver WITHOUT charging the
+            # poison budget
+            dependency = getattr(err, "dependency", None) or seam or "?"
+            delay = max(getattr(err, "retry_after", 0.0),
+                        self._redeliver_base)
+            await self._park(record, token, delay, release_slot,
+                             reason=f"breaker_open:{dependency}")
+            record.retry = None
+            record.event("settle", mode="nack", why="breaker_open",
+                         dependency=dependency)
+            await delivery.nack()
+            self.registry.transition(
+                record, control.FAILED,
+                reason=f"breaker_open: {dependency}",
+            )
+            return
+        if emit_errored:
+            await self.telemetry.emit_status(
+                job_id, schemas.TelemetryStatus.Value("ERRORED")
+            )
+        if fault in (PERMANENT, POISON):
+            logger.error("dropping job on non-retryable failure",
+                         fault=fault, error=str(err)[:200])
+            if self.metrics is not None:
+                self.metrics.jobs_failed.labels(reason=fault).inc()
+            self._failure_counts.pop(job_id, None)
+            # drop any between-attempts retry blob the Retrier left: a
+            # terminal record must not read as "waiting for a retry"
+            record.retry = None
+            record.event("settle", mode="ack", why=fault,
+                         type=type(err).__name__)
+            await delivery.ack()
+            self.registry.transition(
+                record,
+                control.FAILED if fault == PERMANENT
+                else control.DROPPED_POISON,
+                reason=f"{fault}: {type(err).__name__}",
+            )
+            return
+        failures = self._note_failure(job_id)
+        record.event("retry", failures=failures,
+                     threshold=self.poison_threshold, fault=fault,
+                     seam=seam)
+        if self.poison_threshold and failures >= self.poison_threshold:
+            logger.error(
+                "dropping poison job after repeated failures",
+                failures=failures,
+            )
+            # one failure, one count: this attempt is recorded as the
+            # drop, not double-counted as a stage_error too
+            if self.metrics is not None:
+                self.metrics.jobs_failed.labels(reason="poison").inc()
+            self._failure_counts.pop(job_id, None)
+            record.retry = None
+            record.event("settle", mode="ack", why="poison",
+                         failures=failures)
+            await delivery.ack()
+            self.registry.transition(record, control.DROPPED_POISON,
+                                     reason=f"{failures} failures")
+            return
+        if self.metrics is not None:
+            self.metrics.jobs_failed.labels(reason=why).inc()
+        delay = self._redelivery_delay(failures)
+        await self._park(record, token, delay, release_slot,
+                         reason=why, failures=failures)
+        record.retry = None
+        record.event("settle", mode="nack", why=why,
+                     delay_s=round(delay, 3))
+        await delivery.nack()
+        self.registry.transition(record, control.FAILED, reason=why)
+
     async def _run_job(
         self,
         msg: schemas.Download,
@@ -539,6 +765,7 @@ class Orchestrator:
         emitter: EventEmitter,
         record: JobRecord,
         token: CancelToken,
+        release_slot=None,
     ) -> None:
         job_id = msg.media.id
 
@@ -562,13 +789,36 @@ class Orchestrator:
         stage_table = (None if self.streaming_enabled
                        else await load_stages(ctx, self.stage_names))
 
-        # idempotency probe (reference lib/main.js:119-124)
+        # idempotency probe (reference lib/main.js:119-124) — a transient
+        # store blip here must not decide "not staged" (re-running the
+        # stages is merely wasteful) nor escape as a handler crash
+        # (instant requeue): it rides the store retry policy, and an
+        # exhausted budget settles through the classified path below
         already_staged = True
         try:
             logger.info("checking staging bucket for existing files", jobId=job_id)
-            await self.store.get_object(STAGING_BUCKET, done_marker_name(job_id))
+
+            async def _probe():
+                if faults.enabled():
+                    await faults.fire("store.get", key=job_id)
+                return await self.store.get_object(
+                    STAGING_BUCKET, done_marker_name(job_id)
+                )
+
+            await self.retrier.run("store.get", _probe, cancel=token,
+                                   record=record, logger=logger)
         except ObjectNotFound:
             already_staged = False
+        except JobCancelled:
+            raise
+        except Exception as err:
+            logger.error("staging probe failed", error=str(err))
+            record.event("error", type=type(err).__name__,
+                         error=str(err)[:300])
+            await self._settle_failed_attempt(
+                job_id, delivery, logger, record, token, err,
+                release_slot, why="stage_error")
+            return
 
         if not already_staged:
             logger.info("starting main processor after successful stage init")
@@ -640,46 +890,14 @@ class Orchestrator:
                                              reason="stalled")
                     return
 
-                # anything else -> ERRORED + redelivery
-                # (reference lib/main.js:148-150)
-                await self.telemetry.emit_status(
-                    job_id, schemas.TelemetryStatus.Value("ERRORED")
-                )
-                failures = self._failure_counts.pop(job_id, 0) + 1
-                # re-insert at the back: dict eviction below then drops the
-                # LEAST-recently-failing job, never an actively hot one
-                self._failure_counts[job_id] = failures
-                record.event("retry", failures=failures,
-                             threshold=self.poison_threshold)
-                # bound the counter dict: jobs whose redeliveries land on
-                # other replicas (or get dead-lettered) would otherwise
-                # leak one entry each for the process lifetime
-                if len(self._failure_counts) > 10_000:
-                    self._failure_counts.pop(
-                        next(iter(self._failure_counts))
-                    )
-                if self.poison_threshold and failures >= self.poison_threshold:
-                    logger.error(
-                        "dropping poison job after repeated failures",
-                        failures=failures,
-                    )
-                    # one failure, one count: this attempt is recorded as
-                    # the drop, not double-counted as a stage_error too
-                    if self.metrics is not None:
-                        self.metrics.jobs_failed.labels(reason="poison").inc()
-                    self._failure_counts.pop(job_id, None)
-                    record.event("settle", mode="ack", why="poison",
-                                 failures=failures)
-                    await delivery.ack()
-                    self.registry.transition(record, control.DROPPED_POISON,
-                                             reason=f"{failures} failures")
-                    return
-                if self.metrics is not None:
-                    self.metrics.jobs_failed.labels(reason="stage_error").inc()
-                record.event("settle", mode="nack", why="stage_error")
-                await delivery.nack()
-                self.registry.transition(record, control.FAILED,
-                                         reason="stage_error")
+                # anything else settles under the error taxonomy:
+                # permanent/poison drop immediately, transients advance
+                # the poison counter and park before their nack
+                # (replacing the reference's instant ERRORED + redelivery
+                # hot loop, lib/main.js:148-150)
+                await self._settle_failed_attempt(
+                    job_id, delivery, logger, record, token, err,
+                    release_slot, why="stage_error")
                 return
             logger.info("creating convert job")
         else:
@@ -702,34 +920,50 @@ class Orchestrator:
             tp = (None if isinstance(self.tracer, NullTracer)
                   else format_traceparent())
             headers = {"traceparent": tp} if tp else None
-            if getattr(self, "_convert_fanout", False):
-                await self.mq.publish_exchange(
-                    schemas.CONVERT_EXCHANGE, schemas.encode(payload),
-                    headers=headers,
-                )
-            else:
-                await self.mq.publish(
-                    schemas.CONVERT_QUEUE, schemas.encode(payload),
-                    headers=headers,
-                )
+
+            async def _publish():
+                if faults.enabled():
+                    await faults.fire("publish", key=job_id)
+                if getattr(self, "_convert_fanout", False):
+                    await self.mq.publish_exchange(
+                        schemas.CONVERT_EXCHANGE, schemas.encode(payload),
+                        headers=headers,
+                    )
+                else:
+                    await self.mq.publish(
+                        schemas.CONVERT_QUEUE, schemas.encode(payload),
+                        headers=headers,
+                    )
+
+            # broker blips ride the publish retry policy in-process; an
+            # exhausted budget falls through to the classified settle
+            await self.retrier.run("publish", _publish, cancel=token,
+                                   record=record, logger=logger)
             record.event("publish", queue=schemas.CONVERT_QUEUE,
                          fanout=bool(getattr(self, "_convert_fanout", False)))
             if self.metrics is not None:
                 self.metrics.messages_published.labels(
                     queue=schemas.CONVERT_QUEUE
                 ).inc()
+        except JobCancelled:
+            raise  # cancel fired during a publish retry backoff
         except Exception as err:
             # the reference logs and returns without settling
-            # (lib/main.js:161-166), which leaks the delivery; nack instead so
-            # the message is redelivered — the idempotency marker makes the
-            # retry skip straight to re-publishing the convert message
+            # (lib/main.js:161-166), which leaks the delivery.  Settle
+            # through the classified path instead — crucially, publish
+            # failures now COUNT toward the poison threshold (they
+            # previously bypassed it, so a perpetually failing convert
+            # publish redelivered forever): the idempotency marker makes
+            # each redelivery skip straight to re-publishing, and a
+            # hard-down broker trips the publish breaker + parks intake.
+            # No ERRORED telemetry here: the media is fully staged, and
+            # the reference never emitted one for publish trouble either.
             logger.error("failed to create job", error=str(err))
             record.event("error", type=type(err).__name__,
                          error=str(err)[:300])
-            record.event("settle", mode="nack", why="publish_error")
-            await delivery.nack()
-            self.registry.transition(record, control.FAILED,
-                                     reason="publish_error")
+            await self._settle_failed_attempt(
+                job_id, delivery, logger, record, token, err,
+                release_slot, why="publish_error", emit_errored=False)
             return
 
         record.event("settle", mode="ack", why="done")

@@ -52,6 +52,32 @@ DEFAULTS: dict = {
     # port): "control": {"token": "..."} — bearer token gating the
     # mutating endpoints (env CONTROL_TOKEN); "errored_on_cancel": True
     # keeps legacy telemetry consumers on ERRORED instead of CANCELLED.
+    #
+    # Dependency fault tolerance (platform/errors.py):
+    # "retry": {
+    #   "default": {"attempts": 3, "base": 0.1, "cap": 2.0},
+    #       # in-process retry budget for transient dependency failures
+    #       # (total tries / backoff floor seconds / backoff ceiling);
+    #       # per-dependency overrides under "store" | "publish" |
+    #       # "http" | "tracker" | "disk"
+    #   "redelivery": {"base": 0.25, "cap": 15.0},
+    #       # park-then-nack: a transiently-failed delivery waits
+    #       # base * 2^(failures-1) (capped) before its nack, so the
+    #       # broker redelivers AFTER the blip; base 0 = instant nack
+    # },
+    # "breakers": {
+    #   "enabled": True,
+    #   "default": {"threshold": 5, "reset": 30.0},
+    #       # consecutive transient failures that open a dependency's
+    #       # circuit breaker / seconds until its half-open probe;
+    #       # per-dependency overrides like "retry".  "http" (origin
+    #       # fetch) is breaker-less by default — one job's dead origin
+    #       # must not block the fleet — opt in via
+    #       # breakers.http.enabled: true
+    # },
+    # "faults": {"plan": [...]}  # deterministic fault injection for
+    #       # chaos drills (platform/faults.py; env FAULT_PLAN) — see
+    #       # docs/OPERATIONS.md "Failure model"
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),
         "access_key": os.environ.get("MINIO_ACCESS_KEY", ""),

@@ -1,0 +1,230 @@
+"""Deterministic fault injection at the dependency seams.
+
+The fault-tolerance layer (platform/errors.py) is only as trustworthy
+as the failures it was proven against, so the same seams the taxonomy
+covers — store/S3 ops, convert publish, HTTP origin fetch, tracker
+announce, disk preflight — carry injection hooks driven by a declarative
+**fault plan**:
+
+.. code-block:: yaml
+
+    faults:
+      plan:
+        - seam: "store.put"     # fnmatch pattern over the seam name
+          match: "job-7"        # optional substring filter on the call key
+          kind: error           # error | delay | partial | hang
+          count: 5              # how many matching calls to affect
+          after: 0              # matching calls to let through first
+          fault: transient      # taxonomy class carried by the error
+          delay_s: 0.05         # delay/partial sleep length
+
+(env ``FAULT_PLAN`` takes the same list as JSON).  Kinds:
+
+- ``error``   — raise an :class:`InjectedFault` carrying ``fault``
+- ``delay``   — sleep ``delay_s`` then let the call through
+- ``partial`` — sleep ``delay_s`` (simulated partial progress) then
+  raise, modelling a mid-transfer connection drop
+- ``hang``    — block until cancelled (exercises cancel tokens and
+  watchdogs against a black-holed dependency)
+
+Everything is deterministic — activation is by *call count* per rule,
+no randomness — so a chaos test (tests/test_faults.py, ``make chaos``)
+asserts exact retry/breaker sequences.  When no plan is installed the
+seams pay one module-level ``None`` check (:func:`enabled`), nothing
+else.
+
+The injector is process-global (:func:`install` / :func:`uninstall`):
+the seams live in stages, stores, and the tracker, and threading a
+handle through every call path would put a test-harness concern in
+every production signature.  The orchestrator installs from config at
+construction and uninstalls at shutdown; tests use
+``install(...)``/``uninstall()`` in fixtures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .config import cfg_get
+from .errors import FAULT_CLASSES, TRANSIENT
+
+_ENV_PLAN = "FAULT_PLAN"
+
+KINDS = ("error", "delay", "partial", "hang")
+
+
+class InjectedFault(RuntimeError):
+    """A failure manufactured by the fault plan (classified per rule)."""
+
+    def __init__(self, seam: str, kind: str, fault_class: str):
+        self.fault_seam = seam
+        self.kind = kind
+        self.fault_class = fault_class
+        super().__init__(f"injected {fault_class} fault at {seam} ({kind})")
+
+
+@dataclass
+class FaultRule:
+    """One line of the fault plan (see module docstring)."""
+
+    seam: str
+    kind: str = "error"
+    match: str = ""
+    count: Optional[int] = None   # None = every matching call
+    after: int = 0
+    fault: str = TRANSIENT
+    delay_s: float = 0.05
+    # runtime counters (not config)
+    calls: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"fault rule kind must be one of {KINDS}, got {self.kind!r}"
+            )
+        if self.fault not in FAULT_CLASSES:
+            raise ValueError(
+                f"fault rule fault must be one of {FAULT_CLASSES}, "
+                f"got {self.fault!r}"
+            )
+        if self.after < 0 or (self.count is not None and self.count < 0):
+            raise ValueError("fault rule after/count must be >= 0")
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "FaultRule":
+        unknown = set(raw) - {"seam", "kind", "match", "count", "after",
+                              "fault", "delay_s"}
+        if unknown:
+            raise ValueError(f"unknown fault rule keys: {sorted(unknown)}")
+        if "seam" not in raw:
+            raise ValueError("fault rule needs a 'seam'")
+        return cls(**raw)
+
+    def applies(self, seam: str, key: str) -> bool:
+        """Match + count bookkeeping; True when this call is affected."""
+        if not fnmatch.fnmatch(seam, self.seam):
+            return False
+        if self.match and self.match not in key:
+            return False
+        n = self.calls
+        self.calls += 1
+        if n < self.after:
+            return False
+        if self.count is not None and n >= self.after + self.count:
+            return False
+        return True
+
+
+class FaultInjector:
+    """Executes a fault plan at the seams; tracks firing for tests/bench."""
+
+    def __init__(self, rules: List[FaultRule], logger=None):
+        self.rules = rules
+        self.logger = logger
+        self.fired_total = 0
+        # monotonic time of the LAST injected failure: the recovery-time
+        # bench measures "dependency healthy -> first completed job" from
+        # this moment
+        self.last_fired_mono: Optional[float] = None
+
+    @classmethod
+    def from_config(cls, config, logger=None) -> "Optional[FaultInjector]":
+        """Build from env ``FAULT_PLAN`` (JSON list) or ``faults.plan``;
+        None when no plan is configured."""
+        raw_env = os.environ.get(_ENV_PLAN)
+        if raw_env:
+            try:
+                plan = json.loads(raw_env)
+            except ValueError as err:
+                raise ValueError(f"{_ENV_PLAN} is not valid JSON: {err}")
+        else:
+            plan = cfg_get(config, "faults.plan", None)
+        if not plan:
+            return None
+        if not isinstance(plan, (list, tuple)):
+            raise ValueError("faults.plan must be a list of rules")
+        rules = [FaultRule.from_dict(dict(rule)) for rule in plan]
+        return cls(rules, logger=logger)
+
+    def _note_fired(self, rule: FaultRule) -> None:
+        rule.fired += 1
+        self.fired_total += 1
+        if self.logger is not None:
+            self.logger.warn("fault injected", seam=rule.seam,
+                             kind=rule.kind, fault=rule.fault,
+                             fired=rule.fired)
+
+    async def fire(self, seam: str, key: str = "") -> None:
+        """Apply the plan to one seam call (raise / delay / hang)."""
+        for rule in self.rules:
+            if not rule.applies(seam, key):
+                continue
+            self._note_fired(rule)
+            if rule.kind == "delay":
+                await asyncio.sleep(rule.delay_s)
+                continue  # delayed, not failed: later rules still apply
+            if rule.kind == "hang":
+                await asyncio.Event().wait()  # until cancelled
+            if rule.kind == "partial":
+                # partial progress then a mid-transfer failure
+                await asyncio.sleep(rule.delay_s)
+            self.last_fired_mono = time.monotonic()
+            raise InjectedFault(seam, rule.kind, rule.fault)
+
+    def fire_sync(self, seam: str, key: str = "") -> None:
+        """Synchronous seams (disk preflight) support ``error`` only —
+        a blocking sleep would stall the event loop."""
+        for rule in self.rules:
+            if not rule.applies(seam, key):
+                continue
+            if rule.kind != "error":
+                continue
+            self._note_fired(rule)
+            self.last_fired_mono = time.monotonic()
+            raise InjectedFault(seam, rule.kind, rule.fault)
+
+
+# -- process-global installation ---------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall(injector: Optional[FaultInjector] = None) -> None:
+    """Remove the active injector.  Pass the instance you installed to
+    make uninstall idempotent across owners (the orchestrator only
+    removes its own, never a test's)."""
+    global _ACTIVE
+    if injector is None or _ACTIVE is injector:
+        _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def enabled() -> bool:
+    """The zero-overhead guard seams check before awaiting :func:`fire`."""
+    return _ACTIVE is not None
+
+
+async def fire(seam: str, key: str = "") -> None:
+    if _ACTIVE is not None:
+        await _ACTIVE.fire(seam, key)
+
+
+def fire_sync(seam: str, key: str = "") -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.fire_sync(seam, key)

@@ -75,6 +75,35 @@ class Metrics:
             ["from_state", "to_state"],
             registry=self.registry,
         )
+        self.jobs_parked = Counter(
+            f"{ns}_jobs_parked_total",
+            "Jobs parked by the fault-tolerance layer instead of failed "
+            "(breaker open at admission/mid-job, or a delayed-redelivery "
+            "backoff before a nack)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.dependency_retries = Counter(
+            f"{ns}_dependency_retries_total",
+            "In-process retries of transient dependency failures, by seam "
+            "(store.put, http.fetch, publish, ...)",
+            ["seam"],
+            registry=self.registry,
+        )
+        self.breaker_state = Gauge(
+            f"{ns}_breaker_state",
+            "Per-dependency circuit-breaker state: 0=closed, 1=open, "
+            "2=half-open",
+            ["dependency"],
+            registry=self.registry,
+        )
+        self.breaker_transitions = Counter(
+            f"{ns}_breaker_transitions_total",
+            "Circuit-breaker state transitions, by dependency and "
+            "destination state",
+            ["dependency", "to_state"],
+            registry=self.registry,
+        )
         self.stage_seconds = Histogram(
             f"{ns}_stage_seconds",
             "Wall-clock seconds per pipeline stage",

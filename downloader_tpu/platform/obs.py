@@ -235,8 +235,12 @@ class TransferProfiler:
                         flat_s=round(self.interval * flat, 2),
                     )
                     if self.logger is not None:
+                        # traceId explicitly: this logger is the service
+                        # root, not the job's child, and the stall line
+                        # must join the job's trace like every other
                         self.logger.warn(
                             "transfer flat-lined", jobId=record.job_id,
+                            traceId=record.trace_id,
                             stage=record.stage, total_bytes=total,
                             flat_s=round(self.interval * flat, 2),
                         )

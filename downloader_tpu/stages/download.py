@@ -37,6 +37,8 @@ import zlib
 import aiohttp
 
 from .. import schemas
+from ..platform import faults
+from ..platform.errors import Retrier
 from ..store.cache import ContentCache, Singleflight, cache_key
 from ..utils.disk import ensure_disk_space as _ensure_disk_space
 from ..utils.watchdog import STALL_TIMEOUT_SECONDS, StallWatchdog
@@ -295,6 +297,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
     limiter = shared_bucket(ctx.resources, ctx.config, "download_rate_limit")
 
+    # dependency fault tolerance (platform/errors.py): origin fetches
+    # ride the "http" retry policy (transient network errors/5xx back
+    # off in-process instead of burning a broker redelivery — the
+    # .partial resume point makes each retry cheaper than the last);
+    # shared with the orchestrator via ctx.resources
+    retrier = Retrier.shared(ctx.resources, ctx.config,
+                             metrics=ctx.metrics, logger=ctx.logger)
+
     # Parallel ranged HTTP: HTTP_SEGMENTS / instance.http_segments
     # connections per download (default 1 = the reference's single
     # stream).  Misconfiguration fails loudly, like the rate limit.
@@ -405,9 +415,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         transport = os.environ.get("TORRENT_TRANSPORT") or getattr(
             ctx.config.instance, "torrent_transport", None
         ) or "auto"
+        # tracker announces ride the "tracker" retry policy: attempts-1
+        # quick in-client retries per tracker (concurrent across
+        # trackers, so a flaky one never serializes the swarm bootstrap)
+        tracker_retries = max(
+            retrier.policy("tracker").attempts - 1, 0
+        )
         client = TorrentClient(logger=logger, dht=await _shared_dht(logger),
                                rate_limiter=limiter, crypto=crypto,
-                               transport=transport)
+                               transport=transport,
+                               tracker_retries=tracker_retries)
 
         # seed-while-leech: verified pieces are served back to the swarm
         # during the download; SEED_LINGER/config.instance.seed_linger keeps
@@ -1162,7 +1179,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     _promote()
                     return fetched[0]
 
-        total = await watchdog.watch(_fetch())
+        async def _attempt() -> int:
+            if faults.enabled():
+                await faults.fire("http.fetch", key=resource_url)
+            return await watchdog.watch(_fetch())
+
+        # transient origin trouble retries in-process under the "http"
+        # policy; ``fetched``/the .partial resume point persist across
+        # attempts, so a retry continues the transfer instead of
+        # restarting it.  A stall (ERRDLSTALL) passes straight through —
+        # the orchestrator's drop policy owns it.
+        total = await retrier.run("http", _attempt, cancel=cancel,
+                                  record=ctx.record, logger=logger)
         if ctx.record is not None:
             ctx.record.add_bytes("downloaded", total)
         if ctx.metrics is not None:

@@ -33,6 +33,8 @@ import shutil
 import time
 
 from .. import schemas
+from ..platform import faults
+from ..platform.errors import Retrier
 from ..utils.hashing import md5_file_hex, multipart_etag_hex
 from .base import Job, StageContext, StageFn
 
@@ -107,6 +109,13 @@ class Uploader:
 
         self.limiter = shared_bucket(ctx.resources, ctx.config,
                                      "upload_rate_limit")
+        # dependency fault tolerance (platform/errors.py): staging-store
+        # calls ride the service's shared retry executor + "store"
+        # circuit breaker (the orchestrator injects its instance via
+        # ctx.resources; standalone stage use builds one from config)
+        self.retrier = Retrier.shared(ctx.resources, ctx.config,
+                                      metrics=ctx.metrics,
+                                      logger=ctx.logger)
         self.uploaded_total = 0
 
     async def ensure_bucket(self) -> None:
@@ -119,8 +128,16 @@ class Uploader:
         """
         if self.ctx.resources.get("staging_bucket_ready"):
             return
-        if not await self.store.bucket_exists(STAGING_BUCKET):
-            await self.store.make_bucket(STAGING_BUCKET)
+
+        async def _ensure():
+            if faults.enabled():
+                await faults.fire("store.bucket", key=STAGING_BUCKET)
+            if not await self.store.bucket_exists(STAGING_BUCKET):
+                await self.store.make_bucket(STAGING_BUCKET)
+
+        await self.retrier.run("store.bucket", _ensure,
+                               cancel=self.ctx.cancel,
+                               record=self.ctx.record, logger=self.logger)
         self.ctx.resources["staging_bucket_ready"] = True
 
     def _put_supports_progress(self) -> bool:
@@ -192,22 +209,32 @@ class Uploader:
         # aliasing only — the path stays on disk, which the streaming
         # pipeline's post-download walk and the torrent serve path rely
         # on (store/base.py fput_object).
-        if self._put_supports_progress():
-            await self.store.fput_object(
-                STAGING_BUCKET, name, file_path, consume=True,
-                progress=_paced,
-            )
-        else:
-            await self.store.fput_object(
-                STAGING_BUCKET, name, file_path, consume=True)
-            # charge AFTER the successful put: consume() deducts
-            # immediately and sleeps off the deficit, pacing the AVERAGE
-            # egress rate without hooks inside the store client's
-            # transfer loop.  Charging up front would strand service-wide
-            # tokens for bytes that never moved whenever a job is
-            # cancelled or the put fails mid-wait — debt every OTHER job
-            # would then sleep off.
-            await _paced(size)
+        async def _put():
+            if faults.enabled():
+                await faults.fire("store.put", key=name)
+            if self._put_supports_progress():
+                await self.store.fput_object(
+                    STAGING_BUCKET, name, file_path, consume=True,
+                    progress=_paced,
+                )
+            else:
+                await self.store.fput_object(
+                    STAGING_BUCKET, name, file_path, consume=True)
+                # charge AFTER the successful put: consume() deducts
+                # immediately and sleeps off the deficit, pacing the
+                # AVERAGE egress rate without hooks inside the store
+                # client's transfer loop.  Charging up front would strand
+                # service-wide tokens for bytes that never moved whenever
+                # a job is cancelled or the put fails mid-wait — debt
+                # every OTHER job would then sleep off.
+                await _paced(size)
+
+        # transient store failures retry in-process (tokens were only
+        # charged for bytes that actually moved, so a retried part is
+        # paced again like any other bytes); the store breaker opens on
+        # a hard-down backend and parks intake at the orchestrator
+        await self.retrier.run("store.put", _put, cancel=ctx.cancel,
+                               record=ctx.record, logger=self.logger)
         if ctx.record is not None:
             ctx.record.add_bytes("uploaded", size)
             ctx.record.event(
@@ -221,9 +248,15 @@ class Uploader:
     async def write_done_marker(self, media_id: str) -> None:
         """Seal the staging set: the idempotency marker the orchestrator
         probes — written only once EVERY file is staged."""
-        await self.store.put_object(
-            STAGING_BUCKET, done_marker_name(media_id), b"true"
-        )
+        name = done_marker_name(media_id)
+
+        async def _seal():
+            if faults.enabled():
+                await faults.fire("store.put", key=name)
+            await self.store.put_object(STAGING_BUCKET, name, b"true")
+
+        await self.retrier.run("store.put", _seal, cancel=self.ctx.cancel,
+                               record=self.ctx.record, logger=self.logger)
 
     async def cleanup_workdir(self, download_path: str) -> None:
         """Best-effort download-dir removal (reference lib/upload.js:60-64)."""

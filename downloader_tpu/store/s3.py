@@ -27,9 +27,20 @@ from typing import AsyncIterator, Dict, Optional
 import aiohttp
 import yarl
 
+from ..platform.errors import PERMANENT, TRANSIENT
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _status_error(op: str, status: int, body: bytes = b"") -> RuntimeError:
+    """S3 error carrying its taxonomy class (platform/errors.py): 5xx /
+    408 / 429 are dependency blips worth a retry; other 4xx repeat
+    deterministically and must fail fast."""
+    err = RuntimeError(f"{op} failed: {status} {body!r}")
+    err.fault_class = (TRANSIENT if status >= 500 or status in (408, 429)
+                       else PERMANENT)
+    return err
 
 
 def _uri_encode(value: str, encode_slash: bool = True) -> str:
@@ -234,7 +245,7 @@ class S3ObjectStore(ObjectStore):
         resp = await self._request("PUT", f"/{bucket}")
         body = await resp.read()
         if resp.status not in (200, 204) and b"BucketAlreadyOwnedByYou" not in body:
-            raise RuntimeError(f"make_bucket({bucket}) failed: {resp.status} {body!r}")
+            raise _status_error(f"make_bucket({bucket})", resp.status, body)
 
     def _object_path(self, bucket: str, name: str) -> str:
         return f"/{bucket}/" + "/".join(
@@ -247,14 +258,14 @@ class S3ObjectStore(ObjectStore):
         if resp.status == 404:
             raise ObjectNotFound(bucket, name)
         if resp.status != 200:
-            raise RuntimeError(f"get_object failed: {resp.status} {body!r}")
+            raise _status_error("get_object", resp.status, body)
         return body
 
     async def put_object(self, bucket: str, name: str, data: bytes) -> None:
         resp = await self._request("PUT", self._object_path(bucket, name), data=data)
         body = await resp.read()
         if resp.status not in (200, 204):
-            raise RuntimeError(f"put_object failed: {resp.status} {body!r}")
+            raise _status_error("put_object", resp.status, body)
 
     async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
         """Streaming GET straight to disk — media files can be tens of GB,
@@ -266,7 +277,7 @@ class S3ObjectStore(ObjectStore):
                 raise ObjectNotFound(bucket, name)
             if resp.status != 200:
                 body = await resp.read()
-                raise RuntimeError(f"fget_object failed: {resp.status} {body!r}")
+                raise _status_error("fget_object", resp.status, body)
             os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
             with open(file_path, "wb") as fh:
                 async for chunk in resp.content.iter_chunked(1 << 20):
@@ -312,7 +323,7 @@ class S3ObjectStore(ObjectStore):
             )
         body = await resp.read()
         if resp.status not in (200, 204):
-            raise RuntimeError(f"fput_object failed: {resp.status} {body!r}")
+            raise _status_error("fput_object", resp.status, body)
         if progress is not None:
             await progress(size)
 
@@ -324,9 +335,7 @@ class S3ObjectStore(ObjectStore):
         resp = await self._request("POST", path, query={"uploads": ""})
         body = await resp.read()
         if resp.status != 200:
-            raise RuntimeError(
-                f"initiate multipart failed: {resp.status} {body!r}"
-            )
+            raise _status_error("initiate multipart", resp.status, body)
         match = re.search(rb"<UploadId>([^<]+)</UploadId>", body)
         if match is None:
             raise RuntimeError(f"initiate multipart: no UploadId in {body!r}")
@@ -447,7 +456,7 @@ class S3ObjectStore(ObjectStore):
         if resp.status == 404:
             raise ObjectNotFound(bucket, name)
         if resp.status != 200:
-            raise RuntimeError(f"stat_object failed: {resp.status}")
+            raise _status_error("stat_object", resp.status)
         # S3 ETag: MD5 hex for single-part uploads, md5-of-part-md5s with
         # a ``-N`` suffix for multipart — exposed verbatim; callers that
         # verify content handle both forms (see stages/upload.py
@@ -470,7 +479,7 @@ class S3ObjectStore(ObjectStore):
             if resp.status == 404:
                 raise ObjectNotFound(bucket, prefix)
             if resp.status != 200:
-                raise RuntimeError(f"list_objects failed: {resp.status} {body!r}")
+                raise _status_error("list_objects", resp.status, body)
 
             root = ET.fromstring(body)
             ns = ""

@@ -230,7 +230,7 @@ class _Swarm:
 class TorrentClient:
     def __init__(self, logger=None, peer_id: Optional[bytes] = None,
                  dht=None, rate_limiter=None, crypto: str = "prefer",
-                 transport: str = "auto"):
+                 transport: str = "auto", tracker_retries: int = 1):
         """``dht`` is an optional started :class:`~.dht.DHTNode`; when set,
         it is queried as an additional peer source next to trackers (the
         reference's webtorrent does the same via bittorrent-dht,
@@ -263,6 +263,11 @@ class TorrentClient:
             b"-DT0001-" + bytes(random.randrange(48, 58) for _ in range(12))
         )
         self.dht = dht
+        # quick per-tracker retries of transient announce failures
+        # (timeouts, 5xx, resets) — concurrent across trackers, so a
+        # flaky tracker backs off without serializing the healthy ones
+        # (platform/errors.py taxonomy; config ``retry.tracker``)
+        self.tracker_retries = max(int(tracker_retries), 0)
         # lingering seed servers: info_hash -> (Seeder, expiry task)
         self._lingering: dict = {}
 
@@ -720,9 +725,9 @@ class TorrentClient:
         """
         async def _one(url: str) -> List[tracker_mod.Peer]:
             try:
-                return await tracker_mod.announce(
+                return await tracker_mod.announce_with_retry(
                     url, info_hash, self.peer_id, port=port, left=left,
-                    event=event,
+                    event=event, retries=self.tracker_retries,
                 )
             except Exception as err:
                 self._log("tracker announce failed", tracker=url,

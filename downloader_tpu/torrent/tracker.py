@@ -35,6 +35,16 @@ class TrackerError(RuntimeError):
     pass
 
 
+def _permanent(message: str) -> TrackerError:
+    """A tracker rejection that repeats deterministically (bad scheme,
+    explicit failure reason, 4xx): tagged so the retry layer
+    (platform/errors.py classify) fails fast instead of burning its
+    backoff budget re-sending the same request."""
+    err = TrackerError(message)
+    err.fault_class = "permanent"
+    return err
+
+
 _EVENT_CODES = {"none": 0, "completed": 1, "started": 2, "stopped": 3}
 
 
@@ -52,6 +62,12 @@ async def announce(
     udp_retries: int = 2,
 ) -> List[Peer]:
     """Announce to a tracker (http/https/udp) and return its peer list."""
+    # fault-injection seam (platform/faults.py): tracker timeout storms
+    # are a chaos-drill staple, and this hook makes them deterministic
+    from ..platform import faults
+
+    if faults.enabled():
+        await faults.fire("tracker.announce", key=tracker_url)
     scheme = urllib.parse.urlsplit(tracker_url).scheme.lower()
     if scheme == "udp":
         return await announce_udp(
@@ -71,7 +87,40 @@ async def announce(
             uploaded=uploaded, downloaded=downloaded, left=left, event=event,
             session=session,
         )
-    raise TrackerError(f"unsupported tracker scheme: {scheme!r}")
+    raise _permanent(f"unsupported tracker scheme: {scheme!r}")
+
+
+async def announce_with_retry(
+    tracker_url: str,
+    info_hash: bytes,
+    peer_id: bytes,
+    port: int,
+    retries: int = 1,
+    backoff: float = 0.2,
+    **kwargs,
+) -> List[Peer]:
+    """:func:`announce` with bounded transient retries.
+
+    A tracker blip (timeout, 5xx, connection reset) gets ``retries``
+    further attempts with a doubling pause; failures the error taxonomy
+    (platform/errors.py) calls permanent — bad scheme, a bencoded
+    ``failure reason`` — re-raise immediately.  The torrent client runs
+    this per tracker *concurrently*, so a retrying tracker never delays
+    its healthy siblings.
+    """
+    from ..platform.errors import TRANSIENT, classify
+
+    delay = backoff
+    for attempt in range(retries + 1):
+        try:
+            return await announce(tracker_url, info_hash, peer_id, port,
+                                  **kwargs)
+        except Exception as err:
+            if attempt >= retries or classify(err) != TRANSIENT:
+                raise
+            await asyncio.sleep(delay)
+            delay *= 2
+    raise AssertionError("unreachable: announce retry loop returns/raises")
 
 
 async def announce_http(
@@ -109,7 +158,11 @@ async def announce_http(
         # wire untouched (yarl would otherwise re-quote it)
         async with session.get(yarl.URL(url, encoded=True)) as resp:
             if resp.status != 200:
-                raise TrackerError(f"tracker answered {resp.status}")
+                # 5xx/408/429 are outage-shaped (retryable); other 4xx
+                # repeat deterministically
+                if resp.status >= 500 or resp.status in (408, 429):
+                    raise TrackerError(f"tracker answered {resp.status}")
+                raise _permanent(f"tracker answered {resp.status}")
             body = await resp.read()
     finally:
         if owned:
@@ -117,7 +170,11 @@ async def announce_http(
 
     data = bdecode(body)
     if b"failure reason" in data:
-        raise TrackerError(data[b"failure reason"].decode("utf-8", "replace"))
+        # the tracker ANSWERED and rejected the announce (bad infohash,
+        # banned client): retrying re-sends the same request
+        raise _permanent(
+            data[b"failure reason"].decode("utf-8", "replace")
+        )
 
     peers = data.get(b"peers", b"")
     out: List[Peer] = []
@@ -179,7 +236,7 @@ async def _ws_roundtrip(tracker_url: str, payload: dict, want_action: str,
                     except ValueError:
                         continue  # not ours; tolerate tracker chatter
                     if "failure reason" in reply:
-                        raise TrackerError(str(reply["failure reason"]))
+                        raise _permanent(str(reply["failure reason"]))
                     if reply.get("action") != want_action:
                         continue
                     if "offer" in reply or "answer" in reply:
@@ -372,7 +429,7 @@ async def scrape_udp(tracker_url: str, info_hash: bytes,
     """BEP 15 action-2 scrape for one infohash."""
     parts = urllib.parse.urlsplit(tracker_url)
     if parts.hostname is None or parts.port is None:
-        raise TrackerError(f"udp tracker needs host:port: {tracker_url}")
+        raise _permanent(f"udp tracker needs host:port: {tracker_url}")
     loop = asyncio.get_running_loop()
     transport, proto = await loop.create_datagram_endpoint(
         _UdpTrackerProtocol, remote_addr=(parts.hostname, parts.port)
@@ -477,7 +534,7 @@ async def announce_udp(
     """
     parts = urllib.parse.urlsplit(tracker_url)
     if parts.hostname is None or parts.port is None:
-        raise TrackerError(f"udp tracker needs host:port: {tracker_url}")
+        raise _permanent(f"udp tracker needs host:port: {tracker_url}")
     addr = (parts.hostname, parts.port)
 
     loop = asyncio.get_running_loop()

@@ -46,6 +46,12 @@ def free_bytes(dirpath: str) -> int:
 def ensure_disk_space(dirpath: str, needed: int) -> None:
     """Raise :class:`InsufficientDiskSpace` unless ``dirpath``'s volume
     has ``needed`` bytes free."""
+    # fault-injection seam (platform/faults.py): "disk full during
+    # staging" drills inject here instead of actually filling the volume
+    from ..platform import faults
+
+    if faults.enabled():
+        faults.fire_sync("disk.preflight", key=dirpath)
     if needed <= 0:
         return
     free = shutil.disk_usage(dirpath).free

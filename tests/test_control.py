@@ -18,7 +18,7 @@ from minis3 import MiniS3
 from downloader_tpu import schemas
 from downloader_tpu.control.cancel import CancelToken, JobCancelled
 from downloader_tpu.control.registry import (
-    ADMITTED, CANCELLED, DONE, DROPPED_POISON, FAILED, PUBLISHING, RECEIVED,
+    ADMITTED, CANCELLED, DONE, FAILED, PUBLISHING, RECEIVED,
     RUNNING, IllegalTransition, JobRegistry,
 )
 from downloader_tpu.control.scheduler import PriorityScheduler, priority_rank
@@ -73,7 +73,11 @@ def test_registry_idempotent_skip_path():
     ([], RUNNING),                          # RECEIVED -> RUNNING (skips gate)
     ([ADMITTED, RUNNING, FAILED], RUNNING),  # out of terminal
     ([ADMITTED, PUBLISHING, DONE], CANCELLED),
-    ([ADMITTED], DROPPED_POISON),           # poison only from RUNNING
+    ([], PUBLISHING),                       # RECEIVED -> PUBLISHING (skips
+                                            # admission; note ADMITTED ->
+                                            # DROPPED_POISON became legal with
+                                            # the classified probe/publish
+                                            # failure paths)
 ])
 def test_registry_illegal_transitions_raise(walk, bad):
     registry = JobRegistry()

@@ -95,7 +95,9 @@ async def test_readyz_tracks_consuming(client):
     async with session.get(f"{base}/readyz") as resp:
         assert resp.status == 200
         body = await resp.json()
-        assert body == {"status": "ready", "active": 1}
+        # "breakers" rides along since the fault-tolerance layer: the
+        # dependency circuit-breaker states (empty = none instantiated)
+        assert body == {"status": "ready", "active": 1, "breakers": {}}
     orchestrator.consuming = False  # shutdown began
     async with session.get(f"{base}/readyz") as resp:
         assert resp.status == 503

@@ -49,9 +49,13 @@ def make_download_msg(uri: str, job_id: str = "job-1") -> bytes:
 
 
 async def make_orchestrator(tmp_path, broker, store, **kwargs):
-    config = ConfigNode(
-        {"instance": {"download_path": str(tmp_path / "downloads")}}
-    )
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")},
+        # fast fault-tolerance cadences: these tests exercise failure
+        # POLICY (nack/poison/stall), not production backoff timing
+        "retry": {"default": {"attempts": 2, "base": 0.01, "cap": 0.05},
+                  "redelivery": {"base": 0.01, "cap": 0.05}},
+    })
     mq = MemoryQueue(broker)
     telem_mq = MemoryQueue(broker)
     await telem_mq.connect()
