@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap chaos proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet chaos fleet proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -18,6 +18,11 @@ integration:
 chaos:
 	python -m pytest tests/test_faults.py -v
 
+# multi-worker fleet suite: coordination-store semantics, N-orchestrator
+# coalescing over MiniS3, lease takeover, coord-store chaos
+fleet:
+	python -m pytest tests/test_fleet.py -v
+
 lint:
 	python -m pytest tests/test_lint.py -q
 
@@ -28,6 +33,11 @@ bench:
 # stage_overlap_speedup must stay >= 1.25, time_to_staged_ms alongside)
 bench-overlap:
 	python bench.py --overlap
+
+# standalone fleet-coordination bench (one JSON line: M workers x same
+# hot content, fleet_origin_bytes_ratio must stay >= 2.0)
+bench-fleet:
+	python bench.py --fleet
 
 # regenerate protobuf gencode after editing downloader.proto
 proto:

@@ -102,7 +102,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  injected transient store outage ENDING (last injected failure) to
 #  the job completing, exercising in-process retry + park-then-nack
 #  redelivery end to end; sanity guard recovery_ok < 1000 ms.
-HARNESS_VERSION = 12
+# v13 (r11): fleet coordination workload — fleet_fanin_speedup: M
+#  in-process workers (own orchestrators/caches/volumes, shared broker
+#  + staging store) racing the same hot content, coordinated (fleet
+#  plane: lease singleflight + shared tier) vs uncoordinated wall;
+#  fleet_origin_bytes_ratio = uncoordinated origin bytes / coordinated
+#  origin bytes, guard >= 2.0 (with 3 workers the coordinated batch
+#  must fetch from the origin at most once per round).
+#  ``python bench.py --fleet`` runs this workload standalone
+#  (`make bench-fleet`).
+HARNESS_VERSION = 13
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -441,6 +450,163 @@ def _bench_cache_fanin_safe() -> dict:
         return asyncio.run(bench_cache_fanin())
     except Exception as err:
         return {"cache_fanin_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+FLEET_WORKERS = max(2, int(os.environ.get("BENCH_FLEET_WORKERS", 3)))
+
+
+async def bench_fleet_fanin() -> dict:
+    """Fleet coordination (harness v13): M workers, one hot content.
+
+    M orchestrators — each its own cache and download volume, shared
+    broker and staging store (the multi-process topology, in-process) —
+    each receive one job for the SAME content.  Uncoordinated, every
+    worker downloads from the origin (the pre-fleet baseline: PR 1's
+    cache cannot help across processes).  Coordinated, the fleet plane's
+    content lease elects one leader; the rest park, and materialize the
+    leader's shared-tier publish.
+
+    - ``fleet_fanin_speedup`` = uncoordinated wall / coordinated wall
+    - ``fleet_origin_bytes_ratio`` = uncoordinated origin bytes /
+      coordinated origin bytes — the acceptance guard (>= 2.0): the
+      number an origin (or egress bill) actually sees.
+    """
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.fleet import FleetPlane, MemoryCoordStore
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import FilesystemObjectStore
+
+    # the env must not re-enable coordination under the uncoordinated
+    # baseline (fleet=None means "consult config/env"): an exported
+    # FLEET_ENABLED=1 would make the raw phase coalesce too and fail
+    # the ratio guard spuriously (same scrub discipline as --overlap)
+    for var in ("FLEET_ENABLED", "FLEET_BACKEND", "WORKER_ID"):
+        os.environ.pop(var, None)
+
+    size = MIB_PER_JOB << 20
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "media.mkv")
+    with open(path, "wb") as fh:
+        fh.write(os.urandom(size))
+    gets = [0]
+
+    async def serve(request):
+        # HEAD revalidation probes are free by design; FileResponse
+        # carries the strong size/mtime ETag the cache keys on
+        if request.method == "GET":
+            gets[0] += 1
+        return web.FileResponse(path)
+
+    app = web.Application()
+    app.router.add_get("/media.mkv", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    async def run_fleet(tag: str, coordinated: bool) -> float:
+        with tempfile.TemporaryDirectory() as work:
+            broker = InMemoryBroker()
+            coord = MemoryCoordStore()
+            store = FilesystemObjectStore(os.path.join(work, "store"))
+            workers = []
+            for i in range(FLEET_WORKERS):
+                config = ConfigNode({"instance": {
+                    "download_path": os.path.join(work, f"dl{i}"),
+                    "cache": {"path": os.path.join(work, f"cache{i}")},
+                    # one job per worker at a time: the fan-in must
+                    # spread across workers, not coalesce in-process
+                    "max_concurrent_jobs": 1,
+                }})
+                plane = None
+                if coordinated:
+                    plane = FleetPlane(
+                        coord, f"bench-w{i}", store=store,
+                        heartbeat_interval=0.5, liveness_ttl=2.0,
+                        lease_ttl=5.0, poll_interval=0.02,
+                    )
+                orchestrator = Orchestrator(
+                    config=config, mq=MemoryQueue(broker), store=store,
+                    telemetry=Telemetry(MemoryQueue(broker)),
+                    logger=NullLogger(), fleet=plane,
+                    worker_id=f"bench-w{i}",
+                )
+                await orchestrator.start()
+                workers.append(orchestrator)
+            started = time.monotonic()
+            for i in range(FLEET_WORKERS):
+                msg = schemas.Download(
+                    media=schemas.Media(
+                        id=f"fleet-{tag}-{i}",
+                        creator_id=f"card-{i}",
+                        type=schemas.MediaType.Value("MOVIE"),
+                        source=schemas.SourceType.Value("HTTP"),
+                        source_uri=f"http://127.0.0.1:{port}/media.mkv",
+                    )
+                )
+                broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+            await broker.join(schemas.DOWNLOAD_QUEUE, timeout=600)
+            elapsed = time.monotonic() - started
+            converts = len(broker.published(schemas.CONVERT_QUEUE))
+            assert converts == FLEET_WORKERS, (
+                f"{tag}: {converts}/{FLEET_WORKERS} completed"
+            )
+            for orchestrator in workers:
+                await orchestrator.shutdown(grace_seconds=5)
+        return elapsed
+
+    best: "dict | None" = None
+    try:
+        for rep in range(int(os.environ.get("BENCH_FLEET_REPS", 2))):
+            before = gets[0]
+            uncoordinated_s = await run_fleet(f"raw{rep}", False)
+            raw_gets = gets[0] - before
+            before = gets[0]
+            coordinated_s = await run_fleet(f"co{rep}", True)
+            co_gets = gets[0] - before
+            ratio = raw_gets / max(co_gets, 1)
+            # the acceptance guard: coordination must at least halve
+            # what the origin sees (3 workers -> expected 3.0)
+            assert ratio >= 2.0, (
+                f"fleet coordination only cut origin fetches "
+                f"{raw_gets} -> {co_gets} (ratio {ratio:.2f} < 2.0)"
+            )
+            round_out = {
+                "fleet_fanin_speedup": round(
+                    uncoordinated_s / coordinated_s, 2),
+                "fleet_origin_bytes_ratio": round(ratio, 2),
+                "fleet_fanin_workers": FLEET_WORKERS,
+                "fleet_fanin_uncoordinated_s": round(uncoordinated_s, 3),
+                "fleet_fanin_coordinated_s": round(coordinated_s, 3),
+                "fleet_fanin_origin_fetches": co_gets,
+            }
+            if (best is None
+                    or round_out["fleet_fanin_speedup"]
+                    > best["fleet_fanin_speedup"]):
+                best = round_out
+    finally:
+        await runner.cleanup()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return best or {"fleet_bench_error": "no fleet reps ran"}
+
+
+def _bench_fleet_fanin_safe() -> dict:
+    """A fleet-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_fleet_fanin())
+    except Exception as err:
+        return {"fleet_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 async def bench_control() -> dict:
@@ -1510,6 +1676,9 @@ HEADLINE_KEYS = [
     "recovery_ok",                # r10 guard: < 1000 ms
     "fault_check_overhead_ms",    # r10 guard: disabled hooks ~free
     "faults_bench_error",         # present only on failure — visible
+    "fleet_fanin_speedup",        # r11: coordinated vs uncoordinated wall
+    "fleet_origin_bytes_ratio",   # r11 guard: origin bytes cut >= 2.0x
+    "fleet_bench_error",          # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1540,6 +1709,10 @@ def main() -> None:
         # line, no other workloads
         print(json.dumps(_bench_stage_overlap_safe()))
         return
+    if "--fleet" in sys.argv:
+        # standalone fleet-coordination run (`make bench-fleet`)
+        print(json.dumps(_bench_fleet_fanin_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -1557,6 +1730,7 @@ def main() -> None:
         "jobs": JOBS,
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
+        **_bench_fleet_fanin_safe(),
         **_bench_control_safe(),
         **_bench_faults_safe(),
         **_bench_stage_overlap_safe(),

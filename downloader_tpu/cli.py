@@ -14,6 +14,7 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli status [--url http://host:3401]
     python -m downloader_tpu.cli jobs list|show ID|events ID|cancel ID \
         [--url ...]
+    python -m downloader_tpu.cli fleet list|show WORKER [--url ...]
     python -m downloader_tpu.cli debug tasks|stacks [--url ...]
     python -m downloader_tpu.cli watch [--id my-movie]
     python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
@@ -151,6 +152,27 @@ def _build_parser() -> argparse.ArgumentParser:
     jobs_cancel.add_argument("id", help="media/job id")
     jobs_cancel.add_argument("--reason", default="cli",
                              help="recorded in the job's terminal state")
+
+    fleet = sub.add_parser(
+        "fleet", help="inspect the fleet coordination plane (workers, "
+                      "liveness, content leases, shared-tier stats)"
+    )
+    fleet_sub = fleet.add_subparsers(dest="fleet_command", required=True)
+    fleet_list = fleet_sub.add_parser(
+        "list", help="live workers + every live content lease"
+    )
+    fleet_list.add_argument("--url", default="http://127.0.0.1:3401",
+                            help="service base URL (default local health "
+                                 "port)")
+    fleet_list.add_argument("--json", action="store_true",
+                            help="raw JSON instead of the table view")
+    fleet_show = fleet_sub.add_parser(
+        "show", help="one worker's latest heartbeat document (autoscale "
+                     "signals, held leases, shared-tier stats)"
+    )
+    fleet_show.add_argument("id", help="worker id (see `fleet list`)")
+    fleet_show.add_argument("--url", default="http://127.0.0.1:3401",
+                            help="service base URL")
 
     debug = sub.add_parser(
         "debug", help="runtime introspection against a running service"
@@ -458,6 +480,59 @@ async def _jobs(args) -> int:
             return 2
 
 
+async def _fleet(args) -> int:
+    """Drive the fleet endpoints (mirrors the `jobs` UX)."""
+    import json
+    import time
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    timeout = aiohttp.ClientTimeout(total=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        try:
+            if args.fleet_command == "show":
+                async with session.get(
+                    f"{base}/v1/fleet/{args.id}"
+                ) as resp:
+                    body = await resp.json()
+                    print(json.dumps(body, indent=2, sort_keys=True))
+                    return 0 if resp.status == 200 else 1
+            async with session.get(f"{base}/v1/fleet") as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    print(json.dumps(body), file=sys.stderr)
+                    return 1
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(body, indent=2, sort_keys=True))
+        return 0
+    if not body.get("enabled"):
+        print(f"# fleet plane disabled on {body.get('workerId') or base}",
+              file=sys.stderr)
+        return 0
+    now = time.time()
+    print(f"# this worker: {body.get('workerId')}")
+    for worker in body.get("workers", []):
+        signals = worker.get("signals") or {}
+        beat_age = now - float(worker.get("heartbeatAt", now))
+        stats = worker.get("stats") or {}
+        print(f"{worker.get('workerId')}\tbeat={beat_age:.1f}s ago"
+              f"\tqueue={signals.get('queue_depth', '-')}"
+              f"\tactive={signals.get('active_jobs', '-')}"
+              f"\tleases={len(worker.get('leases') or [])}"
+              f"\tsharedHits={stats.get('sharedHits', 0)}"
+              f"\tsharedFills={stats.get('sharedFills', 0)}")
+    for lease in body.get("leases", []):
+        flag = "EXPIRED" if lease.get("expired") else "live"
+        print(f"lease {lease.get('key', '')[:16]}\t{flag}"
+              f"\towner={lease.get('owner')}"
+              f"\tfence={lease.get('fence')}")
+    return 0
+
+
 async def _debug(args) -> int:
     """Drive the runtime-introspection endpoints (/debug/*)."""
     import json
@@ -697,6 +772,8 @@ def main(argv=None) -> int:
         return asyncio.run(_status(args))
     if args.command == "jobs":
         return asyncio.run(_jobs(args))
+    if args.command == "fleet":
+        return asyncio.run(_fleet(args))
     if args.command == "debug":
         return asyncio.run(_debug(args))
     if args.command == "watch":

@@ -10,6 +10,10 @@ serves probes, metrics, and operations:
                                     samples, cache/retry/settle decisions,
                                     correlation ids)
     POST /v1/jobs/{id}/cancel       fire the job's cancel token
+    GET  /v1/fleet                  fleet membership: live workers (with
+                                    heartbeat payloads), live content
+                                    leases, this worker's fleet stats
+    GET  /v1/fleet/{id}             one worker's latest heartbeat doc
     POST /v1/intake/pause           stop pulling deliveries (in-flight
                                     work keeps running; /readyz -> 503)
     POST /v1/intake/resume          start pulling again
@@ -86,6 +90,7 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
         return web.json_response({
             "jobs": [r.to_dict() for r in registry.jobs(state)],
             "counts": registry.counts(),
+            "workerId": getattr(orchestrator, "worker_id", None),
             "intakePaused": bool(
                 getattr(orchestrator, "intake_paused", False)
             ),
@@ -118,6 +123,46 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
             "eventsDropped": record.recorder.dropped,
             "events": record.recorder.events(),
         })
+
+    async def fleet_list(_request: web.Request) -> web.Response:
+        """Fleet membership: live workers (heartbeat payloads incl. the
+        autoscale trio), every live content lease, and this worker's
+        own shared-tier stats."""
+        plane = getattr(orchestrator, "fleet", None)
+        payload = {
+            "workerId": getattr(orchestrator, "worker_id", None),
+            "enabled": plane is not None,
+        }
+        if plane is None:
+            return web.json_response(payload)
+        try:
+            payload["workers"] = await plane.workers()
+            payload["leases"] = await plane.leases()
+        except Exception as err:  # coordination store down: say so
+            return web.json_response(
+                {**payload, "error": f"coordination store: {err}"},
+                status=503,
+            )
+        payload["heldLeases"] = plane.lease_snapshot()
+        payload["stats"] = dict(plane.stats)
+        return web.json_response(payload)
+
+    async def fleet_show(request: web.Request) -> web.Response:
+        plane = getattr(orchestrator, "fleet", None)
+        if plane is None:
+            return web.json_response(
+                {"error": "fleet plane disabled"}, status=503
+            )
+        try:
+            doc = await plane.worker(request.match_info["id"])
+        except Exception as err:
+            return web.json_response(
+                {"error": f"coordination store: {err}"}, status=503
+            )
+        if doc is None:
+            return web.json_response({"error": "unknown worker"},
+                                     status=404)
+        return web.json_response(doc)
 
     async def debug_tasks(_request: web.Request) -> web.Response:
         monitor = getattr(orchestrator, "loop_monitor", None)
@@ -201,6 +246,9 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     app.router.add_get("/v1/jobs/{id}", job_show)
     app.router.add_get("/v1/jobs/{id}/events", job_events)
     app.router.add_post("/v1/jobs/{id}/cancel", job_cancel)
+    # fleet plane: membership, leases, per-worker heartbeat payloads
+    app.router.add_get("/v1/fleet", fleet_list)
+    app.router.add_get("/v1/fleet/{id}", fleet_show)
     # runtime introspection: reads, open like /metrics
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)

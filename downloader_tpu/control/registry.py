@@ -71,8 +71,11 @@ TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON})
 # ``jobs_by_state`` instead of masquerading as stuck RECEIVED/RUNNING.
 LEGAL_TRANSITIONS: Dict[str, frozenset] = {
     RECEIVED: frozenset({PARKED, ADMITTED, FAILED, CANCELLED}),
+    # PARKED -> RUNNING: a job parked MID-RUN (waiting out a peer
+    # worker's content lease, fleet/plane.py) resumes its stage when
+    # the leader publishes; admission-parked jobs still go via ADMITTED
     PARKED: frozenset(
-        {ADMITTED, FAILED, CANCELLED, DROPPED_POISON}
+        {ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON}
     ),
     ADMITTED: frozenset(
         {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
@@ -108,14 +111,21 @@ class JobRecord:
         "percent", "bytes", "cancel", "created_at", "updated_at",
         "stage_seconds", "_entered_mono", "_created_mono",
         "recorder", "trace_id", "span_id", "transferred", "retry",
+        "worker_id",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
-                 recorder_events: int = DEFAULT_EVENT_LIMIT):
+                 recorder_events: int = DEFAULT_EVENT_LIMIT,
+                 worker_id: Optional[str] = None):
         self.uid = uid
         self.job_id = job_id
         self.file_id = file_id
         self.priority = priority
+        # which worker processed this delivery: stamped into the record,
+        # every flight-recorder event (recorder context below), the
+        # job's child logger, and GET /v1/jobs — the cross-worker join
+        # key beside trace_id once a fleet of workers shares traffic
+        self.worker_id = worker_id
         self.state = RECEIVED
         self.stage: Optional[str] = None
         self.reason: Optional[str] = None
@@ -129,7 +139,10 @@ class JobRecord:
         self._entered_mono = self._created_mono
         # per-job flight recorder (platform/obs.py): the job's bounded
         # event timeline, served by GET /v1/jobs/{id}/events
-        self.recorder = FlightRecorder(recorder_events)
+        self.recorder = FlightRecorder(
+            recorder_events,
+            context={"workerId": worker_id} if worker_id else None,
+        )
         # correlation ids: the job span's W3C trace/span id, also bound
         # into the job's child logger — one id joins log lines, the
         # OTLP span, and this record's timeline
@@ -174,6 +187,7 @@ class JobRecord:
             "id": self.job_id,
             "fileId": self.file_id,
             "priority": self.priority,
+            "workerId": self.worker_id,
             "state": self.state,
             "stage": self.stage,
             "reason": self.reason,
@@ -200,9 +214,11 @@ class JobRegistry:
     """
 
     def __init__(self, metrics=None, terminal_ring: int = DEFAULT_TERMINAL_RING,
-                 logger=None, recorder_events: int = DEFAULT_EVENT_LIMIT):
+                 logger=None, recorder_events: int = DEFAULT_EVENT_LIMIT,
+                 worker_id: Optional[str] = None):
         self.metrics = metrics
         self.logger = logger
+        self.worker_id = worker_id
         self.recorder_events = max(int(recorder_events), 1)
         self.terminal_ring = max(int(terminal_ring), 0)
         self._active: "collections.OrderedDict[int, JobRecord]" = (
@@ -221,7 +237,8 @@ class JobRegistry:
                  priority: str = "NORMAL") -> JobRecord:
         """Open a record at delivery receipt (state RECEIVED)."""
         record = JobRecord(next(self._seq), job_id, file_id, priority,
-                           recorder_events=self.recorder_events)
+                           recorder_events=self.recorder_events,
+                           worker_id=self.worker_id)
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
         record.event("received", priority=priority)
@@ -343,3 +360,26 @@ class JobRegistry:
         for record in self.jobs():
             out[record.state] = out.get(record.state, 0) + 1
         return out
+
+    def queued_snapshot(self) -> "tuple[int, float]":
+        """``(depth, oldest_age_seconds)`` over jobs accepted but not
+        yet running (RECEIVED / PARKED / ADMITTED) — the autoscale
+        signal pair: how much work is waiting and for how long.
+
+        Jobs parked MID-RUN waiting out a peer worker's content lease
+        (fleet/plane.py) are excluded: they are coalescing by design,
+        not capacity starvation, and counting them would tell an
+        autoscaler to add workers that could only join the same wait.
+        """
+        depth = 0
+        oldest = 0.0
+        now = time.monotonic()
+        for record in self._active.values():
+            if record.state not in (RECEIVED, PARKED, ADMITTED):
+                continue
+            if (record.state == PARKED and record.reason
+                    and record.reason.startswith("fleet_lease_wait")):
+                continue
+            depth += 1
+            oldest = max(oldest, now - record._created_mono)
+        return depth, oldest

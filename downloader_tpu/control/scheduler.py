@@ -131,6 +131,43 @@ class PriorityScheduler:
             best.fut.set_result(None)
 
 
+class RunSlot:
+    """One job's handle on its priority-scheduler run slot.
+
+    Wraps the acquire/release pair the orchestrator used to manage with
+    closure flags, and adds :meth:`reacquire` so a stage that parks for
+    a long, idle wait — the fleet plane's lease waiters — can give the
+    slot back to runnable jobs and queue for it again (same priority
+    rank, normal aging) before resuming.  ``release`` is idempotent:
+    the park path releases before its sleep and the processor's finally
+    must not double-release.
+    """
+
+    __slots__ = ("_scheduler", "_rank", "granted", "released")
+
+    def __init__(self, scheduler: PriorityScheduler, rank: int):
+        self._scheduler = scheduler
+        self._rank = rank
+        self.granted = False
+        self.released = False
+
+    async def acquire(self) -> None:
+        await self._scheduler.acquire(self._rank)
+        self.granted = True
+        self.released = False
+
+    def release(self) -> None:
+        if self.granted and not self.released:
+            self.released = True
+            self._scheduler.release()
+
+    async def reacquire(self) -> None:
+        """Take a slot again after :meth:`release` (no-op when held)."""
+        if self.granted and self.released:
+            await self._scheduler.acquire(self._rank)
+            self.released = False
+
+
 def backlog_from_config(config) -> int:
     """``instance.scheduler_backlog`` / env SCHEDULER_BACKLOG (extra
     consumer-prefetch deliveries held for start-order reordering)."""

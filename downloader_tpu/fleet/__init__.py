@@ -1,0 +1,34 @@
+"""Fleet coordination plane: worker registry, cross-worker singleflight,
+and a shared cache tier.
+
+PR 1's cache+singleflight, PR 2's control plane, and PR 5's breakers all
+live inside one process; this package is the layer that makes N worker
+processes draining ``v1.download`` behave like one cache-coherent
+downloader:
+
+- :mod:`.coord` — the conditional-put key/value substrate (in-memory
+  backend for tests/benches, staging-bucket backend for production).
+- :mod:`.plane` — :class:`~.plane.FleetPlane`: worker
+  registration/heartbeats with liveness expiry, content-key leases with
+  TTL + takeover (cross-worker singleflight), and the shared cache tier
+  (manifest-last spill of local cache entries, peer materialization).
+
+Disabled by default (``fleet.enabled`` / env ``FLEET_ENABLED``); a lone
+worker pays nothing for it.
+"""
+
+from .coord import (  # noqa: F401
+    ABSENT,
+    ANY,
+    BucketCoordStore,
+    CoordError,
+    CoordStore,
+    MemoryCoordStore,
+)
+from .plane import (  # noqa: F401
+    LED,
+    SHARED,
+    UNCOORDINATED,
+    FleetPlane,
+    resolve_worker_id,
+)

@@ -1,0 +1,278 @@
+"""Coordination store: the fleet's tiny shared key/value substrate.
+
+Every fleet feature — worker liveness, cross-worker leases, shared-tier
+manifests — reduces to one primitive: a small JSON document at a key,
+written with *conditional-put* semantics (create-if-absent or
+compare-and-swap on a write token).  This module provides that primitive
+behind :class:`CoordStore` with two backends:
+
+- :class:`MemoryCoordStore` — an in-process dict with truly atomic
+  conditional puts.  Tests and single-host multi-orchestrator benches
+  share one instance between workers; it is also the hermetic default
+  for ``fleet.backend: memory``.
+- :class:`BucketCoordStore` — documents stored as objects in the staging
+  bucket (default prefix ``.fleet/``), so a fleet needs no coordination
+  service beyond the object store it already depends on (the same
+  posture as the idempotency marker).  Object stores are last-write-wins,
+  so the conditional put is *best-effort*: each write embeds a fresh
+  nonce and is verified by reading the key back — the standard
+  S3-lock discipline.  A lost race is detected (the read-back shows a
+  foreign nonce) in all but a sub-RTT window; the lease layer bounds the
+  damage of that window to one duplicate download, and the shared tier's
+  manifest-last publish keeps correctness unconditional.
+
+Deletes are tombstones on the bucket backend (the :class:`~..store.base.
+ObjectStore` interface has no remove): a deleted key reads as absent and
+may be recreated with ``expect=ABSENT``.
+
+Failure posture: every backend error surfaces as :class:`CoordError`
+(TRANSIENT under the platform taxonomy).  Callers — the fleet plane —
+must treat coordination trouble as *degradation to uncoordinated
+operation*, never as job failure: a worker that cannot reach the
+coordination store downloads like a pre-fleet worker.  All operations
+carry ``coord.*`` fault-injection seams (platform/faults.py) so chaos
+plans can blip exactly this dependency.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import itertools
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..platform import faults
+from ..platform.errors import TRANSIENT
+from ..stages.upload import STAGING_BUCKET
+from ..store.base import ObjectNotFound
+
+# sentinel for "the key must not exist" conditional puts
+ABSENT = "__absent__"
+# sentinel for unconditional writes
+ANY = "__any__"
+
+
+class CoordError(RuntimeError):
+    """The coordination store could not answer (TRANSIENT: the fleet
+    degrades to uncoordinated operation, jobs never fail on this)."""
+
+    fault_class = TRANSIENT
+
+
+class CoordStore(abc.ABC):
+    """Async conditional-put key/value store of small JSON documents.
+
+    Keys are ``/``-separated paths (``workers/<id>``, ``leases/<key>``).
+    Every live entry carries an opaque write *token*; ``put`` with
+    ``expect=<token>`` succeeds only against that exact version
+    (compare-and-swap), ``expect=ABSENT`` only when the key has no live
+    entry, ``expect=ANY`` unconditionally.  ``put``/``delete`` return
+    falsy on a lost race — losing a conditional write is a normal
+    outcome, not an error; :class:`CoordError` is reserved for the
+    store itself misbehaving.
+    """
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> Optional[Tuple[dict, str]]:
+        """``(data, token)`` for a live entry, else None."""
+
+    @abc.abstractmethod
+    async def put(self, key: str, data: dict,
+                  expect: str = ANY) -> Optional[str]:
+        """Conditionally write ``data``; new token, or None on conflict."""
+
+    @abc.abstractmethod
+    async def delete(self, key: str, expect: str = ANY) -> bool:
+        """Conditionally remove; True when the entry is gone."""
+
+    @abc.abstractmethod
+    async def list_keys(self, prefix: str) -> List[str]:
+        """Keys with a live entry under ``prefix``."""
+
+
+class MemoryCoordStore(CoordStore):
+    """Atomic in-process backend; share ONE instance across workers."""
+
+    def __init__(self):
+        self._entries: Dict[str, Tuple[dict, str]] = {}
+        self._lock = asyncio.Lock()
+        self._seq = itertools.count(1)
+
+    async def get(self, key: str) -> Optional[Tuple[dict, str]]:
+        if faults.enabled():
+            await faults.fire("coord.get", key=key)
+        async with self._lock:
+            entry = self._entries.get(key)
+            return (dict(entry[0]), entry[1]) if entry else None
+
+    async def put(self, key: str, data: dict,
+                  expect: str = ANY) -> Optional[str]:
+        if faults.enabled():
+            await faults.fire("coord.put", key=key)
+        async with self._lock:
+            current = self._entries.get(key)
+            if expect == ABSENT and current is not None:
+                return None
+            if expect not in (ABSENT, ANY) and (
+                    current is None or current[1] != expect):
+                return None
+            token = f"m{next(self._seq)}"
+            self._entries[key] = (dict(data), token)
+            return token
+
+    async def delete(self, key: str, expect: str = ANY) -> bool:
+        if faults.enabled():
+            await faults.fire("coord.delete", key=key)
+        async with self._lock:
+            current = self._entries.get(key)
+            if current is None:
+                return True
+            if expect != ANY and current[1] != expect:
+                return False
+            del self._entries[key]
+            return True
+
+    async def list_keys(self, prefix: str) -> List[str]:
+        if faults.enabled():
+            await faults.fire("coord.list", key=prefix)
+        async with self._lock:
+            return sorted(k for k in self._entries if k.startswith(prefix))
+
+
+class BucketCoordStore(CoordStore):
+    """Staging-bucket-backed coordination (best-effort conditional put).
+
+    One JSON object per key at ``<prefix><key>``: ``{"data": {...},
+    "token": <nonce>}``; a tombstone is the same shape with ``data``
+    null.  Writes are verified by read-back (see the module docstring
+    for the atomicity contract).
+    """
+
+    def __init__(self, store, bucket: str = STAGING_BUCKET,
+                 prefix: str = ".fleet/", settle_delay: float = 0.05):
+        self.store = store
+        self.bucket = bucket
+        self.prefix = prefix
+        # pause between write and verification read: two writers whose
+        # pre-write reads both saw the key free race last-write-wins,
+        # and without a settle the EARLIER writer can read back its own
+        # value before the later write lands — both would think they
+        # won.  Settling longer than the (pre-read -> write) gap of any
+        # concurrent writer collapses the double-win window to writers
+        # more than ``settle_delay`` apart, which the pre-write read
+        # already excludes.  Conditional writes are rare (lease ops,
+        # heartbeats), so the latency is noise.
+        self.settle_delay = float(settle_delay)
+        self._seq = itertools.count()
+        self._bucket_ready = False
+
+    def _object(self, key: str) -> str:
+        return self.prefix + key
+
+    def _nonce(self) -> str:
+        return f"{os.getpid():x}.{next(self._seq)}.{os.urandom(6).hex()}"
+
+    async def _ensure_bucket(self) -> None:
+        if self._bucket_ready:
+            return
+        if not await self.store.bucket_exists(self.bucket):
+            await self.store.make_bucket(self.bucket)
+        self._bucket_ready = True
+
+    async def _read(self, key: str) -> Optional[Tuple[Optional[dict], str]]:
+        """Raw entry including tombstones (data None); None = no object."""
+        try:
+            raw = await self.store.get_object(self.bucket, self._object(key))
+        except ObjectNotFound:
+            return None
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            return doc["data"], str(doc["token"])
+        except (ValueError, KeyError, UnicodeDecodeError) as err:
+            raise CoordError(f"corrupt coordination entry {key}: {err}")
+
+    async def get(self, key: str) -> Optional[Tuple[dict, str]]:
+        if faults.enabled():
+            await faults.fire("coord.get", key=key)
+        try:
+            entry = await self._read(key)
+        except CoordError:
+            raise
+        except Exception as err:
+            raise CoordError(f"coord get {key}: {err}") from err
+        if entry is None or entry[0] is None:
+            return None
+        return entry[0], entry[1]
+
+    async def _write_verified(self, key: str,
+                              data: Optional[dict]) -> Optional[str]:
+        """Write with a fresh nonce; token only when the read-back shows
+        OUR write survived (last-write-wins race detection)."""
+        token = self._nonce()
+        body = json.dumps({"data": data, "token": token}).encode("utf-8")
+        try:
+            await self._ensure_bucket()
+            await self.store.put_object(self.bucket, self._object(key), body)
+            if self.settle_delay > 0:
+                await asyncio.sleep(self.settle_delay)
+            raw = await self.store.get_object(self.bucket, self._object(key))
+        except Exception as err:
+            raise CoordError(f"coord put {key}: {err}") from err
+        try:
+            survived = json.loads(raw.decode("utf-8")).get("token") == token
+        except (ValueError, UnicodeDecodeError):
+            survived = False
+        return token if survived else None
+
+    async def put(self, key: str, data: dict,
+                  expect: str = ANY) -> Optional[str]:
+        if faults.enabled():
+            await faults.fire("coord.put", key=key)
+        try:
+            current = await self._read(key)
+        except CoordError:
+            # corrupt entry: only an unconditional write may repair it
+            if expect != ANY:
+                raise
+            current = None
+        except Exception as err:
+            raise CoordError(f"coord put {key}: {err}") from err
+        live = current is not None and current[0] is not None
+        if expect == ABSENT and live:
+            return None
+        if expect not in (ABSENT, ANY) and (
+                not live or current[1] != expect):
+            return None
+        return await self._write_verified(key, data)
+
+    async def delete(self, key: str, expect: str = ANY) -> bool:
+        if faults.enabled():
+            await faults.fire("coord.delete", key=key)
+        try:
+            current = await self._read(key)
+        except CoordError:
+            raise
+        except Exception as err:
+            raise CoordError(f"coord delete {key}: {err}") from err
+        if current is None or current[0] is None:
+            return True
+        if expect != ANY and current[1] != expect:
+            return False
+        # tombstone, not removal: the ObjectStore interface has no delete
+        return await self._write_verified(key, None) is not None
+
+    async def list_keys(self, prefix: str) -> List[str]:
+        if faults.enabled():
+            await faults.fire("coord.list", key=prefix)
+        out = []
+        try:
+            async for info in self.store.list_objects(
+                    self.bucket, self.prefix + prefix):
+                if info.name.startswith(self.prefix):
+                    out.append(info.name[len(self.prefix):])
+        except Exception as err:
+            raise CoordError(f"coord list {prefix}: {err}") from err
+        # tombstones still list here; callers resolve liveness via get()
+        return sorted(out)

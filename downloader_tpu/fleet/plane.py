@@ -1,0 +1,740 @@
+"""Fleet plane: worker registry, cross-worker singleflight, shared cache.
+
+Everything before this package coordinates *inside one process*: PR 1's
+singleflight coalesces same-content jobs sharing an orchestrator, and N
+independent workers draining ``v1.download`` would still each download
+the same hot episode.  :class:`FleetPlane` makes a set of worker
+processes behave like one cache-coherent downloader, on top of the
+:mod:`.coord` store's conditional-put primitive:
+
+- **Worker registry** — each orchestrator registers
+  ``workers/<worker_id>`` and re-heartbeats it every
+  ``fleet.heartbeat_interval`` seconds with the autoscale signal trio
+  (queue depth, oldest-queued age, disk headroom) plus its fleet stats;
+  an entry whose heartbeat is older than ``fleet.liveness_ttl`` is
+  considered dead and filtered from :meth:`workers` without any
+  operator action.
+- **Lease-based cross-worker singleflight** — before touching an
+  origin, a worker tries a conditional-put on ``leases/<content_key>``
+  (the exact :func:`~..store.cache.cache_key` identity the local cache
+  uses).  The winner fetches and keeps the lease renewed; losers park
+  their job (the control plane's PARKED state) and poll for the
+  leader's shared-tier publish.  A lease whose leader stopped renewing
+  (crash, partition) expires after ``fleet.lease_ttl`` and is taken
+  over by compare-and-swap — a dead leader's work is reclaimed by
+  whichever waiter notices first.
+- **Shared cache tier** — on fill, the leader spills its local cache
+  entry to ``<shared_prefix><key>/files/...`` in the staging bucket and
+  seals it with ``manifest.json`` written LAST (the same
+  manifest-publishes-the-entry discipline ``store/cache.py`` uses on
+  disk: a torn spill is invisible, never served).  Peers materialize a
+  hit by streaming the files into their local cache and hardlink-serving
+  from there, so a fleet-wide hot object costs one origin download plus
+  N-1 intra-infrastructure copies.
+
+Failure posture (the PR 5 contract): the coordination store is a
+*dependency like any other* — its calls ride the ``coord`` retry policy
+and every unrecoverable :class:`~.coord.CoordError` degrades the worker
+to plain uncoordinated fetching (counted on
+``fleet_coord_errors_total``), never failing or stalling a job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import posixpath
+import shutil
+import socket
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..control.cancel import JobCancelled
+from ..platform.config import cfg_get
+from ..stages.upload import STAGING_BUCKET
+from ..store.base import ObjectNotFound
+from .coord import (ABSENT, ANY, BucketCoordStore, CoordError, CoordStore,
+                    MemoryCoordStore)
+
+# coordination-store key namespaces
+WORKERS_PREFIX = "workers/"
+LEASES_PREFIX = "leases/"
+# shared-tier object layout in the staging bucket
+SHARED_PREFIX = ".fleet-cache/"
+MANIFEST_NAME = "manifest.json"
+
+DEFAULT_HEARTBEAT_INTERVAL = 5.0
+DEFAULT_LIVENESS_TTL = 15.0
+DEFAULT_LEASE_TTL = 20.0
+DEFAULT_POLL_INTERVAL = 0.25
+# a waiter parked on a peer's lease gives up coordinating (and fetches
+# for itself) after this long — a livelock bound, not a hot-path knob
+DEFAULT_MAX_WAIT = 600.0
+
+# a lease is only treated as dead once expired by this fraction of the
+# TTL: lease math compares the WRITER's wall clock against the READER's,
+# so modest cross-host clock skew must not let a waiter steal a lease
+# its live leader is still renewing (renewals land every ttl/3; skew
+# beyond grace + renewal margin needs NTP, which the docs require)
+TAKEOVER_GRACE_FRAC = 0.25
+
+# coordinate() outcomes
+LED = "led"                     # this worker held the lease and fetched
+SHARED = "shared"               # served from the fleet shared tier
+UNCOORDINATED = "uncoordinated"  # coordination unavailable: fetch alone
+
+
+def resolve_worker_id(config) -> str:
+    """Stable-for-the-process worker identity: env ``WORKER_ID``, config
+    ``fleet.worker_id``, else ``<host>-<pid>-<nonce>`` (the nonce keeps
+    N orchestrators in one test process distinct)."""
+    configured = os.environ.get("WORKER_ID") or cfg_get(
+        config, "fleet.worker_id", None
+    )
+    if configured:
+        return str(configured)
+    return f"{socket.gethostname()}-{os.getpid()}-{os.urandom(3).hex()}"
+
+
+class _Lease:
+    """One held lease: its CAS token and the renewal task keeping it."""
+
+    __slots__ = ("key", "token", "fence", "renewer")
+
+    def __init__(self, key: str, token: str, fence: int):
+        self.key = key
+        self.token = token
+        self.fence = fence
+        self.renewer: Optional[asyncio.Task] = None
+
+
+class FleetPlane:
+    """One worker's handle on the fleet (see module docstring)."""
+
+    def __init__(
+        self,
+        coord: CoordStore,
+        worker_id: str,
+        *,
+        store=None,
+        shared_bucket: str = STAGING_BUCKET,
+        shared_prefix: str = SHARED_PREFIX,
+        heartbeat_interval: float = DEFAULT_HEARTBEAT_INTERVAL,
+        liveness_ttl: float = DEFAULT_LIVENESS_TTL,
+        lease_ttl: float = DEFAULT_LEASE_TTL,
+        poll_interval: float = DEFAULT_POLL_INTERVAL,
+        max_wait: float = DEFAULT_MAX_WAIT,
+        metrics=None,
+        logger=None,
+        retrier=None,
+        payload_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        if liveness_ttl <= heartbeat_interval:
+            raise ValueError(
+                f"fleet.liveness_ttl ({liveness_ttl}) must exceed "
+                f"fleet.heartbeat_interval ({heartbeat_interval})"
+            )
+        if lease_ttl <= 0 or poll_interval <= 0:
+            raise ValueError("fleet lease_ttl/poll_interval must be > 0")
+        self.coord = coord
+        self.worker_id = worker_id
+        self.store = store
+        self.shared_bucket = shared_bucket
+        self.shared_prefix = shared_prefix
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.liveness_ttl = float(liveness_ttl)
+        self.lease_ttl = float(lease_ttl)
+        self.poll_interval = float(poll_interval)
+        self.max_wait = float(max_wait)
+        self.metrics = metrics
+        self.logger = logger
+        self.retrier = retrier
+        self.payload_fn = payload_fn
+        self.started_at = time.time()
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._worker_token: Optional[str] = None
+        self._gauge_sampled_mono = 0.0
+        self._held: Dict[str, _Lease] = {}
+        # local stats, also carried in every heartbeat payload
+        self.stats: Dict[str, int] = {
+            "leasesLed": 0, "leaseWaits": 0, "leaseTakeovers": 0,
+            "sharedHits": 0, "sharedFills": 0,
+            "sharedBytesIn": 0, "sharedBytesOut": 0,
+            "coordErrors": 0, "uncoordinatedFallbacks": 0,
+        }
+
+    # -- config ---------------------------------------------------------
+    @classmethod
+    def from_config(cls, config, *, worker_id: str, store=None, coord=None,
+                    metrics=None, logger=None, retrier=None,
+                    payload_fn=None) -> Optional["FleetPlane"]:
+        """Build from ``fleet.*`` / env; None when the fleet is disabled
+        (the default — a lone worker pays nothing for this subsystem).
+
+        Knobs: ``FLEET_ENABLED``/``fleet.enabled``, ``fleet.backend``
+        (``bucket`` default | ``memory``), ``fleet.heartbeat_interval``,
+        ``fleet.liveness_ttl``, ``fleet.lease_ttl``,
+        ``fleet.poll_interval``, ``fleet.max_wait``,
+        ``fleet.shared_tier`` (false keeps leases but skips the spill).
+        """
+        enabled = os.environ.get("FLEET_ENABLED")
+        if enabled is None:
+            enabled = bool(cfg_get(config, "fleet.enabled", False))
+        else:
+            enabled = enabled.lower() in ("1", "true", "yes")
+        if not enabled:
+            return None
+        if coord is None:
+            backend = os.environ.get("FLEET_BACKEND") or cfg_get(
+                config, "fleet.backend", "bucket"
+            )
+            if backend == "memory":
+                # hermetic, single-process: workers must SHARE a store
+                # to coordinate, so this is for tests/benches that pass
+                # their own — a per-worker one coordinates only itself
+                coord = MemoryCoordStore()
+            elif backend == "bucket":
+                if store is None:
+                    raise ValueError(
+                        "fleet.backend: bucket needs an object store"
+                    )
+                coord = BucketCoordStore(store)
+            else:
+                raise ValueError(
+                    f"fleet.backend must be bucket|memory, got {backend!r}"
+                )
+        shared = bool(cfg_get(config, "fleet.shared_tier", True))
+        return cls(
+            coord, worker_id,
+            store=store if shared else None,
+            heartbeat_interval=float(cfg_get(
+                config, "fleet.heartbeat_interval",
+                DEFAULT_HEARTBEAT_INTERVAL)),
+            liveness_ttl=float(cfg_get(
+                config, "fleet.liveness_ttl", DEFAULT_LIVENESS_TTL)),
+            lease_ttl=float(cfg_get(
+                config, "fleet.lease_ttl", DEFAULT_LEASE_TTL)),
+            poll_interval=float(cfg_get(
+                config, "fleet.poll_interval", DEFAULT_POLL_INTERVAL)),
+            max_wait=float(cfg_get(
+                config, "fleet.max_wait", DEFAULT_MAX_WAIT)),
+            metrics=metrics, logger=logger, retrier=retrier,
+            payload_fn=payload_fn,
+        )
+
+    # -- plumbing -------------------------------------------------------
+    def _note_coord_error(self, op: str, err: BaseException) -> None:
+        self.stats["coordErrors"] += 1
+        if self.metrics is not None:
+            self.metrics.fleet_coord_errors.labels(op=op).inc()
+        if self.logger is not None:
+            self.logger.warn("fleet coordination error",
+                             op=op, error=str(err)[:200])
+
+    async def _coord_op(self, seam: str, factory, cancel=None):
+        """Run one coordination call under the ``coord`` retry policy
+        (when a retrier is attached) so a single store blip does not
+        instantly degrade the worker to uncoordinated fetching."""
+        if self.retrier is None:
+            return await factory()
+        return await self.retrier.run(seam, factory, cancel=cancel,
+                                      logger=self.logger)
+
+    # -- worker registry ------------------------------------------------
+    def _worker_doc(self) -> dict:
+        now = time.time()
+        doc = {
+            "workerId": self.worker_id,
+            "startedAt": round(self.started_at, 3),
+            "heartbeatAt": round(now, 3),
+            "expiresAt": round(now + self.liveness_ttl, 3),
+            "leases": sorted(self._held),
+            "stats": dict(self.stats),
+        }
+        if self.payload_fn is not None:
+            try:
+                doc["signals"] = dict(self.payload_fn())
+            except Exception as err:  # a bad signal must not kill beats
+                doc["signalsError"] = str(err)[:120]
+        return doc
+
+    async def _beat_once(self) -> None:
+        doc = self._worker_doc()
+        key = WORKERS_PREFIX + self.worker_id
+        token = await self.coord.put(
+            key, doc,
+            expect=self._worker_token if self._worker_token else ANY,
+        )
+        if token is None:
+            # our entry was replaced (e.g. swept, or an id collision):
+            # reclaim it unconditionally — this worker IS the identity
+            token = await self.coord.put(key, doc, expect=ANY)
+        self._worker_token = token
+        # membership enumeration is list + one get per key (including
+        # tombstones on the bucket backend), so the gauge samples at a
+        # bounded cadence instead of every beat
+        now = time.monotonic()
+        if (self.metrics is not None and token is not None
+                and now - self._gauge_sampled_mono
+                >= max(self.heartbeat_interval, 15.0)):
+            self._gauge_sampled_mono = now
+            try:
+                live = len(await self.workers())
+                self.metrics.fleet_workers_live.set(live)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # the gauge just keeps its last sample
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await self._beat_once()
+            except CoordError as err:
+                self._note_coord_error("heartbeat", err)
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("heartbeat", err)
+            await asyncio.sleep(self.heartbeat_interval)
+
+    async def start(self) -> None:
+        """Register this worker and begin heartbeating."""
+        try:
+            await self._beat_once()
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            # registration trouble is not fatal: the loop keeps trying
+            self._note_coord_error("register", err)
+        self._heartbeat_task = asyncio.create_task(
+            self._heartbeat_loop(), name=f"fleet-heartbeat-{self.worker_id}"
+        )
+
+    async def stop(self) -> None:
+        """Deregister and release every held lease (clean drain: peers
+        see this worker vanish immediately, not after liveness_ttl)."""
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._heartbeat_task = None
+        for key in list(self._held):
+            await self.release_lease(key)
+        try:
+            await self.coord.delete(WORKERS_PREFIX + self.worker_id)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            # the registry entry simply expires after liveness_ttl
+            self._note_coord_error("deregister", err)
+
+    async def _get_all(self, prefix: str) -> "List[tuple[str, dict]]":
+        """Live ``(key, document)`` pairs under ``prefix``, resolved
+        concurrently (one listing + gathered gets — the bucket backend
+        pays one RTT, not one per key; tombstoned keys resolve to None
+        and drop out)."""
+        keys = await self.coord.list_keys(prefix)
+        entries = await asyncio.gather(
+            *(self.coord.get(key) for key in keys)
+        )
+        return [(key, entry[0]) for key, entry in zip(keys, entries)
+                if entry is not None]
+
+    async def workers(self) -> List[dict]:
+        """Live workers (heartbeat within liveness_ttl), oldest first."""
+        now = time.time()
+        out = [doc for _key, doc in await self._get_all(WORKERS_PREFIX)
+               if float(doc.get("expiresAt", 0)) >= now]
+        out.sort(key=lambda d: d.get("startedAt", 0))
+        return out
+
+    async def worker(self, worker_id: str) -> Optional[dict]:
+        entry = await self.coord.get(WORKERS_PREFIX + worker_id)
+        if entry is None:
+            return None
+        doc = entry[0]
+        doc["live"] = float(doc.get("expiresAt", 0)) >= time.time()
+        return doc
+
+    async def leases(self) -> List[dict]:
+        """Every live lease (owner, fence, expiry) — the stuck-lease
+        triage view ``cli fleet list`` renders."""
+        now = time.time()
+        out = []
+        for key, doc in await self._get_all(LEASES_PREFIX):
+            doc["key"] = key[len(LEASES_PREFIX):]
+            doc["expired"] = float(doc.get("expiresAt", 0)) < now
+            out.append(doc)
+        return out
+
+    # -- leases ---------------------------------------------------------
+    def _lease_doc(self, fence: int) -> dict:
+        now = time.time()
+        return {
+            "owner": self.worker_id,
+            "fence": fence,
+            "acquiredAt": round(now, 3),
+            "expiresAt": round(now + self.lease_ttl, 3),
+        }
+
+    async def try_acquire_lease(self, key: str) -> Optional[_Lease]:
+        """One conditional-put attempt on ``leases/<key>``.
+
+        Returns the held lease, or None when a live peer holds it.  An
+        expired lease is taken over by CAS against the dead leader's
+        token — the fence number increments so the takeover is visible
+        in the lease history."""
+        lease_key = LEASES_PREFIX + key
+        entry = await self.coord.get(lease_key)
+        if entry is None:
+            token = await self.coord.put(
+                lease_key, self._lease_doc(1), expect=ABSENT
+            )
+            fence, takeover = 1, False
+        else:
+            doc, old_token = entry
+            # a lease owned by OUR id that we do not hold is orphaned by
+            # definition (its renewer died with the previous process —
+            # stable worker_ids survive restarts): reclaim immediately
+            # instead of waiting out our own TTL
+            own_orphan = (doc.get("owner") == self.worker_id
+                          and key not in self._held)
+            grace = self.lease_ttl * TAKEOVER_GRACE_FRAC
+            if not own_orphan and (
+                    float(doc.get("expiresAt", 0)) + grace >= time.time()):
+                return None  # live (or skew-ambiguous) leader
+            fence = int(doc.get("fence", 0)) + 1
+            token = await self.coord.put(
+                lease_key, self._lease_doc(fence), expect=old_token
+            )
+            takeover = True
+        if token is None:
+            return None  # lost the race: someone else just took it
+        lease = _Lease(key, token, fence)
+        self._held[key] = lease
+        lease.renewer = asyncio.create_task(
+            self._renew_loop(lease), name=f"fleet-lease-{key[:12]}"
+        )
+        if self.metrics is not None:
+            self.metrics.fleet_leases_acquired.labels(
+                mode="takeover" if takeover else "fresh"
+            ).inc()
+        if takeover:
+            self.stats["leaseTakeovers"] += 1
+            if self.logger is not None:
+                self.logger.warn("fleet: took over expired lease",
+                                 key=key[:16], fence=fence)
+        self.stats["leasesLed"] += 1
+        return lease
+
+    async def _renew_loop(self, lease: _Lease) -> None:
+        """Keep a held lease alive while its fetch runs.  A failed renew
+        (store trouble or the lease was stolen) stops renewing but never
+        interrupts the fetch — worst case a peer duplicates the
+        download, which is the uncoordinated baseline."""
+        interval = max(self.lease_ttl / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                token = await self.coord.put(
+                    LEASES_PREFIX + lease.key, self._lease_doc(lease.fence),
+                    expect=lease.token,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("lease_renew", err)
+                return
+            if token is None:
+                if self.logger is not None:
+                    self.logger.warn("fleet: lease renewal lost",
+                                     key=lease.key[:16])
+                return
+            lease.token = token
+
+    async def release_lease(self, key: str) -> None:
+        lease = self._held.pop(key, None)
+        if lease is None:
+            return
+        if lease.renewer is not None:
+            lease.renewer.cancel()
+            try:
+                await lease.renewer
+            except (asyncio.CancelledError, Exception):
+                pass
+        try:
+            await self.coord.delete(LEASES_PREFIX + key, expect=lease.token)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            # the lease simply expires after its TTL: waiters recover
+            self._note_coord_error("lease_release", err)
+
+    def lease_snapshot(self) -> List[str]:
+        """Content keys this worker currently leads (for heartbeats and
+        the admin API)."""
+        return sorted(self._held)
+
+    # -- shared cache tier ----------------------------------------------
+    def _shared_name(self, key: str, rel: str = "") -> str:
+        if rel:
+            return posixpath.join(self.shared_prefix + key, "files", rel)
+        return posixpath.join(self.shared_prefix + key, MANIFEST_NAME)
+
+    async def publish_entry(self, key: str, cache) -> bool:
+        """Spill the local cache entry for ``key`` to the shared tier.
+
+        Payload objects first, ``manifest.json`` LAST — the manifest is
+        the publish, exactly like the local cache's rename.  Idempotent:
+        an existing manifest means a peer (or an earlier attempt)
+        already published this content.  Best-effort: failures are
+        logged and counted, never raised into the job.
+        """
+        if self.store is None:
+            return False
+        try:
+            await self.store.get_object(
+                self.shared_bucket, self._shared_name(key))
+            return True  # already published
+        except ObjectNotFound:
+            pass
+        except Exception as err:
+            self._note_coord_error("shared_probe", err)
+            return False
+        try:
+            async with cache.pinned(key):
+                # pin BEFORE the lookup: the entry cannot be evicted
+                # between reading its manifest and streaming its files
+                entry = await cache.lookup(key)
+                if entry is None:
+                    return False
+                src_dir = cache.entry_path(key)
+                for rel in entry.files:
+                    await self.store.fput_object(
+                        self.shared_bucket, self._shared_name(key, rel),
+                        os.path.join(src_dir, *rel.split("/")),
+                    )
+                manifest = {
+                    "key": key,
+                    "size": entry.size,
+                    "files": list(entry.files),
+                    "worker": self.worker_id,
+                    "created": round(time.time(), 3),
+                }
+                await self.store.put_object(
+                    self.shared_bucket, self._shared_name(key),
+                    _json_bytes(manifest),
+                )
+        except Exception as err:
+            self._note_coord_error("shared_publish", err)
+            return False
+        self.stats["sharedFills"] += 1
+        self.stats["sharedBytesOut"] += entry.size
+        if self.metrics is not None:
+            self.metrics.fleet_shared_fills.inc()
+            self.metrics.fleet_shared_bytes.labels(
+                direction="out").inc(entry.size)
+        if self.logger is not None:
+            self.logger.info("fleet: published cache entry to shared tier",
+                             key=key[:16], bytes=entry.size)
+        return True
+
+    async def fetch_entry(self, key: str, cache) -> bool:
+        """Materialize a shared-tier entry into the LOCAL cache.
+
+        Streams the manifest's files into a pid-tagged staging dir on
+        the cache volume (crash-orphans are swept by the cache's own
+        startup policy) and fills via :meth:`ContentCache.insert`, so
+        the job then hardlink-serves from the local cache like any warm
+        hit.  False on miss or any trouble — never raises.
+        """
+        if self.store is None:
+            return False
+        try:
+            raw = await self.store.get_object(
+                self.shared_bucket, self._shared_name(key))
+        except ObjectNotFound:
+            return False
+        except Exception as err:
+            self._note_coord_error("shared_probe", err)
+            return False
+        try:
+            manifest = _json_load(raw)
+            files = list(manifest["files"])
+        except (ValueError, KeyError, TypeError):
+            if self.logger is not None:
+                self.logger.warn("fleet: corrupt shared-tier manifest",
+                                 key=key[:16])
+            return False
+        if await cache.lookup(key) is not None:
+            return True  # already local (a concurrent fill won)
+        staging = os.path.join(
+            cache.staging_dir,
+            f"{key}.{os.getpid()}.fleet{os.urandom(3).hex()}",
+        )
+        try:
+            size = 0
+            for rel in files:
+                parts = [p for p in rel.split("/")
+                         if p not in ("", ".", "..")]
+                if not parts:
+                    continue
+                local = os.path.join(staging, *parts)
+                await self.store.fget_object(
+                    self.shared_bucket, self._shared_name(key, rel), local)
+                size += os.path.getsize(local)
+            entry = await cache.insert(key, staging)
+        except Exception as err:
+            self._note_coord_error("shared_fetch", err)
+            return False
+        finally:
+            await asyncio.to_thread(shutil.rmtree, staging, True)
+        got = entry.size if entry is not None else size
+        self.stats["sharedHits"] += 1
+        self.stats["sharedBytesIn"] += got
+        if self.metrics is not None:
+            self.metrics.fleet_shared_hits.inc()
+            self.metrics.fleet_shared_bytes.labels(
+                direction="in").inc(got)
+        if self.logger is not None:
+            self.logger.info("fleet: materialized shared-tier entry",
+                             key=key[:16], bytes=got)
+        return True
+
+    # -- the cross-worker singleflight protocol -------------------------
+    async def coordinate(self, key: str, cache, origin_fill, *,
+                         cancel=None, record=None, registry=None,
+                         slot=None, logger=None) -> str:
+        """Fetch-or-wait for content ``key`` fleet-wide.
+
+        ``origin_fill`` is the caller's fetch-and-fill-local-cache
+        coroutine factory; it runs iff this worker wins the lease.
+        Returns :data:`LED` (we fetched and spilled), :data:`SHARED`
+        (a peer's bytes are now in the LOCAL cache — the caller
+        materializes from there), or :data:`UNCOORDINATED`
+        (coordination unavailable / wait bound hit: the caller fetches
+        alone).  Coordination-store trouble can never raise out of
+        here; ``origin_fill``'s own errors propagate (they are job
+        errors, and the lease is released so a peer takes over).
+
+        A waiter is pure idle time, so alongside the PARKED transition
+        it gives back its run slot (``slot`` — a
+        :class:`~..control.scheduler.RunSlot`) for runnable jobs and
+        reacquires it before resuming.  The *delivery* stays unsettled
+        throughout: with ``scheduler_backlog`` 0 and one run slot the
+        broker's prefetch window still serializes behind the waiter —
+        fan-in deployments size ``max_concurrent_jobs``/backlog for it.
+        """
+        log = logger or self.logger
+        deadline = time.monotonic() + self.max_wait
+        parked = False
+        waited = False
+        try:
+            while True:
+                try:
+                    # 1) a finished leader's bytes beat any lease dance
+                    if await self.fetch_entry(key, cache):
+                        if record is not None:
+                            record.event("fleet", outcome="shared",
+                                         key=key[:16])
+                        return SHARED
+                    # 2) contend for the content lease
+                    lease = await self._coord_op(
+                        "coord.lease",
+                        lambda: self.try_acquire_lease(key),
+                        cancel=cancel,
+                    )
+                except (JobCancelled, asyncio.CancelledError):
+                    raise  # cancellation settles the job, not the fleet
+                except Exception as err:
+                    # CoordError, an open "coord" breaker, anything the
+                    # store threw raw: degrade, never fail the job
+                    self._note_coord_error("lease_acquire", err)
+                    self.stats["uncoordinatedFallbacks"] += 1
+                    if record is not None:
+                        record.event("fleet", outcome="uncoordinated",
+                                     key=key[:16])
+                    return UNCOORDINATED
+                if lease is not None:
+                    break  # we lead
+                # 3) a live peer leads: park and poll for its publish
+                if not waited:
+                    waited = True
+                    self.stats["leaseWaits"] += 1
+                    if self.metrics is not None:
+                        self.metrics.fleet_lease_waits.inc()
+                    if record is not None:
+                        record.event("fleet", outcome="wait", key=key[:16])
+                if not parked and record is not None and registry is not None:
+                    parked = True
+                    if self.metrics is not None:
+                        self.metrics.jobs_parked.labels(reason="fleet").inc()
+                    registry.transition(
+                        record, "PARKED",
+                        reason=f"fleet_lease_wait: {key[:16]}",
+                    )
+                    if slot is not None:
+                        # idle wait: a runnable job must not queue
+                        # behind it (same discipline as the delayed-
+                        # redelivery park)
+                        slot.release()
+                if time.monotonic() >= deadline:
+                    if log is not None:
+                        log.warn("fleet: lease wait bound hit, fetching "
+                                 "uncoordinated", key=key[:16])
+                    self.stats["uncoordinatedFallbacks"] += 1
+                    if record is not None:
+                        record.event("fleet", outcome="wait_timeout",
+                                     key=key[:16])
+                    return UNCOORDINATED
+                if cancel is not None:
+                    await cancel.guard(asyncio.sleep(self.poll_interval))
+                else:
+                    await asyncio.sleep(self.poll_interval)
+        finally:
+            if parked:
+                try:
+                    if slot is not None:
+                        # queue for a run slot again (priority + aging
+                        # apply as usual) before resuming the stage; a
+                        # cancellation here still closes the record via
+                        # the transition below + the orchestrator
+                        if cancel is not None:
+                            await cancel.guard(slot.reacquire())
+                        else:
+                            await slot.reacquire()
+                finally:
+                    # back to RUNNING under the stage we parked in (the
+                    # PARKED -> RUNNING edge exists for exactly this
+                    # resume)
+                    registry.transition(record, "RUNNING",
+                                        stage=record.stage)
+        # -- leader path --------------------------------------------------
+        if record is not None:
+            record.event("fleet", outcome="lead", key=key[:16],
+                         fence=lease.fence)
+        try:
+            await origin_fill()
+            await self.publish_entry(key, cache)
+        finally:
+            await self.release_lease(key)
+        return LED
+
+
+def _json_bytes(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True).encode("utf-8")
+
+
+def _json_load(raw: bytes) -> dict:
+    return json.loads(raw.decode("utf-8"))
+
+
+# re-exported for callers that build planes by hand (tests, bench)
+__all__ = [
+    "FleetPlane", "resolve_worker_id", "MemoryCoordStore",
+    "BucketCoordStore", "CoordError", "LED", "SHARED", "UNCOORDINATED",
+]

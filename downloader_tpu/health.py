@@ -96,10 +96,20 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
                  "active": len(orchestrator.active_jobs)},
                 status=503,
             )
-        return web.json_response(
-            {"status": "ready", "active": len(orchestrator.active_jobs),
-             "breakers": states}
-        )
+        payload = {"status": "ready",
+                   "active": len(orchestrator.active_jobs),
+                   "breakers": states}
+        # fleet plane: identity + liveness posture, without awaiting the
+        # coordination store (readiness probes must stay cheap — the
+        # full membership view lives on GET /v1/fleet)
+        plane = getattr(orchestrator, "fleet", None)
+        if plane is not None:
+            payload["fleet"] = {
+                "workerId": plane.worker_id,
+                "heldLeases": len(plane.lease_snapshot()),
+                "coordErrors": plane.stats.get("coordErrors", 0),
+            }
+        return web.json_response(payload)
 
     async def prom(_request: web.Request) -> web.Response:
         body = metrics.render() if metrics is not None else b""

@@ -35,9 +35,10 @@ from typing import Dict, List, Optional
 from . import control, schemas
 from .control.cancel import CancelToken, JobCancelled
 from .control.registry import JobRecord, JobRegistry
-from .control.scheduler import (PriorityScheduler, aging_from_config,
-                                backlog_from_config, priority_name,
-                                priority_rank)
+from .control.scheduler import (PriorityScheduler, RunSlot,
+                                aging_from_config, backlog_from_config,
+                                priority_name, priority_rank)
+from .fleet.plane import FleetPlane, resolve_worker_id
 from .mq.base import Delivery, MessageQueue
 from .platform import faults
 from .platform.config import cfg_get
@@ -96,6 +97,8 @@ class Orchestrator:
         poison_threshold: int = 5,
         cache: Optional[ContentCache] = None,
         admission_timeout: float = 30.0,
+        fleet: Optional[FleetPlane] = None,
+        worker_id: Optional[str] = None,
     ):
         self.config = config
         self.mq = mq
@@ -103,7 +106,14 @@ class Orchestrator:
         self.telemetry = telemetry or NullTelemetry()
         self.metrics = metrics
         self.tracer = tracer or NullTracer()
-        self.logger = logger or get_logger("orchestrator")
+        # worker identity (fleet/plane.py): bound into the ROOT logger
+        # context — every log line this orchestrator (and its per-job
+        # child loggers) emits carries workerId, so a fleet's merged
+        # log stream joins on (traceId, workerId)
+        self.worker_id = worker_id or resolve_worker_id(config)
+        self.logger = (logger or get_logger("orchestrator")).child(
+            workerId=self.worker_id
+        )
         self.stage_names = stages or list(STAGES)
         # Consumer prefetch = max concurrently-processed jobs, now
         # configurable (MAX_CONCURRENT_JOBS / instance.max_concurrent_jobs)
@@ -156,6 +166,7 @@ class Orchestrator:
             recorder_events=int(cfg_get(
                 config, "obs.recorder_events", DEFAULT_EVENT_LIMIT
             )),
+            worker_id=self.worker_id,
         )
         # runtime introspection (platform/obs.py): loop-lag sampling
         # into /metrics, and the transfer profiler feeding throughput /
@@ -235,6 +246,28 @@ class Orchestrator:
         self.retrier = Retrier(config, breakers=self.breakers,
                                metrics=metrics, logger=self.logger)
         self.stage_resources["retrier"] = self.retrier
+
+        # fleet coordination plane (fleet/): worker registry heartbeats,
+        # lease-based cross-worker singleflight, and the shared cache
+        # tier.  None (the default) = single-worker posture, zero cost.
+        # The download stage consults the plane through stage_resources
+        # before any origin fetch; the registry handle lets it park a
+        # lease-waiting job in the control plane's PARKED state.
+        self.fleet = fleet if fleet is not None else FleetPlane.from_config(
+            config, worker_id=self.worker_id, store=store,
+            metrics=metrics, logger=self.logger, retrier=self.retrier,
+            payload_fn=self.autoscale_signals,
+        )
+        if self.fleet is not None and self.fleet.payload_fn is None:
+            # a plane built by hand (tests/bench) still heartbeats the
+            # autoscale trio once an orchestrator adopts it
+            self.fleet.payload_fn = self.autoscale_signals
+        self.stage_resources["fleet_plane"] = self.fleet
+        self.stage_resources["job_registry"] = self.registry
+        # autoscale signal trio on /metrics: the same snapshot the fleet
+        # heartbeat carries (ROADMAP item 5's fleet-facing contract)
+        if metrics is not None:
+            metrics.bind_autoscale(self.autoscale_signals)
         # the dependencies whose open breaker pauses intake: everything a
         # job needs to SETTLE (staging writes + convert publish) — origin
         # fetch trouble stays per-job (a broken origin is one job's
@@ -287,7 +320,42 @@ class Orchestrator:
         self.consuming = True
         self.loop_monitor.start()
         self.profiler.start()
+        if self.fleet is not None:
+            # join the fleet LAST: by the time peers can route around or
+            # toward this worker, it is actually consuming
+            await self.fleet.start()
+            self.logger.info("joined fleet", workerId=self.worker_id)
         self.logger.info("successfully connected to queue")
+
+    # -- autoscale signals ----------------------------------------------
+    def autoscale_signals(self) -> dict:
+        """The scale-out/scale-down trio, one snapshot for BOTH surfaces
+        (/metrics gauges and the fleet heartbeat payload): queue depth,
+        oldest-queued-job age, and disk headroom on the volume jobs
+        land on (cache volume when caching, download volume otherwise).
+        """
+        depth, oldest = self.registry.queued_snapshot()
+        if self.cache is not None:
+            headroom = self.cache.free_disk_bytes()
+        else:
+            from .utils.disk import free_bytes
+
+            path = job_download_dir(self.config, "_probe")
+            while path and not os.path.isdir(path):
+                parent = os.path.dirname(path)
+                if parent == path:
+                    break
+                path = parent
+            try:
+                headroom = free_bytes(path or os.sep)
+            except OSError:
+                headroom = 0
+        return {
+            "queue_depth": depth,
+            "oldest_queued_seconds": round(oldest, 3),
+            "cache_headroom_bytes": headroom,
+            "active_jobs": len(self.active_jobs),
+        }
 
     # -- control plane: intake steering --------------------------------
     async def pause_intake(self) -> None:
@@ -359,6 +427,10 @@ class Orchestrator:
             )
         await self.profiler.stop()
         await self.loop_monitor.stop()
+        if self.fleet is not None:
+            # leave the fleet before the backends close: deregistration
+            # and lease release still have a live store to write to
+            await self.fleet.stop()
         await self.mq.close()
         await self.telemetry.close()
         for cleanup in self.stage_cleanups:
@@ -429,17 +501,13 @@ class Orchestrator:
         # creator/file id (lib/main.js:81), which collides when two jobs from
         # the same creator run concurrently
         emitter = self.emitter_table[job_id] = EventEmitter()
-        granted = False
-        released = [False]
-
-        def release_slot() -> None:
-            # idempotent: the delayed-redelivery park gives the run slot
-            # back BEFORE its backoff sleep (a healthy queued job must
-            # not wait behind a parked one), and the finally below must
-            # not double-release
-            if granted and not released[0]:
-                released[0] = True
-                self.scheduler.release()
+        # idempotent release (RunSlot): the delayed-redelivery park
+        # gives the run slot back BEFORE its backoff sleep (a healthy
+        # queued job must not wait behind a parked one), the fleet
+        # plane's lease waiters release-and-reacquire around their
+        # park, and the finally below must not double-release
+        slot = RunSlot(self.scheduler, priority_rank(priority))
+        release_slot = slot.release
 
         try:
             # dependency breakers gate intake BEFORE admission: when the
@@ -484,10 +552,7 @@ class Orchestrator:
             admitted_mono = time.monotonic()
             # priority scheduling: wait for one of the run slots, queued
             # by class (HIGH before NORMAL before BULK) with aging
-            await token.guard(
-                self.scheduler.acquire(priority_rank(priority))
-            )
-            granted = True
+            await token.guard(slot.acquire())
             sched_wait = time.monotonic() - admitted_mono
             record.event("sched_wait", seconds=round(sched_wait, 6))
             if self.metrics is not None:
@@ -509,7 +574,7 @@ class Orchestrator:
                                   trace_id=trace_id, span_id=span_id,
                                   jobId=job_id, fileId=file_id):
                 await self._run_job(msg, delivery, child, emitter,
-                                    record, token, release_slot)
+                                    record, token, slot)
         except JobCancelled:
             await self._settle_cancelled(msg, delivery, child, record, token)
         finally:
@@ -765,9 +830,10 @@ class Orchestrator:
         emitter: EventEmitter,
         record: JobRecord,
         token: CancelToken,
-        release_slot=None,
+        slot: Optional[RunSlot] = None,
     ) -> None:
         job_id = msg.media.id
+        release_slot = slot.release if slot is not None else None
 
         # build the stage table for this job (reference lib/main.js:99-115)
         ctx = StageContext(
@@ -782,6 +848,7 @@ class Orchestrator:
             cleanups=self.stage_cleanups,
             cancel=token,
             record=record,
+            slot=slot,
         )
         # the streaming dispatch builds what it needs itself (the download
         # stage against a merged-progress facade, the per-file Uploader);

@@ -78,6 +78,26 @@ DEFAULTS: dict = {
     # "faults": {"plan": [...]}  # deterministic fault injection for
     #       # chaos drills (platform/faults.py; env FAULT_PLAN) — see
     #       # docs/OPERATIONS.md "Failure model"
+    #
+    # Fleet coordination plane (fleet/): disabled by default — a lone
+    # worker pays nothing.  See docs/ARCHITECTURE.md "Fleet plane".
+    # "fleet": {
+    #   "enabled": False,            # FLEET_ENABLED; join the fleet
+    #   "backend": "bucket",         # coordination store: staging-bucket
+    #       # objects under .fleet/ (default) | "memory" (hermetic,
+    #       # single-process tests/benches)
+    #   "worker_id": None,           # WORKER_ID; default host-pid-nonce
+    #   "heartbeat_interval": 5.0,   # registry re-beat cadence, seconds
+    #   "liveness_ttl": 15.0,        # heartbeat age at which a worker
+    #       # is considered dead (must exceed heartbeat_interval)
+    #   "lease_ttl": 20.0,           # content-lease expiry; a crashed
+    #       # leader's work is taken over after this long
+    #   "poll_interval": 0.25,       # lease-waiter poll cadence
+    #   "max_wait": 600.0,           # waiter livelock bound before an
+    #       # uncoordinated fallback fetch
+    #   "shared_tier": True,         # spill cache fills to the staging
+    #       # bucket (.fleet-cache/<key>/) for peers to materialize
+    # },
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),
         "access_key": os.environ.get("MINIO_ACCESS_KEY", ""),

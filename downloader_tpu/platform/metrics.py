@@ -12,6 +12,7 @@ pipeline here records job/stage outcomes, durations, and byte counts.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from prometheus_client import (
@@ -224,6 +225,71 @@ class Metrics:
             "Bytes LRU-evicted from the staging cache",
             registry=self.registry,
         )
+        # -- fleet coordination plane (fleet/) ------------------------
+        self.fleet_workers_live = Gauge(
+            f"{ns}_fleet_workers_live",
+            "Workers with a live heartbeat in the fleet registry "
+            "(sampled at this worker's own heartbeat)",
+            registry=self.registry,
+        )
+        self.fleet_leases_acquired = Counter(
+            f"{ns}_fleet_leases_acquired_total",
+            "Cross-worker content leases this worker won, by mode "
+            "(fresh, or takeover of a dead leader's expired lease)",
+            ["mode"],
+            registry=self.registry,
+        )
+        self.fleet_lease_waits = Counter(
+            f"{ns}_fleet_lease_waits_total",
+            "Jobs that parked waiting out a peer worker's content lease "
+            "instead of duplicating its download",
+            registry=self.registry,
+        )
+        self.fleet_shared_hits = Counter(
+            f"{ns}_fleet_shared_tier_hits_total",
+            "Cache entries materialized from the fleet shared tier "
+            "instead of an origin",
+            registry=self.registry,
+        )
+        self.fleet_shared_fills = Counter(
+            f"{ns}_fleet_shared_tier_fills_total",
+            "Local cache entries spilled to the fleet shared tier",
+            registry=self.registry,
+        )
+        self.fleet_shared_bytes = Counter(
+            f"{ns}_fleet_shared_tier_bytes_total",
+            "Bytes moved through the fleet shared tier, by direction "
+            "(out = spilled by this worker, in = materialized from peers)",
+            ["direction"],
+            registry=self.registry,
+        )
+        self.fleet_coord_errors = Counter(
+            f"{ns}_fleet_coord_errors_total",
+            "Coordination-store failures, by operation — each one is a "
+            "moment this worker degraded toward uncoordinated fetching",
+            ["op"],
+            registry=self.registry,
+        )
+        # -- autoscale signal trio (ROADMAP item 5's fleet contract) --
+        self.queue_depth = Gauge(
+            f"{ns}_queue_depth",
+            "Jobs accepted but not yet running (RECEIVED/PARKED/"
+            "ADMITTED) — the primary scale-out signal",
+            registry=self.registry,
+        )
+        self.oldest_queued_seconds = Gauge(
+            f"{ns}_oldest_queued_job_seconds",
+            "Age of the oldest not-yet-running job — queue depth alone "
+            "cannot distinguish a burst from a stall",
+            registry=self.registry,
+        )
+        self.cache_headroom_bytes = Gauge(
+            f"{ns}_cache_disk_headroom_bytes",
+            "Free bytes on the cache (or download) volume — the "
+            "scale-DOWN guard: a worker without disk headroom is not "
+            "spare capacity",
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",
@@ -258,6 +324,33 @@ class Metrics:
             lambda: float(exporter.errors))
         self.otlp_queue_depth.set_function(
             lambda: float(exporter._queue.qsize()))
+
+    def bind_autoscale(self, signals_fn) -> None:
+        """Wire the autoscale trio to a live snapshot callable.
+
+        ``signals_fn`` returns ``{"queue_depth": int,
+        "oldest_queued_seconds": float, "cache_headroom_bytes": int}``
+        (the orchestrator's :meth:`autoscale_signals`); the gauges read
+        it at scrape time, so /metrics and the fleet heartbeat payload
+        report the SAME numbers by construction.  One snapshot is
+        shared by all three gauges (a sub-second memo): a scrape pays
+        one registry scan and one statvfs, not three of each.
+        """
+        memo = {"at": 0.0, "snap": None}
+
+        def _snapshot() -> dict:
+            now = time.monotonic()
+            if memo["snap"] is None or now - memo["at"] > 0.5:
+                memo["snap"] = signals_fn()
+                memo["at"] = now
+            return memo["snap"]
+
+        self.queue_depth.set_function(
+            lambda: float(_snapshot()["queue_depth"]))
+        self.oldest_queued_seconds.set_function(
+            lambda: float(_snapshot()["oldest_queued_seconds"]))
+        self.cache_headroom_bytes.set_function(
+            lambda: float(_snapshot()["cache_headroom_bytes"]))
 
     def render(self) -> bytes:
         """Prometheus text exposition of the registry."""

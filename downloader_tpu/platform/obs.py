@@ -70,18 +70,25 @@ class FlightRecorder:
     drops, so a long job's tail — where failures live — is always kept.
     """
 
-    __slots__ = ("_events", "dropped")
+    __slots__ = ("_events", "dropped", "context")
 
-    def __init__(self, limit: int = DEFAULT_EVENT_LIMIT):
+    def __init__(self, limit: int = DEFAULT_EVENT_LIMIT,
+                 context: Optional[Dict[str, Any]] = None):
         self._events: "collections.deque[dict]" = collections.deque(
             maxlen=max(int(limit), 1)
         )
         self.dropped = 0
+        # bindings stamped into EVERY event (e.g. the fleet worker id,
+        # so cross-worker traces join on (trace_id, worker_id) without
+        # each event site threading identity through)
+        self.context: Dict[str, Any] = dict(context or {})
 
     def record(self, kind: str, **fields: Any) -> None:
         if len(self._events) == self._events.maxlen:
             self.dropped += 1
         event = {"t": round(time.time(), 3), "kind": kind}
+        if self.context:
+            event.update(self.context)
         event.update(fields)
         self._events.append(event)
 

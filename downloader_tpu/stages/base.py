@@ -158,6 +158,11 @@ class StageContext:
     # byte-counter sampling (``record.add_bytes``); None outside the
     # orchestrator.
     record: Any = None
+    # The job's run-slot handle (control/scheduler.py RunSlot): lets a
+    # stage that parks for a long idle wait — the fleet plane's lease
+    # waiters — give the concurrency slot back to runnable jobs and
+    # reacquire it before resuming.  None outside the orchestrator.
+    slot: Any = None
 
 StageFn = Callable[[Job], Awaitable[Any]]
 StageFactory = Callable[[StageContext], Awaitable[StageFn]]

@@ -1406,17 +1406,23 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
     async def cached_download(key: str, method, url: str, file_id: str,
                               download_path: str, job: Job) -> None:
-        """Probe -> singleflight -> fetch -> fill, for a cacheable key."""
+        """Probe -> singleflight -> fetch -> fill, for a cacheable key.
+
+        With a fleet plane attached (fleet/plane.py, via the
+        orchestrator's stage_resources) the in-process singleflight
+        LEADER additionally coordinates fleet-wide before touching the
+        origin: shared-tier probe, then the content lease — losers park
+        and materialize the winning worker's publish instead of
+        duplicating the download.  Coordination trouble degrades to
+        exactly the pre-fleet behavior.
+        """
         # warm path: no network at all (acceptance: a warm-cache job
         # never re-fetches — only the HEAD revalidation above ran)
         if await materialize_hit(key, download_path, coalesced=False):
             return
 
-        async def leader_fetch(report) -> None:
-            # re-probe under the flight: a previous leader may have
-            # filled the key while this job queued for leadership
-            if await materialize_hit(key, download_path, coalesced=False):
-                return
+        async def origin_fill(report) -> None:
+            """Fetch from the origin into the workdir + fill the cache."""
             if ctx.metrics is not None:
                 ctx.metrics.cache_misses.inc()
             with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
@@ -1445,6 +1451,32 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if ctx.record is not None:
                     ctx.record.event("cache", outcome="fill_failed",
                                      key=key[:16], error=str(err)[:120])
+
+        async def leader_fetch(report) -> None:
+            # re-probe under the flight: a previous leader may have
+            # filled the key while this job queued for leadership
+            if await materialize_hit(key, download_path, coalesced=False):
+                return
+            fleet = ctx.resources.get("fleet_plane")
+            if fleet is not None:
+                outcome = await fleet.coordinate(
+                    key, cache, lambda: origin_fill(report),
+                    cancel=cancel, record=ctx.record,
+                    registry=ctx.resources.get("job_registry"),
+                    slot=ctx.slot, logger=logger,
+                )
+                if outcome == "led":
+                    return  # origin_fill ran under our lease
+                if outcome == "shared":
+                    # a peer worker's bytes landed in the LOCAL cache:
+                    # serve this job (and the flight's waiters) from it
+                    if await materialize_hit(key, download_path,
+                                             coalesced=False):
+                        return
+                    # evicted between fill and link: fetch ourselves
+                # "uncoordinated": coordination store unavailable or the
+                # wait bound hit — fall through to the lone-worker path
+            await origin_fill(report)
 
         async def waiter_progress(percent: int) -> None:
             await telemetry.emit_progress(file_id, downloading, percent)

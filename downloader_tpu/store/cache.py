@@ -33,6 +33,7 @@ additionally excluded by pinning.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import itertools
 import json
@@ -320,6 +321,30 @@ class ContentCache:
         """True when the cache volume holds the admission floor."""
         return self.free_disk_bytes() >= self.min_free_bytes
 
+    def entry_path(self, key: str) -> str:
+        """Absolute directory of entry ``key`` (the fleet shared tier
+        reads entry files from here when spilling; existence is the
+        caller's problem — pair with :meth:`lookup`/:meth:`pinned`)."""
+        return self._entry_dir(key)
+
+    @contextlib.asynccontextmanager
+    async def pinned(self, key: str):
+        """Hold an eviction pin on ``key`` for the duration of the
+        block — the same protection :meth:`materialize` takes while
+        hardlinking, exposed for external readers (the fleet tier's
+        spill streams entry files to the staging bucket)."""
+        async with self._lock:
+            self._pins[key] = self._pins.get(key, 0) + 1
+        try:
+            yield
+        finally:
+            async with self._lock:
+                count = self._pins.get(key, 1) - 1
+                if count <= 0:
+                    self._pins.pop(key, None)
+                else:
+                    self._pins[key] = count
+
     # -- operations -----------------------------------------------------
     async def lookup(self, key: str) -> Optional[CacheEntry]:
         """Completed entry for ``key``, LRU-touched; None on miss."""
@@ -345,13 +370,14 @@ class ContentCache:
         workdir, which the job overwrites or the upload-stage cleanup
         removes with the directory.
         """
-        async with self._lock:
-            meta = await asyncio.to_thread(self._read_meta, key)
-            if meta is None:
-                return None
-            entry = self._entry_from_meta(key, meta)
-            self._pins[key] = self._pins.get(key, 0) + 1
-        try:
+        async with self.pinned(key):
+            # pin BEFORE the manifest read: once pinned the entry
+            # cannot be evicted between the read and the links
+            async with self._lock:
+                meta = await asyncio.to_thread(self._read_meta, key)
+                if meta is None:
+                    return None
+                entry = self._entry_from_meta(key, meta)
             src_dir = self._entry_dir(key)
 
             def _link_all() -> bool:
@@ -382,13 +408,6 @@ class ContentCache:
 
             ok = await asyncio.to_thread(_link_all)
             return entry.size if ok else None
-        finally:
-            async with self._lock:
-                count = self._pins.get(key, 1) - 1
-                if count <= 0:
-                    self._pins.pop(key, None)
-                else:
-                    self._pins[key] = count
 
     async def insert(self, key: str, src_dir: str) -> Optional[CacheEntry]:
         """Fill ``key`` from a completed job workdir.
