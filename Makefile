@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet chaos fleet proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness chaos fleet proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -39,9 +39,19 @@ bench-overlap:
 bench-fleet:
 	python bench.py --fleet
 
-# regenerate protobuf gencode after editing downloader.proto
+# standalone multi-tenant fairness bench (one JSON line: a saturating
+# BULK tenant must not degrade a HIGH tenant's p99 time-to-staged by
+# more than 1.25x vs the idle-worker baseline)
+bench-fairness:
+	python bench.py --fairness
+
+# regenerate protobuf gencode (no protoc in the image: the script
+# applies the declarative edits in scripts/gen_proto.py to the current
+# serialized descriptor and re-emits downloader_pb2.py; keep
+# downloader.proto in sync by hand).  tests/test_schemas.py guards
+# against the committed module drifting from this output.
 proto:
-	protoc --python_out=downloader_tpu/schemas --proto_path=downloader_tpu/schemas downloader.proto
+	python scripts/gen_proto.py
 
 run:
 	python -m downloader_tpu

@@ -111,7 +111,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  must fetch from the origin at most once per round).
 #  ``python bench.py --fleet`` runs this workload standalone
 #  (`make bench-fleet`).
-HARNESS_VERSION = 13
+# v14 (r12): multi-tenant fairness workload — fairness_degradation: a
+#  noisy tenant saturates the worker with BULK traffic (capped at one
+#  run slot by tenants.noisy.max_concurrent) while a vip tenant submits
+#  HIGH jobs; the guard is vip's p99 time-to-staged under load vs the
+#  idle-worker baseline, fairness_ok <= 1.25x.  Without the tenancy
+#  layer the BULK backlog fills every slot and the ratio blows past 2.
+#  ``python bench.py --fairness`` runs this workload standalone
+#  (`make bench-fairness`).
+HARNESS_VERSION = 14
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -607,6 +615,174 @@ def _bench_fleet_fanin_safe() -> dict:
         return asyncio.run(bench_fleet_fanin())
     except Exception as err:
         return {"fleet_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+async def bench_fairness() -> dict:
+    """Multi-tenant fairness (harness v14).
+
+    One worker, two tenants: ``noisy`` (weight 1, capped at one run
+    slot) floods BULK jobs; ``vip`` (weight 4) submits HIGH jobs one at
+    a time.  Each job's time-to-staged is wall time from publish to its
+    registry record closing DONE.  The headline is
+
+        ``fairness_degradation`` = vip p99 loaded / vip p99 idle
+
+    with the acceptance guard ``fairness_ok`` <= 1.25: a saturating
+    BULK tenant must not meaningfully degrade a HIGH tenant's
+    time-to-staged.  All three tenancy levers hold the bar together:
+    the per-tenant concurrency cap keeps one run slot effectively
+    reserved for vip (without it the BULK backlog owns both slots and
+    every HIGH job waits out a full BULK transfer — ratio ~2x on this
+    geometry), the noisy tenant's ingress byte quota paces its transfer
+    so single-core event-loop contention stays inside the guard's
+    margin, and the weighted-fair pick orders the backlog itself.  Jobs
+    are delay-dominated (paced chunk streaming); up to two rounds run
+    and the best is kept (same posture as the fleet bench — the guard
+    is on the machinery, not on one round's scheduler jitter).
+    """
+    import statistics
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import InMemoryObjectStore
+
+    CHUNK, CHUNKS, PACE = b"x" * 8192, 20, 0.01  # ~200 ms floor per job
+    HIGH_JOBS, BULK_JOBS = 4, 12
+    NOISY_INGRESS = 256 << 10  # bytes/s: the noisy tenant's quota
+
+    async def serve(_request):
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(_request)
+        for _ in range(CHUNKS):
+            await resp.write(CHUNK)
+            await asyncio.sleep(PACE)
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/{name}", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    def msg(job_id, priority, tenant):
+        return schemas.encode(schemas.Download(
+            media=schemas.Media(
+                id=job_id, creator_id="bench",
+                name=job_id,
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=f"http://127.0.0.1:{port}/{job_id}.mkv",
+            ),
+            priority=schemas.JobPriority.Value(priority),
+            tenant=tenant,
+        ))
+
+    async def run_round(tag: str) -> dict:
+        with tempfile.TemporaryDirectory() as work:
+            broker = InMemoryBroker()
+            telem_mq = MemoryQueue(broker)
+            await telem_mq.connect()
+            orchestrator = Orchestrator(
+                config=ConfigNode({
+                    "instance": {
+                        "download_path": os.path.join(work, "dl"),
+                        "max_concurrent_jobs": 2,
+                        # wide prefetch: the whole BULK backlog must be
+                        # IN the scheduler for fairness to have work to
+                        # order
+                        "scheduler_backlog": BULK_JOBS + HIGH_JOBS + 4,
+                    },
+                    "tenants": {
+                        "noisy": {"weight": 1, "max_concurrent": 1,
+                                  "download_rate_limit": NOISY_INGRESS},
+                        "vip": {"weight": 4},
+                    },
+                }),
+                mq=MemoryQueue(broker),
+                store=InMemoryObjectStore(),
+                telemetry=Telemetry(telem_mq),
+                logger=NullLogger(),
+            )
+            await orchestrator.start()
+            registry = orchestrator.registry
+
+            async def staged_wall(job_id, priority, tenant) -> float:
+                t0 = time.perf_counter()
+                broker.publish(schemas.DOWNLOAD_QUEUE,
+                               msg(job_id, priority, tenant))
+                async with asyncio.timeout(60):
+                    while True:
+                        record = registry.get(job_id)
+                        if record is not None and record.state == "DONE":
+                            return time.perf_counter() - t0
+                        await asyncio.sleep(0.002)
+
+            try:
+                # warm the object graph (first job pays lazy init)
+                await staged_wall(f"{tag}-warm", "HIGH", "vip")
+                # idle-worker baseline: vip HIGH jobs, one at a time
+                idle = [await staged_wall(f"{tag}-idle-{i}", "HIGH", "vip")
+                        for i in range(HIGH_JOBS)]
+                # loaded: the noisy tenant's BULK flood first, then the
+                # same vip traffic while the backlog churns
+                for i in range(BULK_JOBS):
+                    broker.publish(schemas.DOWNLOAD_QUEUE,
+                                   msg(f"{tag}-bulk-{i}", "BULK", "noisy"))
+                loaded = [
+                    await staged_wall(f"{tag}-loaded-{i}", "HIGH", "vip")
+                    for i in range(HIGH_JOBS)
+                ]
+                await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
+            finally:
+                await orchestrator.shutdown(grace_seconds=10)
+
+        # p99 over 4 samples = max; median alongside for context
+        idle_p99, loaded_p99 = max(idle), max(loaded)
+        ratio = (loaded_p99 / idle_p99 if idle_p99 > 0 else float("inf"))
+        return {
+            "fairness_degradation": round(ratio, 3),
+            "fairness_ok": ratio <= 1.25,
+            "fairness_p99_idle_ms": round(idle_p99 * 1000.0, 1),
+            "fairness_p99_loaded_ms": round(loaded_p99 * 1000.0, 1),
+            "fairness_median_idle_ms": round(
+                statistics.median(idle) * 1000.0, 1),
+            "fairness_median_loaded_ms": round(
+                statistics.median(loaded) * 1000.0, 1),
+            "fairness_high_jobs": HIGH_JOBS,
+            "fairness_bulk_jobs": BULK_JOBS,
+        }
+
+    try:
+        best = None
+        for round_index in range(2):
+            result = await run_round(f"r{round_index}")
+            if (best is None or result["fairness_degradation"]
+                    < best["fairness_degradation"]):
+                best = result
+            # comfortably inside the guard: no need to pay round 2
+            if best["fairness_degradation"] <= 1.25 * 0.9:
+                break
+        return best
+    finally:
+        await runner.cleanup()
+
+
+def _bench_fairness_safe() -> dict:
+    """A fairness-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_fairness())
+    except Exception as err:
+        return {"fairness_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 async def bench_control() -> dict:
@@ -1679,6 +1855,9 @@ HEADLINE_KEYS = [
     "fleet_fanin_speedup",        # r11: coordinated vs uncoordinated wall
     "fleet_origin_bytes_ratio",   # r11 guard: origin bytes cut >= 2.0x
     "fleet_bench_error",          # present only on failure — visible
+    "fairness_degradation",       # r12: vip p99 loaded / idle, <= 1.25
+    "fairness_ok",                # r12 guard verdict
+    "fairness_error",             # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1713,6 +1892,10 @@ def main() -> None:
         # standalone fleet-coordination run (`make bench-fleet`)
         print(json.dumps(_bench_fleet_fanin_safe()))
         return
+    if "--fairness" in sys.argv:
+        # standalone multi-tenant fairness run (`make bench-fairness`)
+        print(json.dumps(_bench_fairness_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -1731,6 +1914,7 @@ def main() -> None:
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
         **_bench_fleet_fanin_safe(),
+        **_bench_fairness_safe(),
         **_bench_control_safe(),
         **_bench_faults_safe(),
         **_bench_stage_overlap_safe(),

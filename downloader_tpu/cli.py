@@ -15,6 +15,7 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli jobs list|show ID|events ID|cancel ID \
         [--url ...]
     python -m downloader_tpu.cli fleet list|show WORKER [--url ...]
+    python -m downloader_tpu.cli tenants [--url ...] [--json]
     python -m downloader_tpu.cli debug tasks|stacks [--url ...]
     python -m downloader_tpu.cli watch [--id my-movie]
     python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
@@ -72,6 +73,17 @@ def _build_parser() -> argparse.ArgumentParser:
         choices=list(schemas.JobPriority.keys()),
         help="scheduling class: HIGH starts before NORMAL before BULK "
              "when the service's run slots are contended",
+    )
+    submit.add_argument(
+        "--tenant", default="",
+        help="tenant identity for the service's weighted-fair scheduler "
+             "and per-tenant quotas (absent/unknown = 'default')",
+    )
+    submit.add_argument(
+        "--ttl", type=float, default=0.0, metavar="SECONDS",
+        help="optional deadline from receipt: expired BULK jobs are "
+             "dropped (EXPIRED), expired HIGH/NORMAL jobs are flagged "
+             "but still run (0 = no deadline)",
     )
     submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
     submit.add_argument("--wait", action="store_true",
@@ -173,6 +185,17 @@ def _build_parser() -> argparse.ArgumentParser:
     fleet_show.add_argument("id", help="worker id (see `fleet list`)")
     fleet_show.add_argument("--url", default="http://127.0.0.1:3401",
                             help="service base URL")
+
+    tenants = sub.add_parser(
+        "tenants", help="tenancy + overload posture: per-tenant weights/"
+                        "caps/quotas, live queue depth and slot "
+                        "occupancy, saturation snapshot"
+    )
+    tenants.add_argument("--url", default="http://127.0.0.1:3401",
+                         help="service base URL (default local health "
+                              "port)")
+    tenants.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the table view")
 
     debug = sub.add_parser(
         "debug", help="runtime introspection against a running service"
@@ -279,6 +302,8 @@ async def _submit(args) -> int:
             source_uri=args.uri,
         ),
         priority=schemas.JobPriority.Value(args.priority),
+        tenant=args.tenant,
+        ttl_seconds=max(args.ttl, 0.0),
     )
     from .platform.tracing import format_traceparent, init_tracer
 
@@ -533,6 +558,47 @@ async def _fleet(args) -> int:
     return 0
 
 
+async def _tenants(args) -> int:
+    """Render GET /v1/tenants (mirrors the `fleet list` UX)."""
+    import json
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    timeout = aiohttp.ClientTimeout(total=10)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        try:
+            async with session.get(f"{base}/v1/tenants") as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    print(json.dumps(body), file=sys.stderr)
+                    return 1
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(body, indent=2, sort_keys=True))
+        return 0
+    overload = body.get("overload") or {}
+    if overload.get("saturated"):
+        print("# worker SATURATED: shedding BULK "
+              f"(reasons: {','.join(overload.get('reasons', []))})",
+              file=sys.stderr)
+    if not body.get("configured"):
+        print("# no tenants.* config: every job runs as 'default'",
+              file=sys.stderr)
+    for name, t in sorted((body.get("tenants") or {}).items()):
+        cap = t.get("maxConcurrent")
+        print(f"{name}\tweight={t.get('weight')}"
+              f"\tcap={cap if cap is not None else '-'}"
+              f"\tqueued={t.get('queued', 0)}"
+              f"\trunning={t.get('runningSlots', 0)}"
+              f"\twaiting={t.get('waitingForSlot', 0)}"
+              f"\tdl={t.get('downloadRateLimit') or '-'}"
+              f"\tul={t.get('uploadRateLimit') or '-'}")
+    return 0
+
+
 async def _debug(args) -> int:
     """Drive the runtime-introspection endpoints (/debug/*)."""
     import json
@@ -774,6 +840,8 @@ def main(argv=None) -> int:
         return asyncio.run(_jobs(args))
     if args.command == "fleet":
         return asyncio.run(_fleet(args))
+    if args.command == "tenants":
+        return asyncio.run(_tenants(args))
     if args.command == "debug":
         return asyncio.run(_debug(args))
     if args.command == "watch":

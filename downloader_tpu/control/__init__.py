@@ -23,6 +23,7 @@ from .registry import (  # noqa: F401
     CANCELLED,
     DONE,
     DROPPED_POISON,
+    EXPIRED,
     FAILED,
     PARKED,
     PUBLISHING,

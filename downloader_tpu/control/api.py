@@ -14,6 +14,10 @@ serves probes, metrics, and operations:
                                     heartbeat payloads), live content
                                     leases, this worker's fleet stats
     GET  /v1/fleet/{id}             one worker's latest heartbeat doc
+    GET  /v1/tenants                tenancy + overload posture: per-
+                                    tenant weight/caps/quotas, live queue
+                                    depth and slot occupancy, saturation
+                                    snapshot
     POST /v1/intake/pause           stop pulling deliveries (in-flight
                                     work keeps running; /readyz -> 503)
     POST /v1/intake/resume          start pulling again
@@ -164,6 +168,41 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
                                      status=404)
         return web.json_response(doc)
 
+    async def tenants_list(_request: web.Request) -> web.Response:
+        """Tenancy + overload posture: per-tenant config (weight, caps,
+        quotas), live per-tenant queue depth / held run slots / waiting
+        jobs, and the overload controller's saturation snapshot — the
+        one endpoint that answers "why is tenant X's work not starting"."""
+        table = getattr(orchestrator, "tenants", None)
+        if table is None:
+            return web.json_response(
+                {"error": "tenancy unavailable"}, status=503
+            )
+        registry = _registry()
+        scheduler = getattr(orchestrator, "scheduler", None)
+        depths = (registry.tenant_queue_depths()
+                  if registry is not None else {})
+        held = (scheduler.held_by_tenant()
+                if scheduler is not None else {})
+        waiting = (scheduler.waiting_by_tenant()
+                   if scheduler is not None else {})
+        tenants = {}
+        for name, spec in table.describe().items():
+            tenants[name] = {
+                **spec,
+                "queued": depths.get(name, 0),
+                "runningSlots": held.get(name, 0),
+                "waitingForSlot": waiting.get(name, 0),
+            }
+        overload = getattr(orchestrator, "overload", None)
+        return web.json_response({
+            "workerId": getattr(orchestrator, "worker_id", None),
+            "configured": table.configured,
+            "tenants": tenants,
+            "overload": (overload.snapshot() if overload is not None
+                         else {"enabled": False}),
+        })
+
     async def debug_tasks(_request: web.Request) -> web.Response:
         monitor = getattr(orchestrator, "loop_monitor", None)
         return web.json_response({
@@ -249,6 +288,9 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     # fleet plane: membership, leases, per-worker heartbeat payloads
     app.router.add_get("/v1/fleet", fleet_list)
     app.router.add_get("/v1/fleet/{id}", fleet_show)
+    # tenancy + overload: per-tenant weights/caps/quotas, live queue
+    # depth and slot occupancy, and the saturation snapshot
+    app.router.add_get("/v1/tenants", tenants_list)
     # runtime introspection: reads, open like /metrics
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)

@@ -58,8 +58,15 @@ DONE = "DONE"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
 DROPPED_POISON = "DROPPED_POISON"
+# deadline-expired BULK work dropped by the overload layer
+# (control/overload.py): distinct from FAILED (nothing errored) and from
+# DROPPED_POISON (the content is fine) — the job simply outlived its
+# submitter-declared TTL while queued, and re-running it would waste the
+# very capacity the deadline exists to protect
+EXPIRED = "EXPIRED"
 
-TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON})
+TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON,
+                             EXPIRED})
 
 # RUNNING -> RUNNING models stage hops (download -> process -> upload);
 # ADMITTED -> PUBLISHING is the idempotency skip (done marker already
@@ -70,15 +77,20 @@ TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED, DROPPED_POISON})
 # delayed-redelivery backoff before its nack — visible in
 # ``jobs_by_state`` instead of masquerading as stuck RECEIVED/RUNNING.
 LEGAL_TRANSITIONS: Dict[str, frozenset] = {
-    RECEIVED: frozenset({PARKED, ADMITTED, FAILED, CANCELLED}),
+    # EXPIRED is reachable only BEFORE a job runs (RECEIVED/PARKED/
+    # ADMITTED): a deadline noticed mid-transfer finishes the work — the
+    # bytes are mostly paid for, and the deadline's purpose is to shed
+    # *queued* backlog, not to waste a nearly-done transfer
+    RECEIVED: frozenset({PARKED, ADMITTED, FAILED, CANCELLED, EXPIRED}),
     # PARKED -> RUNNING: a job parked MID-RUN (waiting out a peer
     # worker's content lease, fleet/plane.py) resumes its stage when
     # the leader publishes; admission-parked jobs still go via ADMITTED
     PARKED: frozenset(
-        {ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON}
+        {ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON, EXPIRED}
     ),
     ADMITTED: frozenset(
-        {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
+        {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON,
+         EXPIRED}
     ),
     RUNNING: frozenset(
         {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON}
@@ -92,6 +104,7 @@ LEGAL_TRANSITIONS: Dict[str, frozenset] = {
     FAILED: frozenset(),
     CANCELLED: frozenset(),
     DROPPED_POISON: frozenset(),
+    EXPIRED: frozenset(),
 }
 
 DEFAULT_TERMINAL_RING = 256
@@ -111,16 +124,26 @@ class JobRecord:
         "percent", "bytes", "cancel", "created_at", "updated_at",
         "stage_seconds", "_entered_mono", "_created_mono",
         "recorder", "trace_id", "span_id", "transferred", "retry",
-        "worker_id",
+        "worker_id", "tenant", "ttl_seconds", "deadline_mono",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
                  recorder_events: int = DEFAULT_EVENT_LIMIT,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 tenant: str = "default",
+                 ttl_seconds: float = 0.0):
         self.uid = uid
         self.job_id = job_id
         self.file_id = file_id
         self.priority = priority
+        # resolved tenant identity (control/tenancy.py): the axis the
+        # scheduler's weighted-fair pick, the per-tenant quotas, and the
+        # shed metrics attribute this delivery to
+        self.tenant = tenant
+        # optional deadline: Download.ttl_seconds measured from receipt;
+        # 0 = none.  deadline_mono is the absolute monotonic cutoff.
+        self.ttl_seconds = float(ttl_seconds or 0.0)
+        self.deadline_mono: Optional[float] = None
         # which worker processed this delivery: stamped into the record,
         # every flight-recorder event (recorder context below), the
         # job's child logger, and GET /v1/jobs — the cross-worker join
@@ -137,11 +160,19 @@ class JobRecord:
         self.stage_seconds: Dict[str, float] = {}
         self._created_mono = time.monotonic()
         self._entered_mono = self._created_mono
+        if self.ttl_seconds > 0:
+            self.deadline_mono = self._created_mono + self.ttl_seconds
         # per-job flight recorder (platform/obs.py): the job's bounded
-        # event timeline, served by GET /v1/jobs/{id}/events
+        # event timeline, served by GET /v1/jobs/{id}/events.  The
+        # tenant joins the context only when non-default, so a
+        # single-tenant deployment's event stream is unchanged.
+        context: Dict[str, Any] = {}
+        if worker_id:
+            context["workerId"] = worker_id
+        if tenant and tenant != "default":
+            context["tenant"] = tenant
         self.recorder = FlightRecorder(
-            recorder_events,
-            context={"workerId": worker_id} if worker_id else None,
+            recorder_events, context=context or None,
         )
         # correlation ids: the job span's W3C trace/span id, also bound
         # into the job's child logger — one id joins log lines, the
@@ -164,6 +195,19 @@ class JobRecord:
     def terminal(self) -> bool:
         return self.state in TERMINAL_STATES
 
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        """True once the job's TTL (if any) has elapsed since receipt."""
+        if self.deadline_mono is None:
+            return False
+        return (now if now is not None else time.monotonic()) \
+            >= self.deadline_mono
+
+    def deadline_remaining(self) -> Optional[float]:
+        """Seconds until the deadline (negative = overdue); None = no TTL."""
+        if self.deadline_mono is None:
+            return None
+        return self.deadline_mono - time.monotonic()
+
     def event(self, kind: str, **fields: Any) -> None:
         """Append one flight-recorder event to this job's timeline."""
         self.recorder.record(kind, **fields)
@@ -183,10 +227,16 @@ class JobRecord:
 
     def to_dict(self) -> dict:
         """JSON shape served by ``GET /v1/jobs[/{id}]``."""
+        remaining = self.deadline_remaining()
         return {
             "id": self.job_id,
             "fileId": self.file_id,
             "priority": self.priority,
+            "tenant": self.tenant,
+            "ttlSeconds": self.ttl_seconds or None,
+            "deadlineRemainingSeconds": (
+                round(remaining, 3) if remaining is not None else None
+            ),
             "workerId": self.worker_id,
             "state": self.state,
             "stage": self.stage,
@@ -234,11 +284,13 @@ class JobRegistry:
 
     # -- lifecycle ------------------------------------------------------
     def register(self, job_id: str, file_id: str,
-                 priority: str = "NORMAL") -> JobRecord:
+                 priority: str = "NORMAL", tenant: str = "default",
+                 ttl_seconds: float = 0.0) -> JobRecord:
         """Open a record at delivery receipt (state RECEIVED)."""
         record = JobRecord(next(self._seq), job_id, file_id, priority,
                            recorder_events=self.recorder_events,
-                           worker_id=self.worker_id)
+                           worker_id=self.worker_id,
+                           tenant=tenant, ttl_seconds=ttl_seconds)
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
         record.event("received", priority=priority)
@@ -374,12 +426,30 @@ class JobRegistry:
         depth = 0
         oldest = 0.0
         now = time.monotonic()
+        for record in self._queued_records():
+            depth += 1
+            oldest = max(oldest, now - record._created_mono)
+        return depth, oldest
+
+    def _queued_records(self):
+        """Records accepted but not yet running — the ONE copy of the
+        queued predicate both :meth:`queued_snapshot` and
+        :meth:`tenant_queue_depths` apply (so the per-tenant gauges can
+        never desynchronize from the queue_depth they break down)."""
         for record in self._active.values():
             if record.state not in (RECEIVED, PARKED, ADMITTED):
                 continue
             if (record.state == PARKED and record.reason
                     and record.reason.startswith("fleet_lease_wait")):
                 continue
-            depth += 1
-            oldest = max(oldest, now - record._created_mono)
-        return depth, oldest
+            yield record
+
+    def tenant_queue_depths(self) -> Dict[str, int]:
+        """Queued-not-yet-running depth per tenant — the per-tenant
+        breakdown of :meth:`queued_snapshot`'s depth (same predicate by
+        construction), feeding the ``tenant_queue_depth`` gauges and
+        ``GET /v1/tenants``."""
+        out: Dict[str, int] = {}
+        for record in self._queued_records():
+            out[record.tenant] = out.get(record.tenant, 0) + 1
+        return out

@@ -41,6 +41,7 @@ import asyncio
 import itertools
 import json
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..platform import faults
@@ -209,9 +210,15 @@ class BucketCoordStore(CoordStore):
     async def _write_verified(self, key: str,
                               data: Optional[dict]) -> Optional[str]:
         """Write with a fresh nonce; token only when the read-back shows
-        OUR write survived (last-write-wins race detection)."""
+        OUR write survived (last-write-wins race detection).
+
+        ``at`` stamps the write time so the GC sweep (fleet/plane.py)
+        can age tombstones; readers ignore it (only data/token matter).
+        """
         token = self._nonce()
-        body = json.dumps({"data": data, "token": token}).encode("utf-8")
+        body = json.dumps({
+            "data": data, "token": token, "at": round(time.time(), 3),
+        }).encode("utf-8")
         try:
             await self._ensure_bucket()
             await self.store.put_object(self.bucket, self._object(key), body)
@@ -276,3 +283,62 @@ class BucketCoordStore(CoordStore):
             raise CoordError(f"coord list {prefix}: {err}") from err
         # tombstones still list here; callers resolve liveness via get()
         return sorted(out)
+
+    async def sweep_tombstones(self, max_age: float) -> int:
+        """Physically remove tombstones older than ``max_age`` seconds.
+
+        Deletes on this backend only tombstone (the ObjectStore interface
+        historically had no remove), so churning keys — every released
+        lease, every deregistered worker — accrete one object each under
+        the prefix forever.  Removing an aged tombstone is semantically
+        invisible: a tombstoned key already reads as absent, conditional
+        puts against its token already fail, and any CAS that could race
+        the removal expired with the lease/liveness TTLs long before
+        ``max_age``.  Tombstones written before age-stamping (no ``at``)
+        are treated as infinitely old.  Returns the number removed.
+        """
+        removed = 0
+        now = time.time()
+        for key in await self.list_keys(""):
+            obj = self._object(key)
+            try:
+                raw = await self.store.get_object(self.bucket, obj)
+            except ObjectNotFound:
+                continue  # already gone
+            except Exception as err:
+                raise CoordError(f"coord sweep {key}: {err}") from err
+            try:
+                doc = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue  # corrupt: repairable only by an operator put
+            if doc.get("data") is not None:
+                continue  # live document — never touch
+            try:
+                written_at = float(doc.get("at") or 0.0)
+            except (TypeError, ValueError):
+                written_at = 0.0
+            if now - written_at < max_age:
+                continue
+            try:
+                # re-read immediately before the delete: a fresh LIVE
+                # write can land at a churned key between the first read
+                # and here, and an unconditional remove would destroy
+                # it.  The re-read shrinks the window to sub-RTT — the
+                # same best-effort bound as this backend's conditional
+                # put, with damage bounded by the lease/liveness TTLs.
+                raw2 = await self.store.get_object(self.bucket, obj)
+                doc2 = json.loads(raw2.decode("utf-8"))
+                if (doc2.get("data") is not None
+                        or doc2.get("token") != doc.get("token")):
+                    continue  # revived or rewritten: leave it alone
+                await self.store.remove_object(self.bucket, obj)
+                removed += 1
+            except NotImplementedError:
+                return removed  # backend cannot delete: nothing to sweep
+            except (ValueError, UnicodeDecodeError):
+                continue  # rewritten to something unreadable: skip
+            except ObjectNotFound:
+                continue  # already gone
+            except Exception as err:
+                raise CoordError(f"coord sweep {key}: {err}") from err
+        return removed

@@ -71,6 +71,11 @@ DEFAULT_POLL_INTERVAL = 0.25
 # a waiter parked on a peer's lease gives up coordinating (and fetches
 # for itself) after this long — a livelock bound, not a hot-path knob
 DEFAULT_MAX_WAIT = 600.0
+# shared-tier / tombstone GC (the sweep keeping .fleet-cache/ and
+# .fleet/ growth bounded); interval 0 disables the loop entirely
+DEFAULT_GC_INTERVAL = 300.0
+DEFAULT_SHARED_MAX_AGE = 24 * 3600.0
+DEFAULT_SHARED_MAX_BYTES = 0  # 0 = no size budget (age bound only)
 
 # a lease is only treated as dead once expired by this fraction of the
 # TTL: lease math compares the WRITER's wall clock against the READER's,
@@ -125,6 +130,9 @@ class FleetPlane:
         lease_ttl: float = DEFAULT_LEASE_TTL,
         poll_interval: float = DEFAULT_POLL_INTERVAL,
         max_wait: float = DEFAULT_MAX_WAIT,
+        gc_interval: float = DEFAULT_GC_INTERVAL,
+        shared_max_age: float = DEFAULT_SHARED_MAX_AGE,
+        shared_max_bytes: int = DEFAULT_SHARED_MAX_BYTES,
         metrics=None,
         logger=None,
         retrier=None,
@@ -147,21 +155,35 @@ class FleetPlane:
         self.lease_ttl = float(lease_ttl)
         self.poll_interval = float(poll_interval)
         self.max_wait = float(max_wait)
+        self.gc_interval = float(gc_interval)
+        self.shared_max_age = float(shared_max_age)
+        self.shared_max_bytes = int(shared_max_bytes)
         self.metrics = metrics
         self.logger = logger
         self.retrier = retrier
         self.payload_fn = payload_fn
         self.started_at = time.time()
         self._heartbeat_task: Optional[asyncio.Task] = None
+        self._gc_task: Optional[asyncio.Task] = None
         self._worker_token: Optional[str] = None
         self._gauge_sampled_mono = 0.0
         self._held: Dict[str, _Lease] = {}
+        # shared-tier entries seen manifest-less on the previous sweep:
+        # two consecutive manifest-less sightings (>= gc_interval apart)
+        # mark a torn/abandoned spill safe to reclaim (listings carry no
+        # mtime, so "seen twice" is the age proxy)
+        self._gc_manifestless: set = set()
+        # manifest "created" stamps memoized across sweeps (immutable
+        # once published; pruned to the current listing each sweep)
+        self._gc_created: Dict[str, float] = {}
         # local stats, also carried in every heartbeat payload
         self.stats: Dict[str, int] = {
             "leasesLed": 0, "leaseWaits": 0, "leaseTakeovers": 0,
             "sharedHits": 0, "sharedFills": 0,
             "sharedBytesIn": 0, "sharedBytesOut": 0,
             "coordErrors": 0, "uncoordinatedFallbacks": 0,
+            "gcSharedEvicted": 0, "gcTombstonesCompacted": 0,
+            "gcBytesReclaimed": 0,
         }
 
     # -- config ---------------------------------------------------------
@@ -176,7 +198,10 @@ class FleetPlane:
         (``bucket`` default | ``memory``), ``fleet.heartbeat_interval``,
         ``fleet.liveness_ttl``, ``fleet.lease_ttl``,
         ``fleet.poll_interval``, ``fleet.max_wait``,
-        ``fleet.shared_tier`` (false keeps leases but skips the spill).
+        ``fleet.shared_tier`` (false keeps leases but skips the spill),
+        ``fleet.gc_interval`` (0 disables the GC sweep),
+        ``fleet.shared_max_age`` / ``fleet.shared_max_bytes`` (shared-
+        tier eviction bounds).
         """
         enabled = os.environ.get("FLEET_ENABLED")
         if enabled is None:
@@ -219,6 +244,13 @@ class FleetPlane:
                 config, "fleet.poll_interval", DEFAULT_POLL_INTERVAL)),
             max_wait=float(cfg_get(
                 config, "fleet.max_wait", DEFAULT_MAX_WAIT)),
+            gc_interval=float(cfg_get(
+                config, "fleet.gc_interval", DEFAULT_GC_INTERVAL)),
+            shared_max_age=float(cfg_get(
+                config, "fleet.shared_max_age", DEFAULT_SHARED_MAX_AGE)),
+            shared_max_bytes=int(cfg_get(
+                config, "fleet.shared_max_bytes",
+                DEFAULT_SHARED_MAX_BYTES)),
             metrics=metrics, logger=logger, retrier=retrier,
             payload_fn=payload_fn,
         )
@@ -300,7 +332,7 @@ class FleetPlane:
             await asyncio.sleep(self.heartbeat_interval)
 
     async def start(self) -> None:
-        """Register this worker and begin heartbeating."""
+        """Register this worker and begin heartbeating (+ GC sweeping)."""
         try:
             await self._beat_once()
         except asyncio.CancelledError:
@@ -311,10 +343,21 @@ class FleetPlane:
         self._heartbeat_task = asyncio.create_task(
             self._heartbeat_loop(), name=f"fleet-heartbeat-{self.worker_id}"
         )
+        if self.gc_interval > 0 and self.store is not None:
+            self._gc_task = asyncio.create_task(
+                self._gc_loop(), name=f"fleet-gc-{self.worker_id}"
+            )
 
     async def stop(self) -> None:
         """Deregister and release every held lease (clean drain: peers
         see this worker vanish immediately, not after liveness_ttl)."""
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            try:
+                await self._gc_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._gc_task = None
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             try:
@@ -604,6 +647,189 @@ class FleetPlane:
             self.logger.info("fleet: materialized shared-tier entry",
                              key=key[:16], bytes=got)
         return True
+
+    # -- shared-tier / tombstone GC -------------------------------------
+    async def _should_gc(self) -> bool:
+        """Elect one sweeper per interval: the OLDEST live worker.
+
+        Every worker running the identical global sweep would multiply
+        the same listing + per-key reads N times for no extra garbage
+        collected; the registry's liveness view is already a cheap,
+        crash-tolerant election (the oldest worker dying just hands the
+        sweep to the next-oldest within liveness_ttl).  Solo workers —
+        and workers that cannot read the registry at all — sweep: a
+        degraded registry must not also mean unbounded garbage.
+        """
+        try:
+            live = await self.workers()
+        except Exception:
+            return True
+        if not live:
+            return True
+        return live[0].get("workerId") == self.worker_id
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gc_interval)
+            try:
+                if await self._should_gc():
+                    await self.gc_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("gc", err)
+
+    async def _remove_entry(self, key: str, names_sizes) -> int:
+        """Evict one shared-tier entry: manifest FIRST (unpublish — a
+        reader mid-materialize already holds the file list and tolerates
+        missing objects as a failed fetch), then the payload objects.
+        Returns the bytes reclaimed."""
+        reclaimed = 0
+        manifest_name = self._shared_name(key)
+        ordered = sorted(names_sizes, key=lambda ns: ns[0] != manifest_name)
+        for name, size in ordered:
+            await self.store.remove_object(self.shared_bucket, name)
+            reclaimed += size
+        return reclaimed
+
+    async def gc_once(self) -> dict:
+        """One bounded sweep over the shared tier + coordination prefix.
+
+        - evicts ``.fleet-cache/<key>/`` entries whose manifest is older
+          than ``fleet.shared_max_age``, then (oldest first) until total
+          size fits ``fleet.shared_max_bytes`` (0 = age bound only);
+        - reclaims manifest-less entries (torn spills) seen on two
+          consecutive sweeps — listings carry no mtime, so "survived a
+          full gc_interval without a manifest" is the abandonment proxy;
+        - compacts aged ``.fleet/`` tombstones on the bucket coordination
+          backend (deletes there only tombstone, so churned lease/worker
+          keys otherwise accrete forever).
+
+        Never raises on store backends without delete support — the
+        sweep is then a no-op.  Entries under a live content lease —
+        this worker's or a peer's (a slow multi-GB spill is manifest-
+        less for its whole upload) — are skipped.
+        """
+        out = {"shared_evicted": 0, "bytes_reclaimed": 0, "tombstones": 0}
+        if self.store is not None:
+            try:
+                entries: Dict[str, list] = {}
+                async for info in self.store.list_objects(
+                        self.shared_bucket, self.shared_prefix):
+                    rest = info.name[len(self.shared_prefix):]
+                    key = rest.split("/", 1)[0]
+                    if key:
+                        entries.setdefault(key, []).append(
+                            (info.name, info.size))
+                # keys under a LIVE content lease are being re-fetched /
+                # re-published by some worker right now: never reclaim
+                # them mid-flight (the torn-spill heuristic especially —
+                # a peer's slow multi-GB spill is manifest-less for its
+                # whole upload).  Lease trouble degrades to "skip none":
+                # the age/size bounds still apply next sweep.
+                leased: set = set()
+                try:
+                    leased = {doc.get("key") for doc in await self.leases()
+                              if not doc.get("expired")}
+                except Exception:
+                    pass
+                now = time.time()
+                # manifest "created" stamps are immutable once published:
+                # remember them across sweeps so a steady-state sweep is
+                # one LIST + GETs only for newly-appeared keys
+                created_memo = self._gc_created
+                aged: "List[tuple[float, str]]" = []  # (created, key)
+                manifestless: set = set()
+                for key, names_sizes in entries.items():
+                    if key in self._held or key in leased:
+                        continue  # mid-publish (ours or a peer's)
+                    manifest_name = self._shared_name(key)
+                    if not any(n == manifest_name for n, _s in names_sizes):
+                        manifestless.add(key)
+                        continue
+                    created = created_memo.get(key)
+                    if created is None:
+                        try:
+                            manifest = _json_load(
+                                await self.store.get_object(
+                                    self.shared_bucket, manifest_name))
+                            created = float(manifest.get("created", 0.0))
+                        except (ValueError, KeyError, TypeError):
+                            created = 0.0  # CORRUPT manifest: ancient
+                        except Exception:
+                            # store trouble reading a healthy-looking
+                            # manifest must not read as "ancient" and
+                            # evict good bytes: skip it this sweep
+                            continue
+                        created_memo[key] = created
+                    aged.append((created, key))
+                # drop memo entries for keys no longer listed
+                self._gc_created = {k: v for k, v in created_memo.items()
+                                    if k in entries}
+                evict: List[str] = []
+                kept: List[tuple] = []
+                for created, key in sorted(aged):
+                    if (self.shared_max_age > 0
+                            and now - created >= self.shared_max_age):
+                        evict.append(key)
+                    else:
+                        kept.append((created, key))
+                if self.shared_max_bytes > 0:
+                    total = sum(
+                        sum(s for _n, s in entries[key])
+                        for _c, key in kept
+                    )
+                    for _created, key in kept:  # oldest first
+                        if total <= self.shared_max_bytes:
+                            break
+                        evict.append(key)
+                        total -= sum(s for _n, s in entries[key])
+                # torn spills: reclaim only on the second consecutive
+                # manifest-less sighting
+                evict.extend(k for k in manifestless
+                             if k in self._gc_manifestless)
+                self._gc_manifestless = manifestless
+                for key in evict:
+                    try:
+                        reclaimed = await self._remove_entry(
+                            key, entries[key])
+                    except NotImplementedError:
+                        break  # backend cannot delete: GC is a no-op
+                    out["shared_evicted"] += 1
+                    out["bytes_reclaimed"] += reclaimed
+                    if self.logger is not None:
+                        self.logger.info("fleet gc: evicted shared entry",
+                                         key=key[:16], bytes=reclaimed)
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("gc_shared", err)
+        sweep = getattr(self.coord, "sweep_tombstones", None)
+        if sweep is not None:
+            # a tombstone is compactable once every CAS that could have
+            # referenced its token has aged out with the lease/liveness
+            # TTLs; 4x the larger one is comfortably past any skew grace
+            try:
+                out["tombstones"] = await sweep(
+                    max(self.lease_ttl, self.liveness_ttl) * 4
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("gc_tombstones", err)
+        self.stats["gcSharedEvicted"] += out["shared_evicted"]
+        self.stats["gcBytesReclaimed"] += out["bytes_reclaimed"]
+        self.stats["gcTombstonesCompacted"] += out["tombstones"]
+        if self.metrics is not None:
+            if out["shared_evicted"]:
+                self.metrics.fleet_gc_removed.labels(
+                    kind="shared_entry").inc(out["shared_evicted"])
+            if out["tombstones"]:
+                self.metrics.fleet_gc_removed.labels(
+                    kind="tombstone").inc(out["tombstones"])
+            if out["bytes_reclaimed"]:
+                self.metrics.fleet_gc_bytes.inc(out["bytes_reclaimed"])
+        return out
 
     # -- the cross-worker singleflight protocol -------------------------
     async def coordinate(self, key: str, cache, origin_fill, *,

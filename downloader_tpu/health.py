@@ -99,6 +99,15 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
         payload = {"status": "ready",
                    "active": len(orchestrator.active_jobs),
                    "breakers": states}
+        # overload controller (control/overload.py): a saturated worker
+        # is still READY — HIGH/NORMAL flow, only BULK is shed — but the
+        # posture is surfaced so routing layers can prefer idle peers
+        overload = getattr(orchestrator, "overload", None)
+        if overload is not None and overload.saturated:
+            payload["overload"] = {
+                "saturated": True,
+                "reasons": list(overload.reasons),
+            }
         # fleet plane: identity + liveness posture, without awaiting the
         # coordination store (readiness probes must stay cheap — the
         # full membership view lives on GET /v1/fleet)

@@ -30,14 +30,17 @@ import os
 import shutil
 import time
 import uuid
+from datetime import datetime, timezone
 from typing import Dict, List, Optional
 
 from . import control, schemas
 from .control.cancel import CancelToken, JobCancelled
 from .control.registry import JobRecord, JobRegistry
+from .control.overload import OverloadController
 from .control.scheduler import (PriorityScheduler, RunSlot,
                                 aging_from_config, backlog_from_config,
                                 priority_name, priority_rank)
+from .control.tenancy import TenantTable
 from .fleet.plane import FleetPlane, resolve_worker_id
 from .mq.base import Delivery, MessageQueue
 from .platform import faults
@@ -61,6 +64,29 @@ from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
 from .store.cache import ContentCache
 from .utils import EventEmitter, utcnow_iso as _utcnow_iso
+
+
+def _submission_age_seconds(created_at: str) -> float:
+    """Seconds since the submitter stamped ``Download.created_at``.
+
+    Anchors ``ttl_seconds`` to the SUBMISSION, not this delivery's
+    receipt: a shed/parked/nacked BULK job keeps the same created_at on
+    every redelivery, so its deadline genuinely elapses instead of
+    resetting each cycle.  Absent/unparseable stamps (and clock skew
+    that would make the age negative) anchor at receipt — the
+    conservative pre-anchoring behavior.
+    """
+    if not created_at:
+        return 0.0
+    try:
+        stamp = datetime.fromisoformat(created_at.replace("Z", "+00:00"))
+        if stamp.tzinfo is None:
+            stamp = stamp.replace(tzinfo=timezone.utc)
+        return max(
+            (datetime.now(timezone.utc) - stamp).total_seconds(), 0.0
+        )
+    except ValueError:
+        return 0.0
 
 
 class _RecordingTelemetry:
@@ -183,8 +209,17 @@ class Orchestrator:
                 config, "obs.profile_interval", DEFAULT_PROFILE_INTERVAL
             )),
         )
+        # multi-tenant overload control (control/tenancy.py +
+        # control/overload.py): the tenant table resolves
+        # ``Download.tenant`` and holds per-tenant weights / concurrency
+        # caps / byte quotas; the scheduler apportions run slots across
+        # tenants by weighted-fair stride within each priority class.
+        # With no ``tenants`` config every delivery is the "default"
+        # tenant and the scheduler behaves exactly as before.
+        self.tenants = TenantTable(config, logger=self.logger)
         self.scheduler = PriorityScheduler(
-            prefetch, aging_seconds=aging_from_config(config)
+            prefetch, aging_seconds=aging_from_config(config),
+            tenants=self.tenants,
         )
         self.consumer_prefetch = prefetch + backlog_from_config(config)
         # intake pause (POST /v1/intake/pause | /v1/drain): stop pulling
@@ -264,10 +299,25 @@ class Orchestrator:
             self.fleet.payload_fn = self.autoscale_signals
         self.stage_resources["fleet_plane"] = self.fleet
         self.stage_resources["job_registry"] = self.registry
+        # the stages stack each job's per-tenant byte quota under the
+        # service-wide rate limiter through this shared table
+        self.stage_resources["tenant_table"] = self.tenants
+        # saturation-aware shedding (control/overload.py): samples the
+        # autoscale trio + event-loop lag; while saturated, BULK
+        # deliveries are parked+nacked at admission (never FAILED, never
+        # charged poison) so HIGH/NORMAL time-to-staged survives the
+        # worker's own overload.  ``overload.enabled: false`` removes it.
+        self.overload = OverloadController.from_config(
+            config, self.autoscale_signals,
+            lambda: getattr(self.loop_monitor, "last_lag", None),
+            metrics=metrics, logger=self.logger,
+        )
         # autoscale signal trio on /metrics: the same snapshot the fleet
         # heartbeat carries (ROADMAP item 5's fleet-facing contract)
         if metrics is not None:
             metrics.bind_autoscale(self.autoscale_signals)
+            metrics.bind_tenants(self.tenants.names(),
+                                 self.registry.tenant_queue_depths)
         # the dependencies whose open breaker pauses intake: everything a
         # job needs to SETTLE (staging writes + convert publish) — origin
         # fetch trouble stays per-job (a broken origin is one job's
@@ -320,6 +370,8 @@ class Orchestrator:
         self.consuming = True
         self.loop_monitor.start()
         self.profiler.start()
+        if self.overload is not None:
+            self.overload.start()
         if self.fleet is not None:
             # join the fleet LAST: by the time peers can route around or
             # toward this worker, it is actually consuming
@@ -427,6 +479,8 @@ class Orchestrator:
             )
         await self.profiler.stop()
         await self.loop_monitor.stop()
+        if self.overload is not None:
+            await self.overload.stop()
         if self.fleet is not None:
             # leave the fleet before the backends close: deregistration
             # and lease release still have a live store to write to
@@ -464,6 +518,12 @@ class Orchestrator:
         file_id = msg.media.creator_id  # (reference lib/main.js:64)
         job_id = msg.media.id           # (reference lib/main.js:65)
         priority = priority_name(msg.priority)
+        # tenant identity (control/tenancy.py): absent/empty and
+        # unconfigured names both resolve to "default" (the
+        # unknown-priority -> NORMAL posture), so old producers and
+        # un-onboarded submitters get exactly the pre-tenancy behavior
+        tenant = self.tenants.resolve(getattr(msg, "tenant", ""))
+        ttl_seconds = float(getattr(msg, "ttl_seconds", 0.0) or 0.0)
 
         if self.metrics is not None:
             self.metrics.jobs_consumed.inc()
@@ -481,13 +541,26 @@ class Orchestrator:
         span_id = uuid.uuid4().hex[:16]
         child = self.logger.child(jobId=job_id, fileId=file_id,
                                   traceId=trace_id, spanId=span_id)
+        if tenant != "default":
+            # the tenant joins the job's log context only when one is
+            # actually named — single-tenant log streams stay unchanged
+            child = child.child(tenant=tenant)
 
         # registered + counted from RECEIPT: a job waiting in admission
         # or the priority queue is visible to /health, GET /v1/jobs,
         # drain, and shutdown (pre-control-plane blind spot).  All
         # bookkeeping after this point is undone in the finally, so a
         # failure anywhere can't leak the gauge or the active-jobs entry.
-        record = self.registry.register(job_id, file_id, priority=priority)
+        record = self.registry.register(job_id, file_id, priority=priority,
+                                        tenant=tenant,
+                                        ttl_seconds=ttl_seconds)
+        if record.deadline_mono is not None:
+            # the TTL ran from SUBMISSION: shift the cutoff back by the
+            # age the message already has, so redeliveries (which carry
+            # the same created_at) cannot reset the clock
+            record.deadline_mono -= _submission_age_seconds(
+                getattr(msg, "created_at", "")
+            )
         record.trace_id = trace_id
         record.span_id = span_id
         record.event("delivered", redelivered=delivery.redelivered)
@@ -506,10 +579,28 @@ class Orchestrator:
         # queued job must not wait behind a parked one), the fleet
         # plane's lease waiters release-and-reacquire around their
         # park, and the finally below must not double-release
-        slot = RunSlot(self.scheduler, priority_rank(priority))
+        slot = RunSlot(self.scheduler, priority_rank(priority),
+                       tenant=tenant)
         release_slot = slot.release
 
         try:
+            # saturation shedding (control/overload.py): while this
+            # worker is saturated, BULK deliveries bounce at admission —
+            # parked briefly then nacked (never FAILED permanently,
+            # never charged poison), so the backlog waits out the
+            # pressure or lands on a healthier fleet peer
+            if self.overload is not None:
+                shed_reason = self.overload.should_shed(priority)
+                if shed_reason is not None:
+                    await self._shed_delivery(delivery, child, record,
+                                              token, shed_reason)
+                    return
+            # submitter deadline (Download.ttl_seconds): a redelivered
+            # BULK job that already outlived its TTL is dropped before
+            # it consumes anything
+            if await self._enforce_deadline(delivery, child, record,
+                                            where="receipt"):
+                return
             # dependency breakers gate intake BEFORE admission: when the
             # staging store or convert publish is hard-down (breaker
             # open), starting the job would only burn its poison budget
@@ -557,6 +648,12 @@ class Orchestrator:
             record.event("sched_wait", seconds=round(sched_wait, 6))
             if self.metrics is not None:
                 self.metrics.scheduler_wait_seconds.observe(sched_wait)
+            # deadline re-check now that the full queue + scheduler wait
+            # is known: expired BULK drops (EXPIRED), expired HIGH/NORMAL
+            # is surfaced (event + warn log) but still runs
+            if await self._enforce_deadline(delivery, child, record,
+                                            where="slot_granted"):
+                return
             # set DOWNLOADING status (reference lib/main.js:68) — only
             # once the job actually holds a run slot: a job parked in
             # admission or the priority queue must not tell telemetry
@@ -594,6 +691,12 @@ class Orchestrator:
                 # MQ layer requeues the delivery; close this record
                 self.registry.transition(record, control.FAILED,
                                          reason="handler_exit")
+            if self.metrics is not None:
+                # per-tenant outcome slice (label set bounded: resolved
+                # tenants x lifecycle states)
+                self.metrics.tenant_jobs.labels(
+                    tenant=record.tenant, outcome=record.state
+                ).inc()
 
     async def _settle_cancelled(self, msg: schemas.Download,
                                 delivery: Delivery, logger: Logger,
@@ -714,13 +817,99 @@ class Orchestrator:
         record.retry = retry_info
         record.event("park", why=reason, delay_s=round(delay, 3))
         if self.metrics is not None:
-            label = "breaker" if reason.startswith("breaker") else "backoff"
+            if reason.startswith("breaker"):
+                label = "breaker"
+            elif reason.startswith("overload"):
+                label = "overload"
+            else:
+                label = "backoff"
             self.metrics.jobs_parked.labels(reason=label).inc()
         self.registry.transition(
             record, control.PARKED,
             reason=f"{reason}: redeliver in {delay:.2f}s",
         )
         await token.guard(asyncio.sleep(delay))
+
+    async def _shed_delivery(self, delivery: Delivery, logger: Logger,
+                             record: JobRecord, token: CancelToken,
+                             reason: str) -> None:
+        """Bounce one BULK delivery while the worker is saturated.
+
+        PR 5's park-then-nack discipline, applied to OUR overload
+        instead of a dependency's: the unsettled delivery parks for
+        ``overload.shed_backoff`` (so the redelivery arrives after the
+        pressure sample window, not into it), then nacks for
+        redelivery.  The poison counter is NOT advanced — nothing about
+        the job failed — and the record closes FAILED with an
+        ``overload_shed`` reason, mirroring the breaker-open settle.
+        """
+        logger.warn("shedding BULK delivery: worker saturated",
+                    reason=reason, tenant=record.tenant)
+        record.event("shed", why="overload", reason=reason)
+        if self.metrics is not None:
+            self.metrics.jobs_shed.labels(
+                reason=reason, tenant=record.tenant
+            ).inc()
+        await self._park(record, token, self.overload.shed_backoff, None,
+                         reason=f"overload_shed:{reason}")
+        record.retry = None
+        record.event("settle", mode="nack", why="overload_shed",
+                     reason=reason)
+        await delivery.nack()
+        self.registry.transition(
+            record, control.FAILED, reason=f"overload_shed: {reason}"
+        )
+
+    async def _enforce_deadline(self, delivery: Delivery, logger: Logger,
+                                record: JobRecord, where: str) -> bool:
+        """Honor ``Download.ttl_seconds`` at an admission checkpoint.
+
+        Returns True when the delivery was settled here (expired BULK:
+        acked + EXPIRED — re-running queue-aged bulk work would burn the
+        very capacity the TTL protects).  Expired HIGH/NORMAL work is
+        *surfaced* — warn log + ``deadline_exceeded`` event at the
+        ``slot_granted`` checkpoint, where the full queueing delay is
+        known — but still runs: a user-facing job is never silently
+        dropped.
+        """
+        if not record.deadline_expired():
+            return False
+        overdue = -(record.deadline_remaining() or 0.0)
+        if record.priority != "BULK":
+            if where == "slot_granted":
+                logger.warn("job deadline exceeded; running anyway "
+                            "(non-BULK work is never dropped)",
+                            ttlSeconds=record.ttl_seconds,
+                            overdueSeconds=round(overdue, 3))
+                record.event("deadline_exceeded",
+                             overdue_s=round(overdue, 3), where=where)
+            return False
+        logger.warn("dropping deadline-expired BULK job",
+                    ttlSeconds=record.ttl_seconds,
+                    overdueSeconds=round(overdue, 3), where=where)
+        if self.metrics is not None:
+            self.metrics.jobs_shed.labels(
+                reason="deadline", tenant=record.tenant
+            ).inc()
+        # telemetry consumers learn the drop (ERRORED — the same
+        # terminal signal the other deliberate drops emit; EXPIRED has
+        # no wire enum and legacy consumers only know the reference's
+        # range).  Best-effort: a telemetry blip must not block settling.
+        try:
+            await self.telemetry.emit_status(
+                record.job_id, schemas.TelemetryStatus.Value("ERRORED")
+            )
+        except Exception as err:
+            logger.warn("expired-job status emit failed", error=str(err))
+        record.event("settle", mode="ack", why="deadline",
+                     overdue_s=round(overdue, 3), where=where)
+        await delivery.ack()
+        self._failure_counts.pop(record.job_id, None)
+        self.registry.transition(
+            record, control.EXPIRED,
+            reason=f"deadline: ttl {record.ttl_seconds:g}s exceeded",
+        )
+        return True
 
     async def _settle_failed_attempt(
         self,

@@ -79,6 +79,40 @@ DEFAULTS: dict = {
     #       # chaos drills (platform/faults.py; env FAULT_PLAN) — see
     #       # docs/OPERATIONS.md "Failure model"
     #
+    # Multi-tenant overload control (control/tenancy.py +
+    # control/overload.py; docs/OPERATIONS.md "Tenancy & overload"):
+    # "tenants": {
+    #   "<name>": {
+    #     "weight": 1,             # weighted-fair share of run slots
+    #         # WITHIN each priority class (stride scheduling; priority
+    #         # and aging still dominate)
+    #     "max_concurrent": None,  # run slots this tenant may hold at
+    #         # once (None = unbounded)
+    #     "download_rate_limit": 0,  # per-tenant ingress bytes/s,
+    #         # stacked UNDER instance.download_rate_limit
+    #     "upload_rate_limit": 0,    # per-tenant egress bytes/s
+    #   },
+    # },
+    # # Absent/unknown Download.tenant runs as "default" (the
+    # # unknown-priority -> NORMAL posture); no "tenants" section = the
+    # # exact pre-tenancy behavior.
+    # "overload": {
+    #   "enabled": True,           # false removes the controller
+    #   "interval": 1.0,           # pressure sampling cadence, seconds
+    #   "sustain": 3,              # consecutive breached samples before
+    #       # the worker is declared saturated (and after: one healthy
+    #       # sample clears)
+    #   "max_loop_lag": 1.5,       # seconds of event-loop lag; 0 off
+    #   "min_headroom_bytes": 0,   # shed when disk headroom drops below
+    #   "max_queue_depth": 0,      # shed when more jobs are queued
+    #   "max_oldest_seconds": 0,   # shed when the oldest queued job ages
+    #   "shed_backoff": 5.0,       # park before the shed nack, seconds
+    # },
+    # # While saturated, BULK deliveries are parked+nacked (never FAILED
+    # # permanently, no poison charge); HIGH/NORMAL keep flowing.
+    # # Download.ttl_seconds (deadline from receipt): expired BULK is
+    # # dropped as EXPIRED, expired HIGH/NORMAL is surfaced but runs.
+    #
     # Fleet coordination plane (fleet/): disabled by default — a lone
     # worker pays nothing.  See docs/ARCHITECTURE.md "Fleet plane".
     # "fleet": {
@@ -97,6 +131,13 @@ DEFAULTS: dict = {
     #       # uncoordinated fallback fetch
     #   "shared_tier": True,         # spill cache fills to the staging
     #       # bucket (.fleet-cache/<key>/) for peers to materialize
+    #   "gc_interval": 300.0,        # shared-tier + tombstone GC sweep
+    #       # cadence (0 disables); bounds .fleet-cache/ and .fleet/
+    #       # growth on the bucket backend
+    #   "shared_max_age": 86400.0,   # evict shared-tier entries older
+    #       # than this (manifest age), seconds
+    #   "shared_max_bytes": 0,       # shared-tier size budget (oldest
+    #       # evicted first; 0 = age bound only)
     # },
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),

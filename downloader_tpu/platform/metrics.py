@@ -270,6 +270,50 @@ class Metrics:
             ["op"],
             registry=self.registry,
         )
+        self.fleet_gc_removed = Counter(
+            f"{ns}_fleet_gc_removed_total",
+            "Objects reclaimed by the fleet GC sweep, by kind "
+            "(shared_entry = an evicted .fleet-cache/ entry, tombstone = "
+            "a compacted .fleet/ coordination tombstone)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.fleet_gc_bytes = Counter(
+            f"{ns}_fleet_gc_reclaimed_bytes_total",
+            "Bytes reclaimed from the fleet shared cache tier by the GC "
+            "sweep",
+            registry=self.registry,
+        )
+        # -- multi-tenant overload control (control/tenancy+overload) --
+        self.jobs_shed = Counter(
+            f"{ns}_jobs_shed_total",
+            "Deliveries shed by the overload layer, by reason (loop_lag/"
+            "disk_headroom/queue_depth/queue_age = saturation park+nack; "
+            "deadline = TTL-expired BULK dropped as EXPIRED) and tenant",
+            ["reason", "tenant"],
+            registry=self.registry,
+        )
+        self.tenant_jobs = Counter(
+            f"{ns}_tenant_jobs_total",
+            "Settled deliveries per tenant, by terminal lifecycle state "
+            "(the per-tenant slice of the job outcome counters)",
+            ["tenant", "outcome"],
+            registry=self.registry,
+        )
+        self.tenant_queue_depth = Gauge(
+            f"{ns}_tenant_queue_depth",
+            "Jobs accepted but not yet running, per tenant (the "
+            "per-tenant breakdown of queue_depth; label set bounded by "
+            "the configured tenants)",
+            ["tenant"],
+            registry=self.registry,
+        )
+        self.overload_saturated = Gauge(
+            f"{ns}_overload_saturated",
+            "1 while the overload controller considers this worker "
+            "saturated (BULK work is being shed), else 0",
+            registry=self.registry,
+        )
         # -- autoscale signal trio (ROADMAP item 5's fleet contract) --
         self.queue_depth = Gauge(
             f"{ns}_queue_depth",
@@ -351,6 +395,29 @@ class Metrics:
             lambda: float(_snapshot()["oldest_queued_seconds"]))
         self.cache_headroom_bytes.set_function(
             lambda: float(_snapshot()["cache_headroom_bytes"]))
+
+    def bind_tenants(self, names, depths_fn) -> None:
+        """Wire the per-tenant queue-depth gauges to a live snapshot.
+
+        ``names`` is the config-bounded tenant set (so the label
+        cardinality is fixed at bind time); ``depths_fn`` returns
+        ``{tenant: queued_depth}`` (``JobRegistry.tenant_queue_depths``).
+        One memoized snapshot serves every label per scrape, mirroring
+        :meth:`bind_autoscale`.
+        """
+        memo = {"at": 0.0, "snap": None}
+
+        def _snapshot() -> dict:
+            now = time.monotonic()
+            if memo["snap"] is None or now - memo["at"] > 0.5:
+                memo["snap"] = depths_fn()
+                memo["at"] = now
+            return memo["snap"]
+
+        for name in names:
+            self.tenant_queue_depth.labels(tenant=name).set_function(
+                lambda n=name: float(_snapshot().get(n, 0))
+            )
 
     def render(self) -> bytes:
         """Prometheus text exposition of the registry."""

@@ -296,6 +296,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     from ..utils.ratelimit import shared_bucket
 
     limiter = shared_bucket(ctx.resources, ctx.config, "download_rate_limit")
+    # per-tenant ingress quota (control/tenancy.py): when the job's
+    # tenant carries a download_rate_limit, it stacks UNDER the service
+    # cap (the transfer pays both buckets); no tenant table / no quota =
+    # the service limiter unchanged
+    from ..control.tenancy import stage_limiter
+
+    limiter = stage_limiter(ctx, "ingress", limiter)
 
     # dependency fault tolerance (platform/errors.py): origin fetches
     # ride the "http" retry policy (transient network errors/5xx back

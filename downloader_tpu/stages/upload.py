@@ -109,6 +109,11 @@ class Uploader:
 
         self.limiter = shared_bucket(ctx.resources, ctx.config,
                                      "upload_rate_limit")
+        # per-tenant egress quota (control/tenancy.py), stacked under
+        # the service cap exactly like the download stage's ingress side
+        from ..control.tenancy import stage_limiter
+
+        self.limiter = stage_limiter(ctx, "egress", self.limiter)
         # dependency fault tolerance (platform/errors.py): staging-store
         # calls ride the service's shared retry executor + "store"
         # circuit breaker (the orchestrator injects its instance via

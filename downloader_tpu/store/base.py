@@ -79,6 +79,20 @@ class ObjectStore(abc.ABC):
         """Iterate objects under ``prefix`` (reference ``getObjects``,
         lib/download.js:217)."""
 
+    async def remove_object(self, bucket: str, name: str) -> None:
+        """Delete one object; idempotent (a missing object is success).
+
+        Added for the fleet GC sweep (fleet/plane.py): evicting aged
+        ``.fleet-cache/`` entries and compacting ``.fleet/`` tombstones
+        needs real deletion.  Kept OUT of the pipeline's staging path —
+        staged media is never deleted by this service.  Backends that
+        cannot delete raise NotImplementedError and the GC degrades to a
+        no-op (bounded by that backend's own lifecycle policies).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support remove_object"
+        )
+
     async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
         """Metadata for one object; raises :class:`ObjectNotFound`.
 

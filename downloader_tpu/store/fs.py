@@ -217,6 +217,29 @@ class FilesystemObjectStore(ObjectStore):
             raise ObjectNotFound(bucket, name) from None
         return ObjectInfo(name=name, size=size, etag=etag)
 
+    async def remove_object(self, bucket: str, name: str) -> None:
+        path = self._object_path(bucket, name)
+
+        def _remove() -> None:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                return
+            except OSError:
+                raise
+            # prune now-empty parent dirs up to (not including) the
+            # bucket root, so evicted prefix trees don't leave husks
+            parent = os.path.dirname(path)
+            stop = self._bucket_path(bucket)
+            while parent != stop and os.path.isdir(parent):
+                try:
+                    os.rmdir(parent)
+                except OSError:
+                    break  # not empty (or racing): done pruning
+                parent = os.path.dirname(parent)
+
+        await asyncio.to_thread(_remove)
+
 
 def _stat_with_md5(path: str) -> tuple:
     from ..utils.hashing import md5_file_hex

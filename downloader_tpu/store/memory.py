@@ -61,6 +61,10 @@ class InMemoryObjectStore(ObjectStore):
             name=name, size=len(data), etag=hashlib.md5(data).hexdigest()
         )
 
+    async def remove_object(self, bucket: str, name: str) -> None:
+        async with self._lock:
+            self._buckets.get(bucket, {}).pop(name, None)
+
 
 def _write_file(path: str, data: bytes) -> None:
     with open(path, "wb") as fh:

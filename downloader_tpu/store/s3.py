@@ -267,6 +267,16 @@ class S3ObjectStore(ObjectStore):
         if resp.status not in (200, 204):
             raise _status_error("put_object", resp.status, body)
 
+    async def remove_object(self, bucket: str, name: str) -> None:
+        resp = await self._request(
+            "DELETE", self._object_path(bucket, name)
+        )
+        body = await resp.read()
+        # S3 DELETE is idempotent: 204 whether or not the key existed;
+        # tolerate an explicit 404 from stricter fakes
+        if resp.status not in (200, 204, 404):
+            raise _status_error("remove_object", resp.status, body)
+
     async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
         """Streaming GET straight to disk — media files can be tens of GB,
         so the body must never be buffered whole in memory."""

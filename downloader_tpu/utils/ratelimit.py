@@ -48,6 +48,33 @@ class TokenBucket:
             await asyncio.sleep(deficit / self.rate)
 
 
+class ChainedLimiter:
+    """Serial composition of token buckets: a transfer must clear EVERY
+    bucket in the chain, so the effective rate is the minimum of the
+    chained caps.  Used to stack a per-tenant byte quota
+    (control/tenancy.py) on top of the per-service limiter without the
+    stages knowing which (if either) is configured.
+    """
+
+    def __init__(self, *buckets: Optional[TokenBucket]):
+        self.buckets = [b for b in buckets if b is not None]
+
+    async def consume(self, n: int) -> None:
+        for bucket in self.buckets:
+            await bucket.consume(n)
+
+
+def chain_limiters(*buckets) -> Optional[object]:
+    """Compose limiters, eliding absent ones: None when nothing is
+    configured, the single bucket when only one is, else a chain."""
+    live = [b for b in buckets if b is not None]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+    return ChainedLimiter(*live)
+
+
 def bucket_from_config(config, key: str) -> Optional[TokenBucket]:
     """Build a bucket from ``config.instance.<key>`` (bytes/s; absent,
     empty, or 0 disables limiting).

@@ -222,6 +222,11 @@ class MiniS3:
             # single PUT overwrites any earlier multipart identity
             self.etags.get(bucket, {}).pop(key, None)
             return web.Response(status=200)
+        if request.method == "DELETE":
+            # object delete (fleet GC): idempotent 204, like real S3
+            self.buckets.get(bucket, {}).pop(key, None)
+            self.etags.get(bucket, {}).pop(key, None)
+            return web.Response(status=204)
         if request.method in ("GET", "HEAD"):
             data = self.buckets.get(bucket, {}).get(key)
             if data is None:

@@ -587,3 +587,82 @@ async def test_breaker_open_rejects_without_calling_and_skips_poison_count():
         return "fine"
     assert await retrier.run("store.put", ok) == "fine"
     assert breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Overload chaos (ISSUE 7 acceptance): injected disk-headroom + loop-lag
+# pressure sheds BULK with attribution, never touches HIGH
+# ---------------------------------------------------------------------------
+
+async def test_overload_pressure_sheds_bulk_never_high(
+        tmp_path, http_server):
+    """Under sustained saturation (loop-lag + disk-headroom thresholds
+    breached), BULK deliveries are parked+nacked with
+    ``jobs_shed_total{reason,tenant}`` attribution while HIGH jobs —
+    including one from an unknown tenant, which runs as "default" —
+    complete; zero HIGH records ever reach FAILED/DROPPED_POISON; and
+    once the pressure clears, every shed BULK job completes too (the
+    shed is never a permanent FAIL)."""
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads"),
+                     "max_concurrent_jobs": 2,
+                     # wide prefetch: the shed/nack churn at the queue
+                     # head must not starve the HIGH deliveries behind
+                     # it of a spot in the consumer window
+                     "scheduler_backlog": 8},
+        "obs": {"loop_lag_interval": 0.01},
+        # pressure by threshold injection: ANY loop-lag sample breaches
+        # 1e-9s, and no real volume has 1 EiB of headroom — both axes
+        # of the saturation predicate trip deterministically
+        "overload": {"interval": 0.02, "sustain": 2,
+                     "max_loop_lag": 1e-9,
+                     "min_headroom_bytes": 10**18,
+                     "shed_backoff": 0.02},
+    })
+    orchestrator = await make_orchestrator(tmp_path, broker, store,
+                                           config=config)
+    try:
+        await wait_for(lambda: orchestrator.overload.saturated)
+        assert set(orchestrator.overload.reasons) >= {"loop_lag"}
+        for i in range(3):
+            broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(
+                http_server, job_id=f"bulk-{i}", priority="BULK"))
+        broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(
+            http_server, job_id="high-0", priority="HIGH"))
+        ghost = schemas.Download()
+        ghost.ParseFromString(make_download_msg(
+            http_server, job_id="high-ghost", priority="HIGH"))
+        ghost.tenant = "ghost"  # unknown tenant: degrades to "default"
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(ghost))
+
+        # HIGH completes while the worker sheds BULK around it
+        await wait_for(lambda: all(
+            (r := orchestrator.registry.get(jid)) is not None
+            and r.state == "DONE"
+            for jid in ("high-0", "high-ghost")))
+        assert orchestrator.registry.get("high-ghost").tenant == "default"
+        text = orchestrator.metrics.render().decode()
+        assert 'jobs_shed_total{reason="loop_lag",tenant="default"}' in text
+
+        # pressure clears -> every shed BULK job completes on redelivery
+        orchestrator.overload.max_loop_lag = 0
+        orchestrator.overload.min_headroom_bytes = 0
+        await wait_for(lambda: not orchestrator.overload.saturated)
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        for i in range(3):
+            assert orchestrator.registry.get(f"bulk-{i}").state == "DONE"
+
+        # the hard acceptance line: no HIGH record ever closed
+        # FAILED/DROPPED_POISON
+        for record in orchestrator.registry.jobs():
+            if record.priority == "HIGH":
+                assert record.state not in ("FAILED", "DROPPED_POISON")
+        # ... and the sheds are visible as overload parks too
+        shed_records = [r for r in orchestrator.registry.jobs()
+                        if r.reason and r.reason.startswith("overload_shed")]
+        assert shed_records and all(r.priority == "BULK"
+                                    for r in shed_records)
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)

@@ -151,6 +151,99 @@ async def test_shared_tier_spill_and_peer_materialize(tmp_path):
     assert plane_b.stats["sharedHits"] == 1
 
 
+async def _shared_tier_bytes(store):
+    total, names = 0, []
+    async for info in store.list_objects(STAGING_BUCKET, ".fleet-cache/"):
+        total += info.size
+        names.append(info.name)
+    return total, names
+
+
+async def test_gc_bounds_shared_tier_growth(tmp_path):
+    """ISSUE 7 satellite: repeated spills stay within the size budget —
+    the sweep evicts oldest-first until the tier fits, manifest removed
+    before payload (a torn GC leaves an invisible, reclaimable husk)."""
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    cache = ContentCache(str(tmp_path / "cache"))
+    plane = FleetPlane(
+        MemoryCoordStore(), "w", store=store,
+        shared_max_bytes=3 * len(PAYLOAD), shared_max_age=3600,
+        metrics=prom.new(f"gc{os.urandom(3).hex()}"),
+    )
+    for i in range(8):
+        key = cache_key("http", f"http://x/m{i}.mkv", f'"e{i}"')
+        src = tmp_path / f"src-{i}"  # one file per entry
+        src.mkdir()
+        (src / f"m{i}.mkv").write_bytes(PAYLOAD)
+        await cache.insert(key, str(src))
+        assert await plane.publish_entry(key, cache)
+        await plane.gc_once()
+        total, _names = await _shared_tier_bytes(store)
+        # bounded: never more than the budget (worst case the newest
+        # spill pushes it to exactly the budget before the next sweep)
+        assert total <= 3 * len(PAYLOAD) + 4096  # + manifest overhead
+    assert plane.stats["gcSharedEvicted"] >= 5
+    assert plane.stats["gcBytesReclaimed"] >= 5 * len(PAYLOAD)
+    text = plane.metrics.render().decode()
+    assert 'fleet_gc_removed_total{kind="shared_entry"}' in text
+    assert "fleet_gc_reclaimed_bytes_total" in text
+    # surviving entries still materialize (the sweep never tears one)
+    survivors = [n for _t, n in [await _shared_tier_bytes(store)]][0]
+    manifests = [n for n in survivors if n.endswith("manifest.json")]
+    assert manifests, "budget must keep at least the newest entries"
+
+
+async def test_gc_evicts_aged_entries_and_torn_spills(tmp_path):
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    cache = ContentCache(str(tmp_path / "cache"))
+    plane = FleetPlane(MemoryCoordStore(), "w", store=store,
+                       shared_max_age=0.05)
+    key = cache_key("http", "http://x/old.mkv", '"old"')
+    await cache.insert(key, _fill_src(tmp_path, name="old.mkv"))
+    assert await plane.publish_entry(key, cache)
+    # a manifest-less husk (torn spill): payload object, no manifest
+    await store.put_object(
+        STAGING_BUCKET, ".fleet-cache/tornkey/files/x.bin", b"x" * 128
+    )
+    await asyncio.sleep(0.08)  # age past shared_max_age
+    out1 = await plane.gc_once()
+    assert out1["shared_evicted"] == 1  # aged entry went; husk only noted
+    _total, names = await _shared_tier_bytes(store)
+    assert names == [".fleet-cache/tornkey/files/x.bin"]
+    out2 = await plane.gc_once()  # second consecutive sighting: reclaim
+    assert out2["shared_evicted"] == 1
+    _total, names = await _shared_tier_bytes(store)
+    assert names == []
+
+
+async def test_gc_compacts_bucket_tombstones(tmp_path):
+    store = InMemoryObjectStore()
+    coord = BucketCoordStore(store, bucket=STAGING_BUCKET,
+                             settle_delay=0.0)
+    token = await coord.put("leases/gone", {"owner": "w"}, expect=ABSENT)
+    assert await coord.delete("leases/gone", expect=token)
+    live = await coord.put("workers/alive", {"hi": 1}, expect=ABSENT)
+    assert live is not None
+    # the tombstone object physically exists until the sweep
+    assert await store.get_object(STAGING_BUCKET, ".fleet/leases/gone")
+    # the "at" stamp is ms-rounded: step past it before a 0-age sweep
+    await asyncio.sleep(0.01)
+    assert await coord.sweep_tombstones(0.0) == 1
+    with pytest.raises(KeyError):
+        await store.get_object(STAGING_BUCKET, ".fleet/leases/gone")
+    # live documents are never touched; the key stays recreatable
+    assert (await coord.get("workers/alive"))[0] == {"hi": 1}
+    assert await coord.put("leases/gone", {"owner": "w2"},
+                           expect=ABSENT) is not None
+    # a FRESH tombstone survives a sweep bounded by max_age
+    token2 = (await coord.get("leases/gone"))[1]
+    assert await coord.delete("leases/gone", expect=token2)
+    assert await coord.sweep_tombstones(3600.0) == 0
+    assert await store.get_object(STAGING_BUCKET, ".fleet/leases/gone")
+
+
 async def test_shared_tier_torn_publish_is_invisible(tmp_path):
     """No manifest -> no entry, regardless of payload objects (the
     manifest IS the publish, like the local cache's rename)."""
@@ -478,6 +571,51 @@ async def test_lease_waiter_releases_run_slot(tmp_path, hot_origin):
     finally:
         await worker.shutdown(grace_seconds=2)
         await other_runner.cleanup()
+
+
+async def test_cancel_while_fleet_lease_parked_no_slot_leak(
+        tmp_path, hot_origin):
+    """ISSUE 7 satellite (fleet half): cancelling a job PARKED on a
+    peer's content lease settles CANCELLED with the workdir removed and
+    the run-slot accounting intact — the park's release/reacquire
+    mechanics must not leak a slot."""
+    uri, gets = hot_origin
+    hot_key = cache_key("http", uri, ETAG)
+    coord = MemoryCoordStore()
+    # a live foreign lease the local job will park behind
+    await coord.put(LEASES_PREFIX + hot_key, {
+        "owner": "worker-far", "fence": 1,
+        "acquiredAt": time.time(), "expiresAt": time.time() + 60,
+    })
+    broker = InMemoryBroker()
+    worker = await make_worker(
+        tmp_path, broker, InMemoryObjectStore(), "cxl", coord,
+        fleet_kwargs={"max_wait": 30.0},
+    )
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(uri, "hot-cxl"))
+        async with asyncio.timeout(10):
+            while True:
+                record = worker.registry.get("hot-cxl")
+                if record is not None and record.state == "PARKED":
+                    break
+                await asyncio.sleep(0.01)
+        assert (record.reason or "").startswith("fleet_lease_wait")
+        # the parked waiter gave its slot back while idle
+        assert worker.scheduler.in_use == 0
+        assert worker.registry.cancel("hot-cxl", reason="operator")
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=15)
+        record = worker.registry.get("hot-cxl")
+        assert record.state == "CANCELLED"
+        workdir = str(tmp_path / "dl-cxl" / "hot-cxl")
+        assert not os.path.exists(workdir)
+        # RunSlot accounting intact: nothing held, nothing queued
+        assert worker.scheduler.in_use == 0
+        assert worker.scheduler.waiting == 0
+        assert gets[0] == 0  # the waiter never touched the origin
+    finally:
+        await worker.shutdown(grace_seconds=2)
 
 
 async def test_from_config_gating(tmp_path):

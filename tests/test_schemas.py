@@ -167,3 +167,44 @@ def test_remap_random_tables_roundtrip(remap_reset):
         schemas.configure_remap(table)
         assert schemas.decode(schemas.Download, schemas.encode(msg)) == msg
         schemas.configure_remap(None)
+
+
+def test_pb2_matches_regeneration():
+    """Tier-1 drift guard (ISSUE 7 satellite): the committed
+    ``downloader_pb2.py`` must be byte-identical to what
+    ``scripts/gen_proto.py`` (``make proto``) would emit from it.
+
+    With schema evolution happening through declarative EDITS (no protoc
+    in the image), the hazard is someone editing the generated module —
+    or the edit tables — without regenerating: the descriptor then
+    silently diverges from the tool's output and the next regeneration
+    clobbers hand changes.  This renders the module in-memory (no file
+    writes) and compares.
+    """
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_gen_proto", os.path.join(repo, "scripts", "gen_proto.py")
+    )
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    fdp = gen.current_file_proto()
+    changed = gen.apply_edits(fdp)
+    assert not changed, (
+        "scripts/gen_proto.py carries schema edits the committed "
+        "downloader_pb2.py lacks — run `make proto` and commit the result"
+    )
+    serialized = fdp.SerializeToString()
+    rendered = gen.TEMPLATE.format(
+        serialized=serialized,
+        offsets=gen.offsets_block(fdp, serialized),
+    )
+    with open(gen.PB2_PATH, "r") as fh:
+        committed = fh.read()
+    assert rendered == committed, (
+        "committed downloader_pb2.py differs from a fresh regeneration "
+        "— run `make proto` and commit the result"
+    )

@@ -1,0 +1,651 @@
+"""Multi-tenant overload control: weighted-fair scheduling, per-tenant
+caps/quotas, deadline-aware shedding, and the tenancy admin surface.
+
+The acceptance slices (ISSUE 7):
+
+- absent-tenant and unknown-tenant deliveries run as ``"default"`` with
+  no behavior change when no ``tenants.*`` config is set;
+- under saturation BULK deliveries are parked+nacked (never a permanent
+  FAIL) with ``jobs_shed_total{reason,tenant}`` attribution while HIGH
+  work keeps flowing;
+- deadline-expired BULK work settles in the distinct EXPIRED terminal
+  state, deadline-expired HIGH work is surfaced but still runs;
+- cancelling a PARKED job (breaker-parked) settles CANCELLED with the
+  workdir removed and no run-slot leak.
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.control.overload import OverloadController
+from downloader_tpu.control.registry import (
+    ADMITTED, CANCELLED, DONE, EXPIRED, PARKED, RECEIVED,
+    IllegalTransition, JobRegistry,
+)
+from downloader_tpu.control.scheduler import PriorityScheduler
+from downloader_tpu.control.tenancy import TenantTable
+from downloader_tpu.health import build_app
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.utils.ratelimit import (ChainedLimiter, TokenBucket,
+                                            chain_limiters)
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# TenantTable: resolve + config parsing
+# ---------------------------------------------------------------------------
+
+def _table(tenants=None):
+    data = {"tenants": tenants} if tenants is not None else {}
+    return TenantTable(ConfigNode(data))
+
+
+def test_resolve_absent_and_default():
+    table = _table()
+    assert table.resolve(None) == "default"
+    assert table.resolve("") == "default"
+    assert table.resolve("default") == "default"
+    assert not table.configured
+
+
+def test_resolve_unknown_degrades_to_default():
+    # the unknown-priority -> NORMAL posture: an un-onboarded submitter
+    # gets baseline service, and metric label cardinality stays bounded
+    table = _table({"vip": {"weight": 4}})
+    assert table.resolve("vip") == "vip"
+    assert table.resolve("nobody") == "default"
+    assert table.configured
+    assert table.names() == ["default", "vip"]
+
+
+def test_weights_caps_and_quotas_parse():
+    table = _table({
+        "vip": {"weight": 4, "max_concurrent": 2},
+        "bulky": {"download_rate_limit": 1024,
+                  "upload_rate_limit": 2048},
+    })
+    assert table.weight("vip") == 4.0
+    assert table.weight("bulky") == 1.0
+    assert table.max_concurrent("vip") == 2
+    assert table.max_concurrent("bulky") is None
+    assert table.ingress_limiter("bulky").rate == 1024.0
+    assert table.egress_limiter("bulky").rate == 2048.0
+    assert table.ingress_limiter("vip") is None
+    # buckets are memoized (per-service, not per-call)
+    assert table.ingress_limiter("bulky") is table.ingress_limiter("bulky")
+
+
+@pytest.mark.parametrize("spec", [
+    {"weight": 0}, {"weight": -1}, {"weight": "fast"},
+    {"max_concurrent": 0}, {"download_rate_limit": -5},
+])
+def test_bad_tenant_config_raises(spec):
+    with pytest.raises(ValueError):
+        _table({"t": spec})
+
+
+def test_chain_limiters():
+    a, b = TokenBucket(100), TokenBucket(200)
+    assert chain_limiters(None, None) is None
+    assert chain_limiters(a, None) is a
+    chained = chain_limiters(a, b)
+    assert isinstance(chained, ChainedLimiter)
+    assert chained.buckets == [a, b]
+
+
+# ---------------------------------------------------------------------------
+# Weighted-fair scheduler
+# ---------------------------------------------------------------------------
+
+async def test_weighted_fair_split_under_contention():
+    table = _table({"heavy": {"weight": 3}, "light": {"weight": 1}})
+    scheduler = PriorityScheduler(1, aging_seconds=0, tenants=table)
+    await scheduler.acquire(1, "heavy")  # occupy the slot
+
+    async def queued(tenant):
+        fut = asyncio.get_running_loop().create_future()
+
+        async def waiter():
+            await scheduler.acquire(1, tenant)
+            fut.set_result(tenant)
+        task = asyncio.create_task(waiter())
+        await asyncio.sleep(0)
+        return tenant, fut, task
+
+    waiters = []
+    for i in range(8):
+        waiters.append(await queued("heavy" if i % 2 == 0 else "light"))
+    scheduler.release("heavy")
+    order = []
+    for _ in range(8):
+        await asyncio.sleep(0.01)
+        granted = [w for w in waiters if w[1].done()]
+        assert len(granted) == 1
+        tenant, fut, task = granted[0]
+        await task
+        order.append(tenant)
+        waiters.remove(granted[0])
+        scheduler.release(tenant)
+    # stride with weights 3:1 gives heavy ~3 of every 4 grants; the
+    # first four grants must include 3 heavy and 1 light
+    assert order[:4].count("heavy") == 3
+    assert order.count("heavy") == 4 and order.count("light") == 4
+
+
+async def test_idle_tenant_cannot_bank_stride_credit():
+    """Regression (review): a tenant idle while another takes many
+    grants must REJOIN at the active floor, not spend banked credit —
+    otherwise it monopolizes the slot until its stale pass catches up."""
+    table = _table({"a": {"weight": 1}, "b": {"weight": 1}})
+    scheduler = PriorityScheduler(1, aging_seconds=0, tenants=table)
+    # a takes 50 uncontended grants while b idles
+    for _ in range(50):
+        await scheduler.acquire(1, "a")
+        scheduler.release("a")
+    await scheduler.acquire(1, "a")  # occupy the slot
+
+    async def queued(tenant):
+        fut = asyncio.get_running_loop().create_future()
+
+        async def waiter():
+            await scheduler.acquire(1, tenant)
+            fut.set_result(tenant)
+        task = asyncio.create_task(waiter())
+        await asyncio.sleep(0)
+        return tenant, fut, task
+
+    waiters = []
+    for i in range(8):
+        waiters.append(await queued("b" if i % 2 == 0 else "a"))
+    scheduler.release("a")
+    order = []
+    for _ in range(8):
+        await asyncio.sleep(0.005)
+        granted = [w for w in waiters if w[1].done()]
+        assert len(granted) == 1
+        tenant, _fut, task = granted[0]
+        await task
+        order.append(tenant)
+        waiters.remove(granted[0])
+        scheduler.release(tenant)
+    # equal weights must alternate from the start: b's 50-grant "debt"
+    # was reset at rejoin, so no 4-in-a-row monopoly for either side
+    assert order[:4].count("b") == 2, order
+
+
+async def test_tenant_concurrency_cap_skips_capped_waiters():
+    table = _table({"capped": {"max_concurrent": 1}})
+    scheduler = PriorityScheduler(2, aging_seconds=0, tenants=table)
+    await scheduler.acquire(1, "capped")
+    # second capped acquire must queue even though a slot is free ...
+    blocked = asyncio.create_task(scheduler.acquire(1, "capped"))
+    await asyncio.sleep(0.01)
+    assert not blocked.done()
+    assert scheduler.in_use == 1 and scheduler.waiting == 1
+    # ... while another tenant takes the free slot immediately, skipping
+    # the earlier capped waiter
+    await asyncio.wait_for(scheduler.acquire(1, "other"), 1.0)
+    assert scheduler.in_use == 2
+    # releasing the capped tenant's slot grants its queued waiter
+    scheduler.release("capped")
+    await asyncio.wait_for(blocked, 1.0)
+    assert scheduler.held_by_tenant() == {"capped": 1, "other": 1}
+    scheduler.release("capped")
+    scheduler.release("other")
+    assert scheduler.in_use == 0
+
+
+async def test_priority_still_dominates_tenant_fairness():
+    # a HIGH waiter from a low-weight tenant beats NORMAL waiters from a
+    # heavy tenant: fairness apportions WITHIN a class, never across
+    table = _table({"heavy": {"weight": 100}, "light": {"weight": 1}})
+    scheduler = PriorityScheduler(1, aging_seconds=0, tenants=table)
+    await scheduler.acquire(1, "heavy")
+    normal = asyncio.create_task(scheduler.acquire(1, "heavy"))
+    await asyncio.sleep(0.01)
+    high = asyncio.create_task(scheduler.acquire(0, "light"))
+    await asyncio.sleep(0.01)
+    scheduler.release("heavy")
+    await asyncio.wait_for(high, 1.0)
+    assert not normal.done()
+    scheduler.release("light")
+    await asyncio.wait_for(normal, 1.0)
+    scheduler.release("heavy")
+
+
+async def test_scheduler_without_table_unchanged():
+    scheduler = PriorityScheduler(1, aging_seconds=0)
+    await scheduler.acquire(2)
+    queued = asyncio.create_task(scheduler.acquire(0))
+    await asyncio.sleep(0.01)
+    scheduler.release()
+    await asyncio.wait_for(queued, 1.0)
+    scheduler.release()
+    assert scheduler.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# Overload controller
+# ---------------------------------------------------------------------------
+
+def test_overload_sustain_and_clear():
+    signals = {"queue_depth": 0, "oldest_queued_seconds": 0.0,
+               "cache_headroom_bytes": 10**12}
+    lag = {"v": 0.0}
+    ctl = OverloadController(lambda: signals, lambda: lag["v"],
+                             sustain=2, max_loop_lag=0.5)
+    assert ctl.sample() is False
+    lag["v"] = 1.0
+    assert ctl.sample() is False      # first breached sample: not yet
+    assert ctl.sample() is True       # sustained
+    assert ctl.reasons == ["loop_lag"]
+    assert ctl.should_shed("BULK") == "loop_lag"
+    assert ctl.should_shed("HIGH") is None
+    assert ctl.should_shed("NORMAL") is None
+    lag["v"] = 0.0
+    assert ctl.sample() is False      # one healthy sample clears
+    assert ctl.should_shed("BULK") is None
+    snap = ctl.snapshot()
+    assert snap["saturated"] is False and snap["reasons"] == []
+
+
+def test_overload_headroom_and_depth_triggers():
+    signals = {"queue_depth": 50, "oldest_queued_seconds": 120.0,
+               "cache_headroom_bytes": 10}
+    ctl = OverloadController(
+        lambda: signals, lambda: None, sustain=1, max_loop_lag=0,
+        min_headroom_bytes=1000, max_queue_depth=10,
+        max_oldest_seconds=60,
+    )
+    assert ctl.sample() is True
+    assert set(ctl.reasons) == {"disk_headroom", "queue_depth", "queue_age"}
+
+
+def test_overload_disabled_by_config():
+    config = ConfigNode({"overload": {"enabled": False}})
+    assert OverloadController.from_config(
+        config, lambda: {}, lambda: None) is None
+
+
+# ---------------------------------------------------------------------------
+# Registry: tenant + EXPIRED
+# ---------------------------------------------------------------------------
+
+def test_registry_tenant_and_deadline_fields():
+    registry = JobRegistry()
+    record = registry.register("j1", "c", tenant="vip", ttl_seconds=60)
+    assert record.tenant == "vip"
+    assert not record.deadline_expired()
+    assert 0 < record.deadline_remaining() <= 60
+    payload = record.to_dict()
+    assert payload["tenant"] == "vip"
+    assert payload["ttlSeconds"] == 60
+    assert payload["deadlineRemainingSeconds"] > 0
+    # default: no deadline, default tenant
+    bare = registry.register("j2", "c")
+    assert bare.tenant == "default"
+    assert bare.deadline_remaining() is None
+    assert not bare.deadline_expired()
+
+
+def test_registry_expired_transitions():
+    registry = JobRegistry()
+    for walk in ([], [PARKED], [ADMITTED]):
+        record = registry.register("j", "c")
+        for state in walk:
+            registry.transition(record, state)
+        registry.transition(record, EXPIRED, reason="deadline")
+        assert record.terminal and record.state == EXPIRED
+    # EXPIRED is unreachable once running (the bytes are being paid for)
+    record = registry.register("j", "c")
+    registry.transition(record, ADMITTED)
+    registry.transition(record, "RUNNING", stage="download")
+    with pytest.raises(IllegalTransition):
+        registry.transition(record, EXPIRED)
+
+
+def test_registry_tenant_queue_depths():
+    registry = JobRegistry()
+    registry.register("a", "c", tenant="vip")
+    registry.register("b", "c", tenant="vip")
+    registry.register("d", "c")
+    done = registry.register("e", "c", tenant="vip")
+    registry.transition(done, ADMITTED)
+    registry.transition(done, "RUNNING", stage="download")
+    assert registry.tenant_queue_depths() == {"vip": 2, "default": 1}
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator integration
+# ---------------------------------------------------------------------------
+
+def make_msg(job_id, uri, priority="NORMAL", tenant="", ttl=0.0,
+             created_at=""):
+    return schemas.encode(schemas.Download(
+        media=schemas.Media(
+            id=job_id, creator_id="card-1", name="A Show",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=uri,
+        ),
+        created_at=created_at,
+        priority=schemas.JobPriority.Value(priority),
+        tenant=tenant,
+        ttl_seconds=ttl,
+    ))
+
+
+async def make_orchestrator(tmp_path, broker, store, extra=None, **kwargs):
+    config = {"instance": {"download_path": str(tmp_path / "downloads")},
+              **(extra or {})}
+    mq = MemoryQueue(broker)
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode(config),
+        mq=mq,
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"tnc{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+        **kwargs,
+    )
+    await orchestrator.start()
+    return orchestrator
+
+
+async def serve_payload():
+    """Tiny instant media server; returns (runner, base_url)."""
+    from helpers import start_media_server
+
+    return await start_media_server(b"V" * 2048)
+
+
+async def wait_for(predicate, timeout=10.0):
+    async with asyncio.timeout(timeout):
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+
+async def test_absent_and_unknown_tenant_run_as_default(tmp_path):
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore())
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("absent", f"{base}/show.mkv"))
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("unknown", f"{base}/show.mkv",
+                                tenant="nobody"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        for job_id in ("absent", "unknown"):
+            record = orchestrator.registry.get(job_id)
+            assert record.state == DONE
+            assert record.tenant == "default"
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_configured_tenant_attributed_end_to_end(tmp_path):
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"tenants": {"vip": {"weight": 4}}},
+    )
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("v1", f"{base}/show.mkv", tenant="vip"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        record = orchestrator.registry.get("v1")
+        assert record.state == DONE and record.tenant == "vip"
+        # flight-recorder context carries the tenant
+        assert any(e.get("tenant") == "vip"
+                   for e in record.recorder.events())
+        # per-tenant outcome counter on /metrics
+        text = orchestrator.metrics.render().decode()
+        assert 'tenant_jobs_total{outcome="DONE",tenant="vip"} 1.0' in text
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_saturated_worker_sheds_bulk_then_recovers(tmp_path):
+    """The shed is park-then-nack, never a permanent FAIL: once the
+    pressure clears, the redelivered BULK job completes."""
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"overload": {"interval": 3600, "sustain": 1,
+                            "shed_backoff": 0.02}},
+    )
+    try:
+        # force saturation (the sampling loop is parked at 1h)
+        orchestrator.overload.saturated = True
+        orchestrator.overload.reasons = ["loop_lag"]
+        shed_seen = asyncio.Event()
+
+        async def unshed():
+            await wait_for(lambda: orchestrator.registry.get("bulk-1")
+                           is not None and orchestrator.registry.get(
+                               "bulk-1").state != RECEIVED)
+            shed_seen.set()
+            orchestrator.overload.saturated = False
+            orchestrator.overload.reasons = []
+
+        task = asyncio.create_task(unshed())
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("bulk-1", f"{base}/show.mkv",
+                                priority="BULK"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        await task
+        assert shed_seen.is_set()
+        record = orchestrator.registry.get("bulk-1")
+        assert record.state == DONE  # latest record: the redelivery ran
+        text = orchestrator.metrics.render().decode()
+        assert 'jobs_shed_total{reason="loop_lag",tenant="default"}' in text
+        # the shed attempt settled FAILED(overload_shed), never poison
+        sheds = [r for r in orchestrator.registry.jobs()
+                 if r.job_id == "bulk-1" and r.state != DONE]
+        assert sheds and all(
+            r.reason.startswith("overload_shed") for r in sheds)
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_saturated_worker_keeps_serving_high(tmp_path):
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"overload": {"interval": 3600, "sustain": 1}},
+    )
+    try:
+        orchestrator.overload.saturated = True
+        orchestrator.overload.reasons = ["disk_headroom"]
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("high-1", f"{base}/show.mkv",
+                                priority="HIGH"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("high-1").state == DONE
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_expired_bulk_drops_expired_high_runs(tmp_path):
+    """Deadline semantics at the admission checkpoints: queue-aged BULK
+    settles EXPIRED (distinct terminal state, acked, shed-attributed);
+    an equally-late HIGH job is surfaced but still staged."""
+    from test_control import start_slow_server
+
+    slow_runner, slow_base, _gets = await start_slow_server(
+        chunks=400, delay=0.02)
+    fast_runner, fast_base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"instance": {
+            "download_path": str(tmp_path / "downloads"),
+            "max_concurrent_jobs": 1, "scheduler_backlog": 4,
+        }},
+    )
+    try:
+        # occupy the single run slot with a slow transfer
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("slow", f"{slow_base}/media.mkv"))
+        await wait_for(lambda: (orchestrator.registry.get("slow")
+                                is not None
+                                and orchestrator.registry.get("slow").state
+                                not in (RECEIVED, ADMITTED)))
+        # both jobs expire while waiting for the slot
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("late-bulk", f"{fast_base}/show.mkv",
+                                priority="BULK", ttl=0.05))
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("late-high", f"{fast_base}/show.mkv",
+                                priority="HIGH", ttl=0.05))
+        await asyncio.sleep(0.2)  # let both TTLs lapse in the queue
+        orchestrator.registry.cancel("slow", reason="test")
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        bulk = orchestrator.registry.get("late-bulk")
+        assert bulk.state == EXPIRED
+        assert bulk.reason.startswith("deadline")
+        high = orchestrator.registry.get("late-high")
+        assert high.state == DONE  # surfaced, never dropped
+        assert any(e["kind"] == "deadline_exceeded"
+                   for e in high.recorder.events())
+        text = orchestrator.metrics.render().decode()
+        assert ('jobs_shed_total{reason="deadline",tenant="default"} 1.0'
+                in text)
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await slow_runner.cleanup()
+        await fast_runner.cleanup()
+
+
+async def test_ttl_anchored_to_submission_not_redelivery(tmp_path):
+    """Regression (review): the deadline runs from Download.created_at,
+    so a redelivered BULK job whose TTL already elapsed is dropped at
+    RECEIPT — it cannot reset its clock with every shed/nack cycle."""
+    from datetime import datetime, timedelta, timezone
+
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore())
+    try:
+        stale = (datetime.now(timezone.utc) - timedelta(seconds=30)) \
+            .isoformat().replace("+00:00", "Z")
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("stale-bulk", f"{base}/show.mkv",
+                                priority="BULK", ttl=5.0,
+                                created_at=stale))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("stale-bulk").state == EXPIRED
+        # same age, HIGH: surfaced but staged
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("stale-high", f"{base}/show.mkv",
+                                priority="HIGH", ttl=5.0,
+                                created_at=stale))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("stale-high").state == DONE
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+async def test_cancel_while_breaker_parked_no_slot_leak(tmp_path):
+    """ISSUE 7 satellite: cancel a breaker-PARKED job -> CANCELLED,
+    workdir gone, RunSlot accounting intact."""
+    runner, base = await serve_payload()
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore())
+    try:
+        breaker = orchestrator.breakers.get("store")
+        for _ in range(breaker.threshold):
+            breaker.record_failure()
+        assert orchestrator.breakers.blocking_dependencies(
+            orchestrator.admission_dependencies)
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_msg("parked", f"{base}/show.mkv"))
+        await wait_for(lambda: (orchestrator.registry.get("parked")
+                                is not None
+                                and orchestrator.registry.get(
+                                    "parked").state == PARKED))
+        assert orchestrator.registry.cancel("parked", reason="operator")
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        record = orchestrator.registry.get("parked")
+        assert record.state == CANCELLED
+        workdir = os.path.join(str(tmp_path / "downloads"), "parked")
+        assert not os.path.exists(workdir)
+        # no slot leak: the parked job never held (or returned) its slot
+        assert orchestrator.scheduler.in_use == 0
+        assert orchestrator.scheduler.waiting == 0
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Admin surface
+# ---------------------------------------------------------------------------
+
+async def serve_admin(orchestrator):
+    import aiohttp
+
+    app = build_app(orchestrator, orchestrator.metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    session = aiohttp.ClientSession()
+
+    async def cleanup():
+        await session.close()
+        await runner.cleanup()
+
+    return session, f"http://127.0.0.1:{port}", cleanup
+
+
+async def test_v1_tenants_endpoint(tmp_path):
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"tenants": {"vip": {"weight": 4, "max_concurrent": 2}}},
+    )
+    session, url, cleanup = await serve_admin(orchestrator)
+    try:
+        async with session.get(f"{url}/v1/tenants") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+        assert body["configured"] is True
+        assert body["tenants"]["vip"]["weight"] == 4
+        assert body["tenants"]["vip"]["maxConcurrent"] == 2
+        assert body["tenants"]["vip"]["queued"] == 0
+        assert "default" in body["tenants"]
+        assert body["overload"]["saturated"] is False
+        # per-tenant queue-depth gauges bound at config cardinality
+        text = orchestrator.metrics.render().decode()
+        assert 'tenant_queue_depth{tenant="vip"} 0.0' in text
+        assert 'tenant_queue_depth{tenant="default"} 0.0' in text
+    finally:
+        await cleanup()
+        await orchestrator.shutdown(grace_seconds=5)

@@ -92,11 +92,14 @@ def test_field_numbers_frozen():
     expected = {
         "Media": {"id": 1, "creator_id": 2, "name": 3, "type": 4,
                   "source": 5, "source_uri": 6},
-        # priority=3 added by the control-plane PR (deliberate, additive
-        # migration: proto3 implicit presence, absent = NORMAL, so the
-        # golden bytes above — which predate the field — still decode
-        # identically and old producers are untouched)
-        "Download": {"media": 1, "created_at": 2, "priority": 3},
+        # priority=3 added by the control-plane PR, tenant=4 +
+        # ttl_seconds=5 by the multi-tenant overload PR (deliberate,
+        # additive migrations: proto3 implicit presence, absent =
+        # NORMAL / "default" tenant / no deadline, so the golden bytes
+        # above — which predate the fields — still decode identically
+        # and old producers are untouched)
+        "Download": {"media": 1, "created_at": 2, "priority": 3,
+                     "tenant": 4, "ttl_seconds": 5},
         "Convert": {"created_at": 1, "media": 2},
     }
     for message_name, fields in expected.items():
@@ -122,6 +125,25 @@ def test_priority_field_wire_semantics():
     assert again.priority == schemas.JobPriority.Value("HIGH")
     assert {v.name: v.number for v in schemas.JobPriority.DESCRIPTOR.values} \
         == {"NORMAL": 0, "HIGH": 1, "BULK": 2}
+
+
+def test_tenant_field_wire_semantics():
+    """tenant=4 / ttl_seconds=5 are additive: golden (pre-field) bytes
+    decode with the implicit defaults ("" -> the default tenant, 0 = no
+    deadline), and unset values add no bytes on encode."""
+    old = schemas.decode(schemas.Download, bytes.fromhex(GOLDEN_DOWNLOAD))
+    assert old.tenant == ""
+    assert old.ttl_seconds == 0.0
+    msg = schemas.Download(
+        media=_media(), created_at="2026-01-02T03:04:05.678Z",
+        tenant="", ttl_seconds=0.0,
+    )
+    assert schemas.encode(msg).hex() == GOLDEN_DOWNLOAD
+    msg.tenant = "vip"
+    msg.ttl_seconds = 12.5
+    again = schemas.decode(schemas.Download, schemas.encode(msg))
+    assert again.tenant == "vip"
+    assert again.ttl_seconds == 12.5
 
 
 def test_observable_enum_constants():
