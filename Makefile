@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness chaos fleet proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash chaos crash fleet proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -17,6 +17,13 @@ integration:
 # against deterministic store/publish/http/tracker/disk failures
 chaos:
 	python -m pytest tests/test_faults.py -v
+
+# kill-based crash-chaos suite: a real worker subprocess SIGKILLed at
+# chosen seams (mid-download, mid-upload, pre-ack, lease-holder) and
+# restarted; asserts DONE exactly once, staged bytes hash-identical,
+# no orphan workdirs/leases, retry counters monotone across the kill
+crash:
+	python -m pytest tests/test_crash.py tests/test_journal.py -v
 
 # multi-worker fleet suite: coordination-store semantics, N-orchestrator
 # coalescing over MiniS3, lease takeover, coord-store chaos
@@ -44,6 +51,12 @@ bench-fleet:
 # more than 1.25x vs the idle-worker baseline)
 bench-fairness:
 	python bench.py --fairness
+
+# standalone crash-durability bench (one JSON line: journal_overhead_ms
+# must stay < 1 ms/job; restart_recovery_ms = SIGKILL -> restart ->
+# recovered job DONE through a real worker subprocess)
+bench-crash:
+	python bench.py --crash
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

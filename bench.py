@@ -119,7 +119,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  layer the BULK backlog fills every slot and the ratio blows past 2.
 #  ``python bench.py --fairness`` runs this workload standalone
 #  (`make bench-fairness`).
-HARNESS_VERSION = 14
+# v15 (r13): crash-durability workload — journal_overhead_ms: the
+#  per-job cost of the append-only job journal's lifecycle traffic
+#  (open + transitions + settle through a real JobJournal with the
+#  default batched fsync), guard < 1 ms/job, same discipline as the
+#  v10 recorder guard; restart_recovery_ms: a real worker subprocess
+#  is SIGKILLed mid-upload by a ``kind: crash`` fault rule and
+#  restarted — measured is the wall from the kill to the recovered
+#  job reaching DONE (interpreter boot + journal replay + workdir
+#  reconciliation + redelivery + resumed staging, end to end).
+#  ``python bench.py --crash`` runs this workload standalone
+#  (`make bench-crash`).
+HARNESS_VERSION = 15
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -1057,6 +1068,109 @@ def _bench_faults_safe() -> dict:
         return {"faults_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_crash() -> dict:
+    """Crash-durability microbenches (harness v15).
+
+    - ``journal_overhead_ms``: what the append-only job journal
+      (control/journal.py) adds to a full registry lifecycle walk —
+      the same 2000-job walk as ``registry_overhead_ms``, run bare and
+      then with a real :class:`JobJournal` attached (default batched
+      fsync, plus the per-job ``settle`` line the orchestrator appends
+      and the close-time flush).  The guard is < 1 ms/job
+      (``journal_overhead_ok``): the durability layer must stay in the
+      recorder/registry cost class, not the fsync cost class.
+    - ``restart_recovery_ms``: the crash harness's headline wall — a
+      REAL ``python -m downloader_tpu`` worker is SIGKILLed mid-upload
+      by a ``kind: crash`` fault rule and restarted; measured from the
+      kill being observed to the recovered job reaching DONE through
+      the restarted worker (interpreter boot + journal replay + workdir
+      reconciliation + broker redelivery + resumed staging).  No guard:
+      the number is interpreter-boot dominated and host-class specific;
+      it exists so the series catches a recovery path that regresses
+      from seconds to minutes.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from downloader_tpu.control.journal import JobJournal
+    from downloader_tpu.control.registry import (
+        ADMITTED, DONE, PUBLISHING, RUNNING, JobRegistry,
+    )
+
+    # -- journal overhead ----------------------------------------------
+    jobs = 2000
+
+    def walk(registry: JobRegistry, journal) -> None:
+        for i in range(jobs):
+            record = registry.register(f"crash-bench-{i}", "card")
+            registry.transition(record, ADMITTED)
+            for stage in ("download", "process", "upload"):
+                registry.transition(record, RUNNING, stage=stage)
+            registry.transition(record, PUBLISHING)
+            registry.transition(record, DONE)
+            if journal is not None:
+                journal.append("settle", record.job_id, mode="ack")
+
+    t0 = time.perf_counter()
+    walk(JobRegistry(), None)
+    bare_ms = (time.perf_counter() - t0) * 1000.0 / jobs
+
+    with tempfile.TemporaryDirectory() as work:
+        journal = JobJournal(os.path.join(work, "journal.jsonl"))
+        t0 = time.perf_counter()
+        walk(JobRegistry(journal=journal), journal)
+        journal.close()  # the final flush+fsync is part of the cost
+        journaled_ms = (time.perf_counter() - t0) * 1000.0 / jobs
+    journal_ms = max(journaled_ms - bare_ms, 0.0)
+
+    # -- restart recovery ----------------------------------------------
+    # the kill-harness rig lives with the tests (real subprocess worker,
+    # real-wire MiniAmqp + MiniS3)
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_crash import CrashRig, start_origin
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rig = CrashRig(Path(tmp))
+        await rig.start_backends()
+        origin, uri, _gets = await start_origin()
+        try:
+            rig.write_config()
+            # crash on the SECOND store put: media file staged, done
+            # marker not — the torn-publish window reconciliation +
+            # manifest verification exist for
+            await rig.spawn_worker(fault_plan=(
+                '[{"seam": "store.put", "kind": "crash", "after": 1,'
+                ' "count": 1}]'
+            ))
+            await rig.publish("bench-crash", uri)
+            await rig.wait_killed()
+            t0 = time.perf_counter()
+            await rig.spawn_worker()
+            await rig.wait_job_state("bench-crash", "DONE", timeout=60)
+            restart_ms = (time.perf_counter() - t0) * 1000.0
+            await rig.assert_staged_ok("bench-crash")
+        finally:
+            await rig.stop()
+            await origin.cleanup()
+
+    return {
+        "journal_overhead_ms": round(journal_ms, 4),
+        "journal_overhead_ok": journal_ms < 1.0,
+        "restart_recovery_ms": round(restart_ms, 1),
+    }
+
+
+def _bench_crash_safe() -> dict:
+    """A crash-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_crash())
+    except Exception as err:
+        return {"crash_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
 async def bench_stage_overlap() -> dict:
     """Streaming stage overlap (harness v11): pipelined vs barrier.
 
@@ -1858,6 +1972,9 @@ HEADLINE_KEYS = [
     "fairness_degradation",       # r12: vip p99 loaded / idle, <= 1.25
     "fairness_ok",                # r12 guard verdict
     "fairness_error",             # present only on failure — visible
+    "journal_overhead_ms",        # r13 guard: job journal < 1 ms/job
+    "restart_recovery_ms",        # r13: SIGKILL -> restart -> job DONE
+    "crash_bench_error",          # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -1896,6 +2013,10 @@ def main() -> None:
         # standalone multi-tenant fairness run (`make bench-fairness`)
         print(json.dumps(_bench_fairness_safe()))
         return
+    if "--crash" in sys.argv:
+        # standalone crash-durability run (`make bench-crash`)
+        print(json.dumps(_bench_crash_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -1917,6 +2038,7 @@ def main() -> None:
         **_bench_fairness_safe(),
         **_bench_control_safe(),
         **_bench_faults_safe(),
+        **_bench_crash_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

@@ -140,8 +140,13 @@ def _build_parser() -> argparse.ArgumentParser:
     _jobs_common(jobs_list)
     jobs_list.add_argument("--state", default=None,
                            help="filter by lifecycle state "
-                                "(RECEIVED/ADMITTED/RUNNING/PUBLISHING/"
-                                "DONE/FAILED/CANCELLED/DROPPED_POISON)")
+                                "(RECEIVED/ADMITTED/RUNNING/PARKED/"
+                                "PUBLISHING/DONE/FAILED/CANCELLED/"
+                                "DROPPED_POISON/EXPIRED)")
+    jobs_list.add_argument("--recovered", action="store_true",
+                           help="only jobs that survived a worker crash "
+                                "(journal-replayed placeholders and "
+                                "their adopting redeliveries)")
 
     jobs_show = jobs_sub.add_parser("show", help="one job's full record")
     _jobs_common(jobs_show)
@@ -448,6 +453,8 @@ async def _jobs(args) -> int:
         try:
             if args.jobs_command == "list":
                 params = {"state": args.state} if args.state else {}
+                if args.recovered:
+                    params["recovered"] = "true"
                 async with session.get(f"{base}/v1/jobs",
                                        params=params) as resp:
                     body = await resp.json()

@@ -91,8 +91,13 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
                 {"error": f"unknown state {state!r}",
                  "states": sorted(reg.LEGAL_TRANSITIONS)}, status=400
             )
+        jobs = registry.jobs(state)
+        # ?recovered=true: only jobs that survived a worker crash
+        # (journal-replayed placeholders + their adopting redeliveries)
+        if request.query.get("recovered") in ("true", "1", "yes"):
+            jobs = [r for r in jobs if r.recovered]
         return web.json_response({
-            "jobs": [r.to_dict() for r in registry.jobs(state)],
+            "jobs": [r.to_dict() for r in jobs],
             "counts": registry.counts(),
             "workerId": getattr(orchestrator, "worker_id", None),
             "intakePaused": bool(
@@ -186,6 +191,8 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
                 if scheduler is not None else {})
         waiting = (scheduler.waiting_by_tenant()
                    if scheduler is not None else {})
+        footprint_fn = getattr(orchestrator, "tenant_staging_bytes", None)
+        footprints = footprint_fn() if callable(footprint_fn) else {}
         tenants = {}
         for name, spec in table.describe().items():
             tenants[name] = {
@@ -193,6 +200,9 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
                 "queued": depths.get(name, 0),
                 "runningSlots": held.get(name, 0),
                 "waitingForSlot": waiting.get(name, 0),
+                # live disk footprint (quotas cover transfer rate only;
+                # this is the accounting half, no enforcement)
+                "stagingBytes": footprints.get(name, 0),
             }
         overload = getattr(orchestrator, "overload", None)
         return web.json_response({

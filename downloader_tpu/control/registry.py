@@ -84,9 +84,13 @@ LEGAL_TRANSITIONS: Dict[str, frozenset] = {
     RECEIVED: frozenset({PARKED, ADMITTED, FAILED, CANCELLED, EXPIRED}),
     # PARKED -> RUNNING: a job parked MID-RUN (waiting out a peer
     # worker's content lease, fleet/plane.py) resumes its stage when
-    # the leader publishes; admission-parked jobs still go via ADMITTED
+    # the leader publishes; admission-parked jobs still go via ADMITTED.
+    # PARKED -> RECEIVED: a crash-recovery placeholder (control/
+    # journal.py) is adopted by its redelivery and re-enters the normal
+    # intake path from the top — one record carries both incarnations.
     PARKED: frozenset(
-        {ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON, EXPIRED}
+        {RECEIVED, ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON,
+         EXPIRED}
     ),
     ADMITTED: frozenset(
         {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON,
@@ -125,6 +129,7 @@ class JobRecord:
         "stage_seconds", "_entered_mono", "_created_mono",
         "recorder", "trace_id", "span_id", "transferred", "retry",
         "worker_id", "tenant", "ttl_seconds", "deadline_mono",
+        "recovered",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
@@ -179,6 +184,12 @@ class JobRecord:
         # OTLP span, and this record's timeline
         self.trace_id: Optional[str] = None
         self.span_id: Optional[str] = None
+        # crash-recovery provenance (control/journal.py): True on a
+        # record replayed from the journal at boot — first as the PARKED
+        # "awaiting redelivery" placeholder, then carried through the
+        # adopting redelivery, so GET /v1/jobs?recovered= can list the
+        # jobs that survived a worker kill
+        self.recovered = False
         # live retry/backoff detail (platform/errors.py): the Retrier
         # sets it while a dependency call is between attempts, the
         # orchestrator while the job is parked for delayed redelivery —
@@ -244,6 +255,7 @@ class JobRecord:
             "percent": self.percent,
             "bytes": dict(self.bytes),
             "retry": dict(self.retry) if self.retry else None,
+            "recovered": self.recovered,
             "cancelRequested": self.cancel.cancelled,
             "traceId": self.trace_id,
             "spanId": self.span_id,
@@ -265,10 +277,15 @@ class JobRegistry:
 
     def __init__(self, metrics=None, terminal_ring: int = DEFAULT_TERMINAL_RING,
                  logger=None, recorder_events: int = DEFAULT_EVENT_LIMIT,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None, journal=None):
         self.metrics = metrics
         self.logger = logger
         self.worker_id = worker_id
+        # crash-safe durability (control/journal.py): every register/
+        # transition appends one journal line, so a killed worker's
+        # replacement can replay the lifecycle it lost.  None = the
+        # exact pre-journal in-memory-only registry.
+        self.journal = journal
         self.recorder_events = max(int(recorder_events), 1)
         self.terminal_ring = max(int(terminal_ring), 0)
         self._active: "collections.OrderedDict[int, JobRecord]" = (
@@ -285,8 +302,16 @@ class JobRegistry:
     # -- lifecycle ------------------------------------------------------
     def register(self, job_id: str, file_id: str,
                  priority: str = "NORMAL", tenant: str = "default",
-                 ttl_seconds: float = 0.0) -> JobRecord:
-        """Open a record at delivery receipt (state RECEIVED)."""
+                 ttl_seconds: float = 0.0,
+                 recovered_at: str = "") -> JobRecord:
+        """Open a record at delivery receipt (state RECEIVED).
+
+        ``recovered_at`` is set only by startup reconciliation when it
+        re-opens a boot placeholder: carried on the journal ``open``
+        line so the placeholder-retirement clock (when its redelivery
+        never arrives) survives any number of restarts instead of
+        resetting with each boot's re-registration.
+        """
         record = JobRecord(next(self._seq), job_id, file_id, priority,
                            recorder_events=self.recorder_events,
                            worker_id=self.worker_id,
@@ -294,7 +319,56 @@ class JobRegistry:
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
         record.event("received", priority=priority)
+        if self.journal is not None:
+            fields = dict(fileId=file_id, priority=priority,
+                          tenant=tenant, ttl=ttl_seconds)
+            if recovered_at:
+                fields["recoveredAt"] = recovered_at
+            self.journal.append("open", job_id, **fields)
         return record
+
+    def adopt_recovered(self, job_id: str, file_id: str,
+                        priority: str = "NORMAL",
+                        tenant: str = "default",
+                        ttl_seconds: float = 0.0) -> Optional[JobRecord]:
+        """Hand a crash-recovery placeholder to its arriving redelivery.
+
+        A placeholder is a live PARKED record the startup reconciliation
+        opened from the journal (``recovered`` flag set, reason
+        ``recovered: ...``).  The redelivery re-enters the normal intake
+        path with the SAME record — and crucially the same cancel token,
+        so an operator cancel fired during the replay window settles the
+        redelivery the moment it arrives.  Identity fields are refreshed
+        from the delivery (the journal's copy may predate a producer-side
+        change).  Returns None when no placeholder is waiting.
+        """
+        placeholder = None
+        for record in self._active.values():
+            if (record.job_id == job_id and record.recovered
+                    and record.state == PARKED
+                    and (record.reason or "").startswith("recovered")):
+                placeholder = record
+        if placeholder is None:
+            return None
+        placeholder.file_id = file_id
+        placeholder.priority = priority
+        placeholder.tenant = tenant
+        placeholder.ttl_seconds = float(ttl_seconds or 0.0)
+        placeholder.deadline_mono = (
+            time.monotonic() + placeholder.ttl_seconds
+            if placeholder.ttl_seconds > 0 else None
+        )
+        if self.journal is not None:
+            # journal the refreshed identity too: a crash after adoption
+            # must replay the delivery's fields, not the stale pre-crash
+            # open line (an open on a live job keeps its poison counter)
+            self.journal.append("open", job_id, fileId=file_id,
+                                priority=priority, tenant=tenant,
+                                ttl=ttl_seconds)
+        self.transition(placeholder, RECEIVED,
+                        reason="recovered: redelivery arrived")
+        placeholder.event("redelivered_after_recovery")
+        return placeholder
 
     def transition(self, record: JobRecord, state: str,
                    stage: Optional[str] = None,
@@ -341,6 +415,9 @@ class JobRegistry:
         record.updated_at = _utcnow_iso()
         record._entered_mono = now
         record.event("state", **event_fields)
+        if self.journal is not None:
+            self.journal.append("state", record.job_id, state=state,
+                                stage=record.stage, reason=reason)
         if state in TERMINAL_STATES:
             self._retire(record)
         return record
@@ -365,6 +442,11 @@ class JobRegistry:
             evicted = self._ring.popleft()
             # the gauge counts records the registry still knows about
             self._gauge(evicted.state, -1)
+        if self.journal is not None:
+            # amortized growth bound: only ever checked when a job ends
+            # (one stat), and the rewrite itself runs off-thread so a
+            # loop-side settle never pays the replay + fsyncs
+            self.journal.maybe_compact_async()
 
     # -- control --------------------------------------------------------
     def cancel(self, job_id: str, reason: str = "operator") -> List[JobRecord]:

@@ -522,6 +522,42 @@ class FleetPlane:
         the admin API)."""
         return sorted(self._held)
 
+    async def reclaim_own_leases(self) -> int:
+        """Release leases a previous incarnation of this worker died
+        holding (crash-recovery boot path, orchestrator ``_recover``).
+
+        A lease owned by our ``worker_id`` that this process does not
+        hold has no renewer — waiters would otherwise sit out the full
+        TTL + takeover grace before failing over.  ``try_acquire_lease``
+        already reclaims such an orphan when WE next want the content;
+        this sweep handles the case where we never will, deleting the
+        doc by CAS token so a racing peer takeover is never clobbered.
+        Returns the number reclaimed; coordination trouble just stops
+        the sweep (expiry remains the backstop).
+        """
+        reclaimed = 0
+        try:
+            for key, doc in await self._get_all(LEASES_PREFIX):
+                content_key = key[len(LEASES_PREFIX):]
+                if doc.get("owner") != self.worker_id:
+                    continue
+                if content_key in self._held:
+                    continue  # live, renewed by this process
+                entry = await self.coord.get(key)
+                if entry is None or entry[0].get("owner") != self.worker_id:
+                    continue  # raced: expired away or taken over
+                if not await self.coord.delete(key, expect=entry[1]):
+                    continue  # raced: a peer takeover rewrote the token
+                reclaimed += 1
+                if self.logger is not None:
+                    self.logger.info("fleet: reclaimed orphan lease",
+                                     key=content_key[:16])
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("lease_reclaim", err)
+        return reclaimed
+
     # -- shared cache tier ----------------------------------------------
     def _shared_name(self, key: str, rel: str = "") -> str:
         if rel:

@@ -118,6 +118,13 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
                 "heldLeases": len(plane.lease_snapshot()),
                 "coordErrors": plane.stats.get("coordErrors", 0),
             }
+        # crash recovery (control/journal.py): what the last boot's
+        # reconciliation found — recovered placeholders, restored retry
+        # counters, swept orphan workdirs.  Present only when a journal
+        # is configured; torn lines > 0 is worth an operator's look.
+        recovery = getattr(orchestrator, "recovery", None)
+        if recovery is not None:
+            payload["recovery"] = recovery
         return web.json_response(payload)
 
     async def prom(_request: web.Request) -> web.Response:

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 from . import control, schemas
 from .control.cancel import CancelToken, JobCancelled
+from .control.journal import JobJournal, recovery_counters
 from .control.registry import JobRecord, JobRegistry
 from .control.overload import OverloadController
 from .control.scheduler import (PriorityScheduler, RunSlot,
@@ -187,12 +188,31 @@ class Orchestrator:
         # scheduler_backlog > 0 widens the consumer prefetch past the run
         # slots so the scheduler has deliveries to reorder (default 0 =
         # exact pre-control-plane behavior).
+        # crash-safe durability (control/journal.py): an append-only
+        # journal under the work dir records lifecycle transitions,
+        # settle modes, and retry counters, and start() replays it —
+        # so a SIGKILL costs at most one in-flight attempt's incremental
+        # work, never the retry schedule and never disk.
+        self._download_root = os.path.dirname(
+            job_download_dir(config, "_probe")
+        )
+        self.journal = JobJournal.from_config(
+            config, self._download_root, logger=self.logger
+        )
+        # populated by start()'s reconciliation: the /readyz "recovery"
+        # block + the jobs_recovered_total attribution
+        self.recovery: Optional[dict] = None
+        # job_id -> {"cancelled": bool, "reason": str} for recovered jobs
+        # whose redelivery has not arrived yet (the replay window)
+        self._recovered: Dict[str, dict] = {}
+        self._recovery_watchers: List[asyncio.Task] = []
         self.registry = JobRegistry(
             metrics=metrics, logger=self.logger,
             recorder_events=int(cfg_get(
                 config, "obs.recorder_events", DEFAULT_EVENT_LIMIT
             )),
             worker_id=self.worker_id,
+            journal=self.journal,
         )
         # runtime introspection (platform/obs.py): loop-lag sampling
         # into /metrics, and the transfer profiler feeding throughput /
@@ -318,6 +338,13 @@ class Orchestrator:
             metrics.bind_autoscale(self.autoscale_signals)
             metrics.bind_tenants(self.tenants.names(),
                                  self.registry.tenant_queue_depths)
+            # per-tenant staging *footprint* (ROADMAP item 5 remaining
+            # depth): live workdir bytes per tenant — quotas today cover
+            # transfer rate; this gauge is the disk-accounting half
+            # (observability only, no enforcement yet)
+            metrics.bind_tenant_staging(self.tenants.names(),
+                                        self.tenant_staging_bytes)
+        self._staging_memo = {"at": 0.0, "snap": None, "busy": False}
         # the dependencies whose open breaker pauses intake: everything a
         # job needs to SETTLE (staging writes + convert publish) — origin
         # fetch trouble stays per-job (a broken origin is one job's
@@ -350,6 +377,10 @@ class Orchestrator:
     # ------------------------------------------------------------------
     async def start(self) -> None:
         """Connect and begin consuming (reference lib/main.js:47,172)."""
+        # reconcile BEFORE the first delivery can arrive: redeliveries
+        # must find their restored retry counters and their placeholder
+        # records already in place
+        await self._recover()
         await self.mq.connect()
         await self.telemetry.connect()
         # route Convert through a fanout exchange bound to the canonical
@@ -408,6 +439,272 @@ class Orchestrator:
             "cache_headroom_bytes": headroom,
             "active_jobs": len(self.active_jobs),
         }
+
+    def tenant_staging_bytes(self) -> Dict[str, int]:
+        """Live per-tenant staging footprint: bytes on disk under each
+        non-terminal job's workdir, attributed to the job's tenant.
+
+        Fed to the ``tenant_staging_bytes`` gauges and ``GET
+        /v1/tenants`` — the disk half of per-tenant accounting (quotas
+        cover transfer rate only; this is observability, not
+        enforcement).  Stale-while-revalidate: callers are sync gauge
+        callbacks on the event loop, and the walk stats real workdirs
+        (a large torrent is tens of thousands of files — inline it
+        would be exactly the loop stall the OverloadController sheds
+        on), so a stale snapshot answers immediately and the re-walk
+        runs on the executor.  The first call returns ``{}``.
+        """
+        now = time.monotonic()
+        memo = self._staging_memo
+        stale = memo["snap"] is None or now - memo["at"] >= 5.0
+        if stale and not memo["busy"]:
+            memo["busy"] = True
+            # capture (job_id, tenant) on the loop side: the walk thread
+            # must not touch live registry records
+            jobs = []
+            seen: set = set()
+            for record in self.registry.jobs():
+                if record.terminal or record.job_id in seen:
+                    continue
+                seen.add(record.job_id)
+                jobs.append((record.job_id, record.tenant))
+
+            from .utils.disk import dir_bytes
+
+            def _walk() -> None:
+                out: Dict[str, int] = {}
+                try:
+                    for job_id, tenant in jobs:
+                        size = dir_bytes(
+                            job_download_dir(self.config, job_id))
+                        if size:
+                            out[tenant] = out.get(tenant, 0) + size
+                    memo["snap"] = out
+                    memo["at"] = time.monotonic()
+                finally:
+                    memo["busy"] = False
+
+            try:
+                asyncio.get_running_loop().run_in_executor(None, _walk)
+            except RuntimeError:
+                # no loop (direct sync use in tests): walk inline
+                _walk()
+        return memo["snap"] or {}
+
+    # -- crash recovery (control/journal.py) ----------------------------
+    async def _recover(self) -> None:
+        """Startup reconciliation: replay the journal, restore retry
+        schedules, open PARKED placeholders for jobs whose redelivery is
+        still coming, sweep orphan workdirs, and release any content
+        leases a previous incarnation of this worker died holding.
+
+        The outcome is surfaced three ways: the ``recovery`` block on
+        ``/readyz``, ``jobs_recovered_total{outcome}``, and a
+        ``recovered`` event + flag on each placeholder record.
+        """
+        if self.journal is None:
+            return
+        state = await asyncio.to_thread(self.journal.replay)
+        live = state.live()
+        counters = recovery_counters(state)
+        restored = 0
+        for job_id, failures in counters.items():
+            self._failure_counts[job_id] = failures
+            restored += 1
+        tombstone_ttl = float(cfg_get(
+            self.config, "journal.tombstone_ttl", 86400.0))
+        expired: set = set()
+
+        def _retire(job_id: str, why: str) -> None:
+            # the redelivery never came (dead-lettered, message TTL,
+            # queue purge, completed by a fleet peer): settle-ack the
+            # journal so the job stops replaying — and re-counting —
+            # on every boot forever
+            self.journal.append("settle", job_id, mode="ack", why=why)
+            self._failure_counts.pop(job_id, None)
+            expired.add(job_id)
+            if self.metrics is not None:
+                self.metrics.jobs_recovered.labels(
+                    outcome="expired").inc()
+
+        for job_id, job in live.items():
+            if job.state == control.CANCELLED:
+                # an operator-cancelled placeholder from a PREVIOUS
+                # recovery window (the CANCELLED transition is journaled,
+                # the delivery never settled): the decision is final
+                # across any number of restarts — no run placeholder,
+                # just the tombstone that settles the eventual
+                # redelivery as cancelled the moment it arrives
+                if (tombstone_ttl > 0
+                        and _submission_age_seconds(job.updated_at)
+                        > tombstone_ttl):
+                    _retire(job_id, "tombstone_expired")
+                    continue
+                # no metrics inc here: outcome="cancelled" counted once,
+                # when the cancel first settled the placeholder — a
+                # crash-looping worker must not re-count the same
+                # tombstone every boot
+                self._recovered[job_id] = {
+                    "cancelled": True,
+                    "reason": job.reason or "cancelled",
+                    "watcher": None,
+                }
+                continue
+            # the placeholder-retirement clock: recovered_at survives
+            # re-registration across boots (clears on adoption/progress),
+            # so a placeholder the broker has owed a redelivery for a
+            # full TTL is a ghost — retire it instead of parking it,
+            # keeping its workdir, and re-counting it at every boot
+            if (tombstone_ttl > 0 and job.recovered_at
+                    and _submission_age_seconds(job.recovered_at)
+                    > tombstone_ttl):
+                _retire(job_id, "recovery_expired")
+                continue
+            record = self.registry.register(
+                job_id, job.file_id, priority=job.priority,
+                tenant=self.tenants.resolve(job.tenant),
+                ttl_seconds=job.ttl_seconds,
+                recovered_at=(job.recovered_at or job.updated_at
+                              or _utcnow_iso()),
+            )
+            record.recovered = True
+            record.event("recovered", prior_state=job.state,
+                         prior_stage=job.stage, failures=job.failures,
+                         settle=job.settle)
+            if job.failures > 0:
+                # GET /v1/jobs answers "how burned is this job's poison
+                # budget" before the redelivery even lands
+                record.retry = {"why": "recovered",
+                                "failures": job.failures}
+            self.registry.transition(
+                record, control.PARKED,
+                reason="recovered: awaiting redelivery",
+            )
+            watcher = asyncio.create_task(self._watch_recovered(record))
+            self._recovered[job_id] = {"cancelled": False, "reason": "",
+                                       "watcher": watcher}
+            self._recovery_watchers.append(watcher)
+            if self.metrics is not None:
+                self.metrics.jobs_recovered.labels(outcome="replayed").inc()
+        swept, resumed = await asyncio.to_thread(
+            self._sweep_workdirs,
+            # cancelled tombstones are never resumable (their workdir,
+            # if the kill beat the cancel's own rmtree, is an orphan),
+            # and neither are jobs just retired past tombstone_ttl —
+            # keeping a retired ghost's workdir would leak it for the
+            # whole process lifetime
+            {job_id for job_id, job in live.items()
+             if job.state != control.CANCELLED and job_id not in expired},
+        )
+        if self.metrics is not None:
+            if swept:
+                self.metrics.jobs_recovered.labels(
+                    outcome="swept").inc(swept)
+            if resumed:
+                self.metrics.jobs_recovered.labels(
+                    outcome="resumable").inc(resumed)
+        # compact now that the history is replayed: the journal restarts
+        # as one snapshot line of the still-live jobs (self-replaying,
+        # so the placeholder lines just appended are part of the basis)
+        await asyncio.to_thread(self.journal.compact)
+        leases_reclaimed = 0
+        if self.fleet is not None:
+            try:
+                leases_reclaimed = await self.fleet.reclaim_own_leases()
+            except Exception as err:
+                # coordination trouble degrades, never blocks a boot —
+                # the acquire-time own-orphan reclaim still applies
+                self.logger.warn("recovery lease reclaim failed",
+                                 error=str(err))
+        self.recovery = {
+            "recoveredJobs": len(live),
+            "restoredRetryCounters": restored,
+            "sweptWorkdirs": swept,
+            "resumableWorkdirs": resumed,
+            "tornJournalLines": state.torn_lines,
+            "reclaimedLeases": leases_reclaimed,
+            "at": _utcnow_iso(),
+        }
+        if live or swept or state.torn_lines:
+            self.logger.info("crash recovery complete", **self.recovery)
+
+    def _sweep_workdirs(self, live_ids: set) -> "tuple[int, int]":
+        """Reconcile the download root against the journal (thread-side).
+
+        A workdir whose job still expects a redelivery is KEPT — its
+        ``.partial``/piece state is content-keyed (validators in
+        ``.partial.meta``, SHA-1 piece hashes) so the resumed attempt
+        pays only the missing bytes.  Everything else — ack-settled
+        terminal jobs, dirs the journal has never heard of — is an
+        orphan and is deleted: the journal is authoritative for this
+        root (dot-dirs, including the journal's own, are skipped).
+        Returns ``(swept, resumed)`` counts.
+        """
+        swept = resumed = 0
+        # service dirs that legitimately live under the download root but
+        # are NOT job workdirs: the journal's own dir and a configured
+        # content cache (CACHE_DIR/instance.cache.path may point a
+        # non-dot-prefixed dir here — sweeping it would silently discard
+        # the whole LRU cache at every boot)
+        protected = set()
+        if self.journal is not None:
+            protected.add(os.path.realpath(os.path.dirname(
+                self.journal.path)))
+        from .store.cache import resolve_cache_path
+
+        # the ONE resolver the cache itself uses — a diverging copy here
+        # would eventually sweep the LRU cache as an "orphan"
+        protected.add(os.path.realpath(resolve_cache_path(self.config)))
+        try:
+            entries = os.scandir(self._download_root)
+        except OSError:
+            return swept, resumed
+        with entries:
+            for entry in entries:
+                if not entry.is_dir(follow_symlinks=False):
+                    continue
+                if entry.name.startswith("."):
+                    continue  # .journal, .cache-style service dirs
+                if os.path.realpath(entry.path) in protected:
+                    continue
+                if entry.name in live_ids:
+                    resumed += 1
+                    continue
+                try:
+                    shutil.rmtree(entry.path)
+                    swept += 1
+                except OSError as err:
+                    self.logger.warn("orphan workdir sweep failed",
+                                     path=entry.path, error=str(err))
+        return swept, resumed
+
+    async def _watch_recovered(self, record: JobRecord) -> None:
+        """Settle a recovered placeholder that is cancelled before its
+        redelivery arrives (the cancel-during-reconciliation window).
+
+        The placeholder holds no run slot and no delivery, so nothing
+        else will ever settle it: this watcher transitions it to
+        CANCELLED, removes the workdir, and leaves a tombstone in
+        ``_recovered`` so the eventual redelivery is acked as cancelled
+        instead of silently re-running an operator-cancelled job.
+        """
+        await record.cancel.wait()
+        if record.terminal or not (record.state == control.PARKED
+                                   and record.recovered):
+            return  # adopted by a redelivery first: the normal path owns it
+        reason = record.cancel.reason or "cancelled"
+        entry = self._recovered.get(record.job_id)
+        if entry is not None:
+            entry["cancelled"] = True
+            entry["reason"] = reason
+        self._clear_failures(record.job_id)
+        await self._remove_workdir(record.job_id, self.logger)
+        record.event("settle", mode="none", why="cancelled_during_recovery",
+                     reason=reason)
+        self.registry.transition(record, control.CANCELLED, reason=reason)
+        if self.metrics is not None:
+            self.metrics.jobs_cancelled.inc()
+            self.metrics.jobs_recovered.labels(outcome="cancelled").inc()
 
     # -- control plane: intake steering --------------------------------
     async def pause_intake(self) -> None:
@@ -485,8 +782,17 @@ class Orchestrator:
             # leave the fleet before the backends close: deregistration
             # and lease release still have a live store to write to
             await self.fleet.stop()
+        for watcher in self._recovery_watchers:
+            watcher.cancel()
+        if self._recovery_watchers:
+            await asyncio.gather(*self._recovery_watchers,
+                                 return_exceptions=True)
+            self._recovery_watchers.clear()
         await self.mq.close()
         await self.telemetry.close()
+        if self.journal is not None:
+            # synchronous flush: a clean shutdown's journal is exact
+            await asyncio.to_thread(self.journal.close)
         for cleanup in self.stage_cleanups:
             try:
                 await cleanup()
@@ -551,9 +857,39 @@ class Orchestrator:
         # drain, and shutdown (pre-control-plane blind spot).  All
         # bookkeeping after this point is undone in the finally, so a
         # failure anywhere can't leak the gauge or the active-jobs entry.
-        record = self.registry.register(job_id, file_id, priority=priority,
-                                        tenant=tenant,
-                                        ttl_seconds=ttl_seconds)
+        # crash-recovery adoption (control/journal.py): a redelivery for
+        # a job the startup replay knows about takes over its PARKED
+        # placeholder — same record, same cancel token, restored retry
+        # schedule — so the attempt resumes its history instead of
+        # starting cold.  A placeholder cancelled during the replay
+        # window leaves a tombstone: the redelivery is settled as
+        # cancelled the moment it arrives (an operator decision is
+        # final, PR 7's cancel-while-PARKED posture).
+        recovered_entry = self._recovered.pop(job_id, None)
+        record = None
+        if recovered_entry is not None:
+            watcher = recovered_entry.get("watcher")
+            if watcher is not None and not watcher.done():
+                watcher.cancel()
+            record = self.registry.adopt_recovered(
+                job_id, file_id, priority=priority, tenant=tenant,
+                ttl_seconds=ttl_seconds,
+            )
+            if record is not None and self.metrics is not None:
+                self.metrics.jobs_recovered.labels(outcome="adopted").inc()
+        if record is None:
+            record = self.registry.register(job_id, file_id,
+                                            priority=priority,
+                                            tenant=tenant,
+                                            ttl_seconds=ttl_seconds)
+            if recovered_entry is not None:
+                # the placeholder is gone (cancelled during the replay
+                # window settled it) but the delivery is still this
+                # job's: mark provenance on the fresh record
+                record.recovered = True
+        if recovered_entry is not None and recovered_entry.get("cancelled"):
+            record.cancel.cancel(recovered_entry.get("reason")
+                                 or "cancelled")
         if record.deadline_mono is not None:
             # the TTL ran from SUBMISSION: shift the cutoff back by the
             # age the message already has, so redeliveries (which carry
@@ -716,16 +1052,12 @@ class Orchestrator:
         # settling, so "delivery settled" implies "disk reclaimed" (the
         # cancel-latency bench and any operator automation can treat the
         # ack as the single completion signal)
-        try:
-            await asyncio.to_thread(
-                shutil.rmtree, job_download_dir(self.config, job_id), True
-            )
-        except OSError as err:
-            logger.warn("cancelled-job cleanup failed", error=str(err))
+        await self._remove_workdir(job_id, logger)
         record.event("settle", mode="ack", why="cancelled",
                      reason=token.reason or "cancelled")
+        self._journal_settle(job_id, "ack", "cancelled")
         await delivery.ack()
-        self._failure_counts.pop(job_id, None)
+        self._clear_failures(job_id)
         if self.metrics is not None:
             self.metrics.jobs_cancelled.inc()
         try:
@@ -791,7 +1123,38 @@ class Orchestrator:
         self._failure_counts[job_id] = failures
         if len(self._failure_counts) > 10_000:
             self._failure_counts.pop(next(iter(self._failure_counts)))
+        if self.journal is not None:
+            # the poison counter must survive a worker kill: a job that
+            # failed twice before the crash is on its third strike after
+            self.journal.append("retry", job_id, failures=failures)
         return failures
+
+    def _clear_failures(self, job_id: str) -> None:
+        """Drop the poison counter (and journal the drop, so a restart
+        cannot resurrect a count the live process already cleared)."""
+        if self._failure_counts.pop(job_id, None) is not None \
+                and self.journal is not None:
+            self.journal.append("retry_clear", job_id)
+
+    def _journal_settle(self, job_id: str, mode: str, why: str) -> None:
+        """Record how the delivery settled — the bit recovery uses to
+        decide whether a redelivery is still coming (nack) or the job's
+        story is over and its workdir is an orphan (ack)."""
+        if self.journal is not None:
+            self.journal.append("settle", job_id, mode=mode, why=why)
+
+    async def _remove_workdir(self, job_id: str, logger: Logger) -> None:
+        """Best-effort workdir removal for settles after which no
+        redelivery will ever come (ack + terminal): without this, a
+        permanently-failed or expired job's partial downloads sat on
+        disk until an operator noticed (the crash-recovery sweep now
+        catches them at the NEXT boot; this catches them live)."""
+        try:
+            await asyncio.to_thread(
+                shutil.rmtree, job_download_dir(self.config, job_id), True
+            )
+        except OSError as err:
+            logger.warn("terminal workdir cleanup failed", error=str(err))
 
     def _redelivery_delay(self, failures: int) -> float:
         """Exponential park-then-nack pause for the Nth failure."""
@@ -855,6 +1218,7 @@ class Orchestrator:
         record.retry = None
         record.event("settle", mode="nack", why="overload_shed",
                      reason=reason)
+        self._journal_settle(record.job_id, "nack", "overload_shed")
         await delivery.nack()
         self.registry.transition(
             record, control.FAILED, reason=f"overload_shed: {reason}"
@@ -903,12 +1267,17 @@ class Orchestrator:
             logger.warn("expired-job status emit failed", error=str(err))
         record.event("settle", mode="ack", why="deadline",
                      overdue_s=round(overdue, 3), where=where)
+        self._journal_settle(record.job_id, "ack", "deadline")
         await delivery.ack()
-        self._failure_counts.pop(record.job_id, None)
+        self._clear_failures(record.job_id)
+        # terminal state BEFORE the workdir removal's await: anything
+        # woken by the ack (broker join, drain, /v1/jobs pollers) must
+        # already see EXPIRED, not a settled-but-ADMITTED limbo
         self.registry.transition(
             record, control.EXPIRED,
             reason=f"deadline: ttl {record.ttl_seconds:g}s exceeded",
         )
+        await self._remove_workdir(record.job_id, logger)
         return True
 
     async def _settle_failed_attempt(
@@ -950,6 +1319,7 @@ class Orchestrator:
             record.retry = None
             record.event("settle", mode="nack", why="breaker_open",
                          dependency=dependency)
+            self._journal_settle(job_id, "nack", "breaker_open")
             await delivery.nack()
             self.registry.transition(
                 record, control.FAILED,
@@ -965,12 +1335,13 @@ class Orchestrator:
                          fault=fault, error=str(err)[:200])
             if self.metrics is not None:
                 self.metrics.jobs_failed.labels(reason=fault).inc()
-            self._failure_counts.pop(job_id, None)
+            self._clear_failures(job_id)
             # drop any between-attempts retry blob the Retrier left: a
             # terminal record must not read as "waiting for a retry"
             record.retry = None
             record.event("settle", mode="ack", why=fault,
                          type=type(err).__name__)
+            self._journal_settle(job_id, "ack", fault)
             await delivery.ack()
             self.registry.transition(
                 record,
@@ -978,6 +1349,9 @@ class Orchestrator:
                 else control.DROPPED_POISON,
                 reason=f"{fault}: {type(err).__name__}",
             )
+            # no redelivery is coming: the workdir would otherwise leak
+            # until the next boot's orphan sweep
+            await self._remove_workdir(job_id, logger)
             return
         failures = self._note_failure(job_id)
         record.event("retry", failures=failures,
@@ -992,13 +1366,15 @@ class Orchestrator:
             # drop, not double-counted as a stage_error too
             if self.metrics is not None:
                 self.metrics.jobs_failed.labels(reason="poison").inc()
-            self._failure_counts.pop(job_id, None)
+            self._clear_failures(job_id)
             record.retry = None
             record.event("settle", mode="ack", why="poison",
                          failures=failures)
+            self._journal_settle(job_id, "ack", "poison")
             await delivery.ack()
             self.registry.transition(record, control.DROPPED_POISON,
                                      reason=f"{failures} failures")
+            await self._remove_workdir(job_id, logger)
             return
         if self.metrics is not None:
             self.metrics.jobs_failed.labels(reason=why).inc()
@@ -1008,6 +1384,7 @@ class Orchestrator:
         record.retry = None
         record.event("settle", mode="nack", why=why,
                      delay_s=round(delay, 3))
+        self._journal_settle(job_id, "nack", why)
         await delivery.nack()
         self.registry.transition(record, control.FAILED, reason=why)
 
@@ -1139,11 +1516,13 @@ class Orchestrator:
                 if getattr(err, "code", None) == "ERRDLSTALL":
                     if self.metrics is not None:
                         self.metrics.jobs_failed.labels(reason="stalled").inc()
-                    self._failure_counts.pop(job_id, None)  # job is settled
+                    self._clear_failures(job_id)  # job is settled
                     record.event("settle", mode="ack", why="stalled")
+                    self._journal_settle(job_id, "ack", "stalled")
                     await delivery.ack()
                     self.registry.transition(record, control.FAILED,
                                              reason="stalled")
+                    await self._remove_workdir(job_id, logger)
                     return
 
                 # anything else settles under the error taxonomy:
@@ -1168,6 +1547,18 @@ class Orchestrator:
         # path for everyone is finishing the publish.
         self.registry.transition(record, control.PUBLISHING)
         payload = schemas.Convert(created_at=_utcnow_iso(), media=msg.media)
+        # deadline propagation (ROADMAP item 5 remaining depth): the
+        # SURVIVING ttl budget rides into the convert pipeline — the
+        # downstream converter can apply the same expired-BULK shedding
+        # instead of transcoding work nobody is waiting for.  Floor at
+        # 1 ms, never 0: proto3 drops a 0.0 from the wire, and the
+        # field's contract reads absent/0 as "no deadline" — exactly
+        # the overdue jobs (negative remaining) must NOT decode as
+        # deadline-free.  Jobs without a TTL leave the field unset, so
+        # old consumers decode identically.
+        remaining = record.deadline_remaining()
+        if remaining is not None:
+            payload.deadline_seconds = max(round(remaining, 3), 0.001)
         try:
             # carry the job span's context to the downstream converter so
             # its spans join this trace (submit -> job -> convert); a
@@ -1222,11 +1613,18 @@ class Orchestrator:
                 release_slot, why="publish_error", emit_errored=False)
             return
 
+        # crash point "settle.ack" (platform/faults.py kind: crash): the
+        # pre-ack seam — everything staged and published, the delivery
+        # not yet settled.  A kill here is the redelivery-of-a-finished-
+        # job case the idempotency probe + journal must absorb.
+        if faults.enabled():
+            await faults.fire("settle.ack", key=job_id)
         record.event("settle", mode="ack", why="done")
+        self._journal_settle(job_id, "ack", "done")
         await delivery.ack()
         # success clears the poison counter: transient-failure retries that
         # eventually succeed must not count against a later redelivery
-        self._failure_counts.pop(job_id, None)
+        self._clear_failures(job_id)
         if self.metrics is not None:
             self.metrics.jobs_completed.inc()
         self.registry.transition(record, control.DONE)

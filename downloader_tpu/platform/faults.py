@@ -26,6 +26,16 @@ announce, disk preflight — carry injection hooks driven by a declarative
   raise, modelling a mid-transfer connection drop
 - ``hang``    — block until cancelled (exercises cancel tokens and
   watchdogs against a black-holed dependency)
+- ``crash``   — SIGKILL this very process at the seam: a deterministic
+  crash point for the kill-based chaos harness (tests/test_crash.py,
+  ``make crash``).  A real, uncatchable kill — no atexit, no finally,
+  no flush — exactly the torn state an OOM-kill leaves, so restart
+  reconciliation (control/journal.py) is proven against the worst
+  case, not a polite simulation.  ``after``/``count`` place the kill
+  precisely (e.g. ``seam: store.put, after: 1`` dies between the first
+  staged file and the done marker); the restarted process starts with
+  fresh rule counters, so the same plan does not re-kill unless its
+  ``after`` is reached again
 
 Everything is deterministic — activation is by *call count* per rule,
 no randomness — so a chaos test (tests/test_faults.py, ``make chaos``)
@@ -56,7 +66,26 @@ from .errors import FAULT_CLASSES, TRANSIENT
 
 _ENV_PLAN = "FAULT_PLAN"
 
-KINDS = ("error", "delay", "partial", "hang")
+KINDS = ("error", "delay", "partial", "hang", "crash")
+
+
+def _crash_now(seam: str) -> None:
+    """SIGKILL this process — the deterministic crash point.
+
+    ``signal.SIGKILL`` (not ``os._exit``): the process must die the way
+    an OOM-kill kills it — no interpreter teardown, no buffered-file
+    flush — so the journal/workdir state the restart reconciles is the
+    real torn state, not a softened one.  The raw stderr write is a
+    best-effort breadcrumb for the harness log (fd 2, unbuffered — it
+    survives the kill).
+    """
+    import signal
+
+    try:
+        os.write(2, f"FAULT CRASH at seam {seam}\n".encode())
+    except OSError:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 class InjectedFault(RuntimeError):
@@ -167,6 +196,8 @@ class FaultInjector:
             if not rule.applies(seam, key):
                 continue
             self._note_fired(rule)
+            if rule.kind == "crash":
+                _crash_now(seam)
             if rule.kind == "delay":
                 await asyncio.sleep(rule.delay_s)
                 continue  # delayed, not failed: later rules still apply
@@ -179,11 +210,14 @@ class FaultInjector:
             raise InjectedFault(seam, rule.kind, rule.fault)
 
     def fire_sync(self, seam: str, key: str = "") -> None:
-        """Synchronous seams (disk preflight) support ``error`` only —
-        a blocking sleep would stall the event loop."""
+        """Synchronous seams (disk preflight) support ``error`` and
+        ``crash`` only — a blocking sleep would stall the event loop."""
         for rule in self.rules:
             if not rule.applies(seam, key):
                 continue
+            if rule.kind == "crash":
+                self._note_fired(rule)
+                _crash_now(seam)
             if rule.kind != "error":
                 continue
             self._note_fired(rule)

@@ -334,6 +334,36 @@ class Metrics:
             "spare capacity",
             registry=self.registry,
         )
+        # -- crash-safe durability (control/journal.py) ----------------
+        self.jobs_recovered = Counter(
+            f"{ns}_jobs_recovered_total",
+            "Startup-reconciliation outcomes after a crash, by kind "
+            "(replayed = journal job restored as a PARKED placeholder, "
+            "resumable = workdir kept for its expected redelivery, "
+            "swept = orphan workdir deleted, adopted = redelivery took "
+            "over its placeholder, cancelled = placeholder cancelled "
+            "during the replay window, expired = placeholder or cancel "
+            "tombstone retired past journal.tombstone_ttl — its "
+            "redelivery never came)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.manifest_mismatches = Counter(
+            f"{ns}_staged_manifest_mismatches_total",
+            "Jobs whose staged objects failed the pre-done-marker "
+            "content-manifest verification (short, missing, or "
+            "hash-divergent staging set) — each one is a torn publish "
+            "that was caught before the converter could trust it",
+            registry=self.registry,
+        )
+        self.tenant_staging_bytes = Gauge(
+            f"{ns}_tenant_staging_bytes",
+            "Live staging footprint per tenant: bytes on disk under "
+            "non-terminal jobs' workdirs (the disk half of per-tenant "
+            "accounting; quotas cover transfer rate only)",
+            ["tenant"],
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",
@@ -416,6 +446,28 @@ class Metrics:
 
         for name in names:
             self.tenant_queue_depth.labels(tenant=name).set_function(
+                lambda n=name: float(_snapshot().get(n, 0))
+            )
+
+    def bind_tenant_staging(self, names, footprint_fn) -> None:
+        """Wire the per-tenant staging-footprint gauges to a live walk.
+
+        ``footprint_fn`` returns ``{tenant: bytes_on_disk}``
+        (``Orchestrator.tenant_staging_bytes`` — itself memoized for a
+        few seconds, since the walk stats real workdirs); the label set
+        is the config-bounded tenant list, like :meth:`bind_tenants`.
+        """
+        memo = {"at": 0.0, "snap": None}
+
+        def _snapshot() -> dict:
+            now = time.monotonic()
+            if memo["snap"] is None or now - memo["at"] > 0.5:
+                memo["snap"] = footprint_fn()
+                memo["at"] = now
+            return memo["snap"]
+
+        for name in names:
+            self.tenant_staging_bytes.labels(tenant=name).set_function(
                 lambda n=name: float(_snapshot().get(n, 0))
             )
 

@@ -33,6 +33,11 @@ MEDIA_EXTS = {".mp4", ".mkv", ".mov", ".webm"}
 # framework's own artifact, not downloaded content, so the filter must
 # not let it defeat the sole-top-level-directory rule below.
 _RESUME_SIDECAR = ".dt-resume"
+# our own workdir sidecars, invisible to the sole-top-level-directory
+# parity check below: the torrent resume state and the staged-artifact
+# content manifest (stages/manifest.py) live beside the payload but are
+# not payload
+_SIDECARS = frozenset({_RESUME_SIDECAR, ".manifest.json"})
 
 # (reference lib/process.js:59-66) — substring matches, like JS regex.test
 _SKIP_PATH_RE = re.compile(r"/extras|/commentary", re.IGNORECASE)
@@ -53,7 +58,7 @@ def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
     # preserved as-is for parity.
     try:
         if os.path.exists(os.path.join(root, name)):
-            entries = [e for e in os.listdir(root) if e != _RESUME_SIDECAR]
+            entries = [e for e in os.listdir(root) if e not in _SIDECARS]
             if len(entries) == 1 and entries[0] == name:
                 logger.info(
                     "directory allowed: only top level directory", path=dir_path

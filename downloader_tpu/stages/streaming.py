@@ -289,8 +289,11 @@ async def run_streaming_job(ctx: StageContext, media) -> None:
                 await accepted.put(None)
             await asyncio.gather(*workers)
 
-            # done marker ONLY after every authoritative file is staged:
-            # it is the idempotency probe the whole fleet trusts
+            # done marker ONLY after every authoritative file is staged
+            # AND the staged set verifies against the content manifest
+            # (stages/manifest.py): it is the idempotency probe the
+            # whole fleet trusts
+            await uploader.verify_staged_set(media_id, found)
             await uploader.write_done_marker(media_id)
             await progress.finish()
             logger.info("pipeline: all files staged",

@@ -53,8 +53,9 @@ def done_marker_name(media_id: str) -> str:
     return posixpath.join(media_id, "original", DONE_MARKER)
 
 
-async def _already_staged(store, name: str, file_path: str) -> bool:
-    """True when the staged object provably holds this file's bytes.
+async def _already_staged(store, name: str, file_path: str):
+    """The staged object's info when it provably holds this file's
+    bytes, else None (truthy/falsy, so it still reads as a predicate).
 
     Requires both a size match and a content-hash match against the
     backend's etag; a backend that can't report one (empty etag) never
@@ -62,14 +63,17 @@ async def _already_staged(store, name: str, file_path: str) -> bool:
     object under the done marker.  The probe is best-effort: ANY stat
     failure (not just ObjectNotFound — e.g. write-only credentials where
     HEAD answers 403) means "not staged" so the upload proceeds instead
-    of failing a job the plain put path would have handled fine.
+    of failing a job the plain put path would have handled fine.  On a
+    hit the returned ``ObjectInfo`` carries the verified size + etag, so
+    the caller's content manifest (stages/manifest.py) records the SAME
+    hash the skip decision trusted — no second stat, no re-read.
     """
     try:
         info = await store.stat_object(STAGING_BUCKET, name)
     except Exception:
-        return False
+        return None
     if not info.etag or info.size != os.path.getsize(file_path):
-        return False
+        return None
     if "-" in info.etag:
         # multipart object: its etag is md5-of-part-md5s at the store's
         # part size, which we can recompute locally — without this, every
@@ -77,12 +81,13 @@ async def _already_staged(store, name: str, file_path: str) -> bool:
         # the files resume matters for
         part_size = getattr(store, "multipart_part_size", None)
         if not part_size:
-            return False
+            return None
         expected = await asyncio.to_thread(
             multipart_etag_hex, file_path, part_size
         )
-        return info.etag == expected
-    return info.etag == await asyncio.to_thread(md5_file_hex, file_path)
+        return info if info.etag == expected else None
+    expected = await asyncio.to_thread(md5_file_hex, file_path)
+    return info if info.etag == expected else None
 
 
 class Uploader:
@@ -122,6 +127,41 @@ class Uploader:
                                       metrics=ctx.metrics,
                                       logger=ctx.logger)
         self.uploaded_total = 0
+        # staged-artifact integrity (stages/manifest.py): per-job content
+        # manifest, loaded lazily on the first upload so a redelivered
+        # attempt inherits what its predecessor proved
+        from .manifest import integrity_enabled
+
+        self._integrity = integrity_enabled(ctx.config)
+        self._manifest = None
+        self._manifest_lock = asyncio.Lock()
+
+    async def manifest_for(self, media_id: str):
+        """The job's content manifest (None when integrity is off).
+
+        The first call loads a prior attempt's ``.manifest.json`` —
+        blocking disk I/O, run off-loop like :meth:`JobManifest.persist`
+        for the same reason (a contended or network-backed volume must
+        not stall concurrent transfers).  The off-loop load is a real
+        suspension point, so the lazy init is locked: without it two
+        streaming upload workers can both load, and the loser's
+        assignment would discard entries the winner already noted —
+        a spurious StagedSetMismatch at seal time."""
+        if not self._integrity:
+            return None
+        if (self._manifest is not None
+                and self._manifest.media_id == media_id):
+            return self._manifest
+        async with self._manifest_lock:
+            if self._manifest is None or self._manifest.media_id != media_id:
+                from .download import job_download_dir
+                from .manifest import JobManifest
+
+                self._manifest = await asyncio.to_thread(
+                    JobManifest.load,
+                    job_download_dir(self.ctx.config, media_id), media_id,
+                )
+        return self._manifest
 
     async def ensure_bucket(self) -> None:
         """Staging-bucket existence, checked once per service.
@@ -180,8 +220,16 @@ class Uploader:
         # done marker was written) skips files whose bytes are provably
         # already staged — the reference re-uploads everything from
         # scratch (lib/upload.js:34-52)
-        if await _already_staged(self.store, name, file_path):
+        staged = await _already_staged(self.store, name, file_path)
+        if staged is not None:
             self.logger.info("already staged, skipping", file=file_path)
+            manifest = await self.manifest_for(media_id)
+            if manifest is not None:
+                # the skip decision just verified size + content hash:
+                # record exactly what it trusted
+                manifest.note(name, size=staged.size, etag=staged.etag,
+                              file=file_path)
+                await asyncio.to_thread(manifest.persist)
             if ctx.record is not None:
                 ctx.record.event("upload_done", file=basename, bytes=0,
                                  skipped=True)
@@ -240,6 +288,22 @@ class Uploader:
         # a hard-down backend and parks intake at the orchestrator
         await self.retrier.run("store.put", _put, cancel=ctx.cancel,
                                record=ctx.record, logger=self.logger)
+        manifest = await self.manifest_for(media_id)
+        if manifest is not None:
+            # capture the store-computed content hash of what just
+            # landed (one metadata round trip; the file itself is never
+            # re-read) — the pre-seal verification compares against THIS
+            try:
+                info = await self.store.stat_object(STAGING_BUCKET, name)
+                manifest.note(name, size=info.size, etag=info.etag,
+                              file=file_path)
+            except Exception as err:
+                # integrity is defense-in-depth: an unstattable backend
+                # degrades the verify for this file, never the upload
+                self.logger.warn("manifest stat after upload failed",
+                                 file=basename, error=str(err))
+                manifest.note(name, size=size, etag="", file=file_path)
+            await asyncio.to_thread(manifest.persist)
         if ctx.record is not None:
             ctx.record.add_bytes("uploaded", size)
             ctx.record.event(
@@ -249,6 +313,42 @@ class Uploader:
         if ctx.metrics is not None:
             ctx.metrics.bytes_uploaded.inc(size)
         return size
+
+    async def verify_staged_set(self, media_id: str, files) -> None:
+        """Manifest-vs-staged verification, run BEFORE the done marker.
+
+        Re-stats every authoritative file's object against the per-job
+        content manifest (size + store content hash recorded as each
+        file landed).  Any divergence raises
+        :class:`~.manifest.StagedSetMismatch` (transient: the
+        redelivery re-stages), so a torn crash mid-upload can never
+        seal a short or corrupt staging set under the marker the whole
+        fleet trusts.  No-op when ``integrity.enabled`` is off.
+        """
+        manifest = await self.manifest_for(media_id)
+        if manifest is None or not files:
+            return
+        from .manifest import StagedSetMismatch
+
+        try:
+            verified, unverifiable = await manifest.verify_staged(
+                self.store, STAGING_BUCKET, files, object_name
+            )
+        except StagedSetMismatch as err:
+            if self.ctx.metrics is not None:
+                self.ctx.metrics.manifest_mismatches.inc()
+            if self.ctx.record is not None:
+                self.ctx.record.event("manifest_mismatch",
+                                      problems=len(err.problems))
+            self.logger.error("staged set failed manifest verification",
+                              problems=err.problems[:5])
+            raise
+        if unverifiable:
+            self.logger.warn("staged objects unverifiable, sealing on "
+                             "put success alone", count=unverifiable)
+        if self.ctx.record is not None:
+            self.ctx.record.event("manifest_verified", files=verified,
+                                  unverifiable=unverifiable)
 
     async def write_done_marker(self, media_id: str) -> None:
         """Seal the staging set: the idempotency marker the orchestrator
@@ -305,6 +405,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 percent = (i / len(files) * 50) + 50
                 await ctx.telemetry.emit_progress(media_id, downloading, int(percent))
 
+            # integrity gate: the marker seals only a verified set
+            await uploader.verify_staged_set(media_id, files)
             await uploader.write_done_marker(media_id)
 
         logger.info("finished uploading all files")

@@ -54,6 +54,35 @@ DEFAULT_MAX_BYTES = 10 << 30
 DEFAULT_MIN_FREE_BYTES = 256 << 20
 
 
+def resolve_cache_path(config) -> str:
+    """Where the content cache lives on disk, resolved exactly as
+    :meth:`ContentCache.from_config` does: ``CACHE_DIR`` /
+    ``instance.cache.path``, defaulting to ``<download_path>/.cache``,
+    relative paths anchored at the repo root.
+
+    Shared with the orchestrator's boot workdir sweep, which must
+    PROTECT this directory — two divergent copies of the resolution
+    would eventually let the sweep rmtree the whole LRU cache.
+    """
+    from ..platform.config import cfg_get
+
+    path = os.environ.get("CACHE_DIR") or cfg_get(
+        config, "instance.cache.path", None
+    )
+    if not path:
+        # default beside the per-job download dirs; dot-prefixed so it
+        # can never collide with a media-id workdir
+        configured = cfg_get(
+            config, "instance.download_path", "downloading"
+        )
+        path = os.path.join(configured, ".cache")
+    if not os.path.isabs(path):
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        path = os.path.join(repo_root, path)
+    return path
+
+
 def cache_key(*parts: str) -> str:
     """Stable content key from identity parts (protocol, locator,
     validator).  SHA-256 so hostile URLs cannot craft path segments."""
@@ -235,23 +264,13 @@ class ContentCache:
             enabled = cfg_get(config, "instance.cache.enabled", None)
         else:
             enabled = enabled.lower() in ("1", "true", "yes")
-        path = os.environ.get("CACHE_DIR") or cfg_get(
+        explicit = os.environ.get("CACHE_DIR") or cfg_get(
             config, "instance.cache.path", None
         )
         # a configured path implies enabled unless explicitly disabled
-        if enabled is False or (enabled is None and not path):
+        if enabled is False or (enabled is None and not explicit):
             return None
-        if not path:
-            # default beside the per-job download dirs; dot-prefixed so it
-            # can never collide with a media-id workdir
-            configured = cfg_get(
-                config, "instance.download_path", "downloading"
-            )
-            path = os.path.join(configured, ".cache")
-        if not os.path.isabs(path):
-            repo_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))
-            path = os.path.join(repo_root, path)
+        path = resolve_cache_path(config)
         max_bytes = int(
             os.environ.get("CACHE_MAX_BYTES")
             or cfg_get(config, "instance.cache.max_bytes", DEFAULT_MAX_BYTES)

@@ -34,6 +34,28 @@ def allocated_bytes(path: str) -> int:
     return min(blocks * 512, st.st_size)
 
 
+def dir_bytes(dirpath: str) -> int:
+    """Total bytes actually backed by the volume under ``dirpath``
+    (recursive; 0 for a missing dir).  Uses :func:`allocated_bytes` per
+    file so sparse preallocated transfers report what they really hold —
+    the per-tenant staging-footprint gauge feeds off this."""
+    total = 0
+    try:
+        entries = os.scandir(dirpath)
+    except OSError:
+        return 0
+    with entries:
+        for entry in entries:
+            try:
+                if entry.is_dir(follow_symlinks=False):
+                    total += dir_bytes(entry.path)
+                elif entry.is_file(follow_symlinks=False):
+                    total += allocated_bytes(entry.path)
+            except OSError:
+                continue
+    return total
+
+
 def free_bytes(dirpath: str) -> int:
     """Free bytes on ``dirpath``'s volume; 0 when the path is unstatable
     (callers treat that as "no headroom" rather than crashing)."""

@@ -1,0 +1,483 @@
+"""Kill-based crash-chaos harness (ISSUE 8 tentpole d; ``make crash``).
+
+Each scenario runs a REAL ``python -m downloader_tpu`` worker subprocess
+against a real-wire MiniAmqp broker + MiniS3 staging store + a local
+HTTP origin, SIGKILLs it at a chosen seam — mid-download (bytes already
+on disk), between the staged file and the done marker, pre-ack with
+everything published, and while holding a fleet content lease — then
+restarts it and asserts the crash-safety invariants end to end:
+
+- the job eventually reaches DONE exactly once, and the staged bytes
+  are hash-identical to the origin payload;
+- no orphan workdirs under the download root, no leaked fleet leases;
+- the retry/poison counter survives the restart (monotone, never
+  reset by the redelivery);
+- the restart surfaces a ``recovery`` block on ``/readyz``.
+
+The kill is a true SIGKILL: either a ``kind: crash`` fault-plan rule
+(platform/faults.py) fires ``os.kill(pid, SIGKILL)`` at the seam, or —
+for the mid-transfer case, where no call seam sits inside the splice
+loop — the parent watches the shared filesystem for the ``.partial``
+file and kills the worker while bytes are landing.
+"""
+
+import asyncio
+import base64
+import os
+import signal
+import socket
+import sys
+
+import pytest
+import yaml
+
+from downloader_tpu import schemas
+from downloader_tpu.control.journal import (JOURNAL_DIRNAME,
+                                            JOURNAL_FILENAME, replay)
+from downloader_tpu.store.s3 import S3ObjectStore
+
+from minis3 import MiniS3
+from miniamqp import MiniAmqpServer
+
+pytestmark = pytest.mark.anyio
+
+STAGING = "triton-staging"
+PAYLOAD = bytes(range(256)) * 2048  # 512 KiB, content-checkable
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _object_name(job_id: str, basename: str) -> str:
+    encoded = base64.b64encode(basename.encode()).decode()
+    return f"{job_id}/original/{encoded}"
+
+
+def download_msg(job_id: str, uri: str) -> bytes:
+    return schemas.encode(schemas.Download(media=schemas.Media(
+        id=job_id, creator_id="crash-card",
+        type=schemas.MediaType.Value("MOVIE"),
+        source=schemas.SourceType.Value("HTTP"),
+        source_uri=uri,
+    )))
+
+
+async def start_origin(chunk_delay: float = 0.0):
+    """Streamed origin for ``/show.mkv`` with an ETag (cacheable).
+
+    ``chunk_delay`` > 0 streams the payload in 32 KiB chunks with a
+    pause after each, holding the transfer open long enough for the
+    parent to kill the worker mid-splice.  Returns (runner, url, gets).
+    """
+    from aiohttp import web
+
+    from helpers import start_http_server
+
+    gets = [0]
+
+    async def serve(request):
+        headers = {"ETag": '"crash-etag-1"'}
+        if request.method == "HEAD":
+            return web.Response(headers={
+                **headers, "Content-Length": str(len(PAYLOAD)),
+                "Accept-Ranges": "bytes",
+            })
+        gets[0] += 1
+        if not chunk_delay:
+            return web.Response(body=PAYLOAD, headers=headers)
+        resp = web.StreamResponse(headers={
+            **headers, "Content-Length": str(len(PAYLOAD)),
+        })
+        await resp.prepare(request)
+        for off in range(0, len(PAYLOAD), 32 << 10):
+            await resp.write(PAYLOAD[off:off + (32 << 10)])
+            await asyncio.sleep(chunk_delay)
+        await resp.write_eof()
+        return resp
+
+    runner, base = await start_http_server(serve, path="/show.mkv")
+    return runner, f"{base}/show.mkv", gets
+
+
+class CrashRig:
+    """One scenario's infrastructure: broker + store + config + worker
+    generations.  The broker and store OUTLIVE worker kills — they are
+    the durable world the restarted worker reconciles against."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.downloads = str(tmp_path / "downloads")
+        self.config_dir = str(tmp_path / "config")
+        self.health_port = _free_port()
+        self.amqp = MiniAmqpServer()
+        self.s3 = MiniS3()
+        self.store = None
+        self.proc = None
+        self.generation = 0
+
+    async def start_backends(self) -> None:
+        await self.amqp.start()
+        s3_url = await self.s3.start()
+        self.store = S3ObjectStore(s3_url, "AKIA", "SECRET")
+        # the staging bucket pre-exists (production provisions it; the
+        # fleet coordination store also writes under it at boot)
+        await self.store.make_bucket(STAGING)
+
+    def write_config(self, extra: dict = None) -> None:
+        cfg = {
+            "instance": {"download_path": self.downloads,
+                         "max_concurrent_jobs": 2},
+            "rabbitmq": {"backend": "amqp"},
+            "minio": {"backend": "s3",
+                      "endpoint": f"http://127.0.0.1:{self.s3.port}",
+                      "access_key": "AKIA", "secret_key": "SECRET"},
+            "services": {"rabbitmq": self.amqp.url},
+            # strict per-append durability: the parent reads the journal
+            # file while the worker runs
+            "journal": {"fsync_interval": 0},
+            "retry": {"default": {"attempts": 1, "base": 0.05,
+                                  "cap": 0.1},
+                      "redelivery": {"base": 0.05, "cap": 0.2}},
+        }
+        if extra:
+            for key, value in extra.items():
+                node = cfg.setdefault(key, {})
+                if isinstance(value, dict):
+                    node.update(value)
+                else:
+                    cfg[key] = value
+        os.makedirs(self.config_dir, exist_ok=True)
+        with open(os.path.join(self.config_dir, "converter.yaml"),
+                  "w", encoding="utf-8") as fh:
+            yaml.safe_dump(cfg, fh)
+
+    async def spawn_worker(self, fault_plan: str = "") -> None:
+        """Start a worker generation; blocks until /readyz answers."""
+        self.generation += 1
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("FAULT_PLAN", "PIPELINE_MODE", "CACHE_DIR",
+                            "CACHE_ENABLED", "UPLOAD_CONCURRENCY",
+                            "CONFIG_PATH", "PORT", "WORKER_ID")}
+        env["CONFIG_PATH"] = self.config_dir
+        env["PORT"] = str(self.health_port)
+        env["WORKER_ID"] = "crash-w1"  # stable across restarts
+        if fault_plan:
+            env["FAULT_PLAN"] = fault_plan
+        log = open(os.path.join(str(self.tmp_path),
+                                f"worker-gen{self.generation}.log"), "wb")
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "downloader_tpu",
+                env=env, stdout=log, stderr=log,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+            )
+        finally:
+            log.close()
+        await self._wait_ready()
+
+    async def _wait_ready(self, timeout: float = 30.0) -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with asyncio.timeout(timeout):
+                while True:
+                    if self.proc.returncode is not None:
+                        raise AssertionError(
+                            f"worker gen{self.generation} exited "
+                            f"{self.proc.returncode} before ready "
+                            f"(see worker-gen{self.generation}.log)"
+                        )
+                    try:
+                        async with session.get(self._url("/readyz")) as r:
+                            if r.status == 200:
+                                return
+                    except aiohttp.ClientError:
+                        pass
+                    await asyncio.sleep(0.1)
+
+    def _url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.health_port}{path}"
+
+    async def admin(self, path: str):
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(self._url(path)) as resp:
+                return resp.status, await resp.json()
+
+    async def wait_killed(self, timeout: float = 30.0) -> None:
+        """Block until the fault plan's crash point fires."""
+        async with asyncio.timeout(timeout):
+            await self.proc.wait()
+        assert self.proc.returncode == -signal.SIGKILL
+
+    async def kill_now(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        await self.proc.wait()
+
+    async def wait_job_state(self, job_id: str, state: str,
+                             timeout: float = 30.0) -> dict:
+        async with asyncio.timeout(timeout):
+            while True:
+                status, body = await self.admin(f"/v1/jobs/{job_id}")
+                if status == 200 and body.get("state") == state:
+                    return body
+                await asyncio.sleep(0.1)
+
+    def publish(self, job_id: str, uri: str):
+        """Publish a Download over the real AMQP wire (own connection)."""
+        return self._publish_body(download_msg(job_id, uri))
+
+    async def _publish_body(self, body: bytes) -> None:
+        from downloader_tpu.mq.amqp import AmqpQueue
+
+        queue = AmqpQueue(self.amqp.url, heartbeat=5)
+        await queue.connect()
+        try:
+            await queue.publish(schemas.DOWNLOAD_QUEUE, body)
+        finally:
+            await queue.close()
+
+    # -- invariant helpers ---------------------------------------------
+    @property
+    def journal_path(self) -> str:
+        return os.path.join(self.downloads, JOURNAL_DIRNAME,
+                            JOURNAL_FILENAME)
+
+    def journal_state(self):
+        return replay(self.journal_path)
+
+    def orphan_workdirs(self) -> list:
+        try:
+            entries = os.listdir(self.downloads)
+        except OSError:
+            return []
+        return [e for e in entries if not e.startswith(".")
+                and os.path.isdir(os.path.join(self.downloads, e))]
+
+    async def staged_bytes(self, job_id: str) -> bytes:
+        return await self.store.get_object(
+            STAGING, _object_name(job_id, "show.mkv"))
+
+    async def assert_staged_ok(self, job_id: str) -> None:
+        assert await self.staged_bytes(job_id) == PAYLOAD
+        assert await self.store.get_object(
+            STAGING, f"{job_id}/original/done") == b"true"
+
+    async def live_leases(self) -> list:
+        """Lease keys whose coordination doc is LIVE (a delete leaves a
+        tombstone object behind until the fleet GC sweeps it — liveness
+        resolves through the coord store's get, like real readers)."""
+        from downloader_tpu.fleet.coord import BucketCoordStore
+
+        coord = BucketCoordStore(self.store, STAGING)
+        out = []
+        async for info in self.store.list_objects(STAGING,
+                                                  ".fleet/leases/"):
+            key = info.name[len(".fleet/"):]
+            if await coord.get(key) is not None:
+                out.append(info.name)
+        return out
+
+    async def stop(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.send_signal(signal.SIGKILL)
+            await self.proc.wait()
+        if self.store is not None:
+            await self.store.close()
+        await self.s3.stop()
+        await self.amqp.stop()
+
+
+async def test_sigkill_mid_download_then_restart_completes(tmp_path):
+    """Kill the worker while origin bytes are landing in ``.partial``:
+    the restart keeps the resumable workdir, the redelivery adopts the
+    journal placeholder, and the job finishes with staged bytes
+    hash-identical to the origin."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, _gets = await start_origin(chunk_delay=0.15)
+    try:
+        rig.write_config()
+        await rig.spawn_worker()
+        await rig.publish("crash-dl", uri)
+
+        partial = os.path.join(rig.downloads, "crash-dl",
+                               "show.mkv.partial")
+        async with asyncio.timeout(20):
+            while not (os.path.exists(partial)
+                       and os.path.getsize(partial) > 0):
+                await asyncio.sleep(0.02)
+        await rig.kill_now()  # SIGKILL with the transfer mid-flight
+
+        # the torn world: journal knows the job, workdir holds .partial
+        state = rig.journal_state()
+        assert "crash-dl" in state.live()
+        assert rig.orphan_workdirs() == ["crash-dl"]
+
+        await rig.spawn_worker()  # no fault plan: clean second life
+        _status, ready = await rig.admin("/readyz")
+        recovery = ready.get("recovery") or {}
+        assert recovery.get("recoveredJobs", 0) >= 1
+        assert recovery.get("resumableWorkdirs", 0) >= 1
+
+        body = await rig.wait_job_state("crash-dl", "DONE")
+        assert body.get("recovered") is True
+        await rig.assert_staged_ok("crash-dl")
+        assert rig.orphan_workdirs() == []
+        final = rig.journal_state().jobs.get("crash-dl")
+        assert final is not None and final.state == "DONE"
+        assert final.settle == "ack"
+    finally:
+        await rig.stop()
+        await origin.cleanup()
+
+
+async def test_sigkill_between_file_and_done_marker(tmp_path):
+    """Crash point ``store.put`` after=1: the media file is staged, the
+    done marker is not — the exact torn-publish window the manifest
+    guards.  The restarted attempt resumes (no second byte upload),
+    verifies the set, seals it, and settles DONE."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, gets = await start_origin()
+    try:
+        rig.write_config()
+        await rig.spawn_worker(fault_plan=(
+            '[{"seam": "store.put", "kind": "crash", "after": 1,'
+            ' "count": 1}]'
+        ))
+        await rig.publish("crash-seal", uri)
+        await rig.wait_killed()
+
+        # torn state: bytes staged, set NOT sealed
+        assert await rig.staged_bytes("crash-seal") == PAYLOAD
+        with pytest.raises(Exception):
+            await rig.store.get_object(STAGING,
+                                       "crash-seal/original/done")
+
+        await rig.spawn_worker()
+        await rig.wait_job_state("crash-seal", "DONE")
+        await rig.assert_staged_ok("crash-seal")
+        assert rig.orphan_workdirs() == []
+        assert gets[0] >= 1
+    finally:
+        await rig.stop()
+        await origin.cleanup()
+
+
+async def test_sigkill_pre_ack_idempotent_redelivery(tmp_path):
+    """Crash point ``settle.ack``: everything staged and published, the
+    delivery never settled.  The broker redelivers; the restarted
+    worker's idempotency probe (done marker) skips the stages and the
+    job settles DONE without re-staging a byte."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, gets = await start_origin()
+    try:
+        rig.write_config()
+        await rig.spawn_worker(fault_plan=(
+            '[{"seam": "settle.ack", "kind": "crash", "count": 1}]'
+        ))
+        await rig.publish("crash-ack", uri)
+        await rig.wait_killed()
+
+        # fully staged and sealed — only the ack is missing
+        await rig.assert_staged_ok("crash-ack")
+        state = rig.journal_state()
+        assert state.jobs["crash-ack"].settle is None  # never settled
+
+        origin_gets_before = gets[0]
+        await rig.spawn_worker()
+        body = await rig.wait_job_state("crash-ack", "DONE")
+        assert body.get("recovered") is True
+        await rig.assert_staged_ok("crash-ack")
+        assert gets[0] == origin_gets_before  # idempotent skip: no refetch
+        assert rig.orphan_workdirs() == []
+    finally:
+        await rig.stop()
+        await origin.cleanup()
+
+
+async def test_retry_counter_survives_sigkill(tmp_path):
+    """An attempt fails (counter = 1, journaled), the NEXT attempt is
+    SIGKILLed mid-upload: after the restart the placeholder carries the
+    restored counter — monotone across the crash, never reset by the
+    redelivery — and the job still completes."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, _gets = await start_origin()
+    try:
+        rig.write_config()
+        await rig.spawn_worker(fault_plan=(
+            '[{"seam": "store.put", "kind": "error", "count": 1,'
+            ' "fault": "transient"},'
+            ' {"seam": "store.put", "kind": "crash", "after": 1,'
+            ' "count": 1}]'
+        ))
+        await rig.publish("crash-retry", uri)
+        await rig.wait_killed()
+
+        # the pre-crash journal carries the first attempt's failure
+        state = rig.journal_state()
+        assert state.jobs["crash-retry"].failures == 1
+
+        await rig.spawn_worker()
+        body = await rig.wait_job_state("crash-retry", "DONE")
+        assert body.get("recovered") is True
+        await rig.assert_staged_ok("crash-retry")
+        # monotone: the boot compaction snapshot preserved failures=1
+        # (DONE then cleared it — never a reset to 0 mid-history)
+        with open(rig.journal_path, "r", encoding="utf-8") as fh:
+            first = fh.readline()
+        assert '"failures":1' in first
+        assert rig.orphan_workdirs() == []
+    finally:
+        await rig.stop()
+        await origin.cleanup()
+
+
+async def test_sigkill_lease_holder_restart_reclaims(tmp_path):
+    """Fleet enabled (bucket coordination on the staging bucket): the
+    worker is killed at the fetch seam while HOLDING the content lease.
+    The restarted worker (same WORKER_ID) reclaims its orphan lease at
+    boot — far before the 120 s TTL — and the job completes with zero
+    leases left behind."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, _gets = await start_origin()
+    try:
+        rig.write_config(extra={
+            "instance": {"download_path": rig.downloads,
+                         "max_concurrent_jobs": 2,
+                         "cache": {"enabled": True}},
+            "fleet": {"enabled": True, "backend": "bucket",
+                      "lease_ttl": 120.0, "heartbeat_interval": 1.0,
+                      "liveness_ttl": 5.0},
+        })
+        await rig.spawn_worker(fault_plan=(
+            '[{"seam": "http.fetch", "kind": "crash", "count": 1}]'
+        ))
+        await rig.publish("crash-lease", uri)
+        await rig.wait_killed()
+
+        # the dead worker's lease doc survives it (TTL far away)
+        leases = await rig.live_leases()
+        assert len(leases) == 1
+
+        await rig.spawn_worker()
+        _status, ready = await rig.admin("/readyz")
+        recovery = ready.get("recovery") or {}
+        assert recovery.get("reclaimedLeases", 0) == 1
+
+        await rig.wait_job_state("crash-lease", "DONE")
+        await rig.assert_staged_ok("crash-lease")
+        assert await rig.live_leases() == []  # nothing leaked
+        assert rig.orphan_workdirs() == []
+    finally:
+        await rig.stop()
+        await origin.cleanup()

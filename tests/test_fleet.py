@@ -409,14 +409,17 @@ async def test_restarted_worker_reclaims_its_own_lease(
     uri, gets = hot_origin
     key = cache_key("http", uri, ETAG)
     coord = MemoryCoordStore()
-    # "previous life" of worker-own: far-from-expired, never renewed
-    await coord.put(LEASES_PREFIX + key, {
-        "owner": "worker-own", "fence": 3,
-        "acquiredAt": time.time(), "expiresAt": time.time() + 300,
-    })
     broker = InMemoryBroker()
     worker = await make_worker(tmp_path, broker, InMemoryObjectStore(),
                                "own", coord)
+    # orphan appears AFTER boot (the startup reconciliation sweep —
+    # control/journal.py — reclaims pre-existing ones before the first
+    # delivery; this exercises the acquire-time fallback): far from
+    # expired, never renewed, owned by our id but not held
+    await coord.put(LEASES_PREFIX + key, {
+        "owner": worker.fleet.worker_id, "fence": 3,
+        "acquiredAt": time.time(), "expiresAt": time.time() + 300,
+    })
     try:
         started = time.monotonic()
         broker.publish(schemas.DOWNLOAD_QUEUE, make_download_msg(uri, "own-1"))

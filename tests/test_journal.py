@@ -1,0 +1,491 @@
+"""Crash-safe durability layer tests (control/journal.py; ISSUE 8).
+
+Journal mechanics (append/replay/torn-line/compaction) plus the
+in-process half of the recovery story: startup reconciliation opens
+PARKED placeholders and restores retry counters, the orphan sweep is
+journal-authoritative, redeliveries adopt their placeholder (same
+record, same cancel token), and a cancel landing during the replay
+window settles the eventual redelivery — mirroring PR 7's
+cancel-while-PARKED suite.  The subprocess SIGKILL scenarios live in
+tests/test_crash.py.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+from helpers import start_media_server
+
+from downloader_tpu import schemas
+from downloader_tpu.control.journal import (JobJournal, RecoveredJob,
+                                            recovery_counters, replay)
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# Journal mechanics
+# ---------------------------------------------------------------------------
+
+def make_journal(tmp_path, **kwargs) -> JobJournal:
+    return JobJournal(str(tmp_path / ".journal" / "journal.jsonl"),
+                      fsync_interval=0, **kwargs)
+
+
+def test_replay_rebuilds_lifecycle(tmp_path):
+    journal = make_journal(tmp_path)
+    journal.append("open", "j1", fileId="card-1", priority="HIGH",
+                   tenant="acme", ttl=30.0)
+    journal.append("state", "j1", state="RUNNING", stage="pipeline")
+    journal.append("retry", "j1", failures=1)
+    journal.append("open", "j2", fileId="card-2", priority="NORMAL")
+    journal.append("state", "j2", state="DONE")
+    journal.append("settle", "j2", mode="ack", why="done")
+    journal.close()
+
+    state = replay(journal.path)
+    assert state.torn_lines == 0
+    j1 = state.jobs["j1"]
+    assert (j1.priority, j1.tenant, j1.ttl_seconds) == ("HIGH", "acme", 30.0)
+    assert j1.state == "RUNNING" and j1.failures == 1
+    assert j1.redelivery_expected  # never settled: the broker owes one
+    j2 = state.jobs["j2"]
+    assert j2.terminal and j2.settle == "ack"
+    assert not j2.redelivery_expected
+    # the recovery set is exactly the jobs still owed a delivery
+    assert set(state.live()) == {"j1"}
+    assert recovery_counters(state) == {"j1": 1}
+
+
+def test_replay_tolerates_torn_final_line(tmp_path):
+    journal = make_journal(tmp_path)
+    journal.append("open", "j1", fileId="card-1")
+    journal.close()
+    with open(journal.path, "a", encoding="utf-8") as fh:
+        fh.write('{"op": "state", "id": "j1", "sta')  # crash mid-write
+
+    state = replay(journal.path)
+    assert state.torn_lines == 1
+    assert state.jobs["j1"].state == "RECEIVED"  # prefix replayed fine
+
+
+def test_redelivery_open_preserves_poison_counter(tmp_path):
+    """A fresh delivery's open resets per-attempt state but NOT the
+    failures counter — the counter spans redeliveries by design."""
+    journal = make_journal(tmp_path)
+    journal.append("open", "j1", fileId="card-1")
+    journal.append("retry", "j1", failures=2)
+    journal.append("settle", "j1", mode="nack", why="stage_error")
+    journal.append("open", "j1", fileId="card-1")  # the redelivery
+    journal.close()
+
+    job = replay(journal.path).jobs["j1"]
+    assert job.failures == 2
+    assert job.settle is None  # the new attempt has not settled
+
+
+def test_compaction_keeps_live_drops_settled(tmp_path):
+    journal = make_journal(tmp_path)
+    journal.append("open", "live-1", fileId="c")
+    journal.append("retry", "live-1", failures=1)
+    for i in range(50):
+        journal.append("open", f"done-{i}", fileId="c")
+        journal.append("state", f"done-{i}", state="DONE")
+        journal.append("settle", f"done-{i}", mode="ack", why="done")
+
+    journal.compact(journal.replay())
+    with open(journal.path, "r", encoding="utf-8") as fh:
+        lines = [json.loads(line) for line in fh if line.strip()]
+    assert len(lines) == 1 and lines[0]["op"] == "snapshot"
+
+    state = replay(journal.path)
+    assert set(state.live()) == {"live-1"}
+    assert state.jobs["live-1"].failures == 1
+    # appends continue on the compacted file
+    journal.append("state", "live-1", state="RUNNING", stage="download")
+    journal.close()
+    assert replay(journal.path).jobs["live-1"].state == "RUNNING"
+
+
+def test_maybe_compact_bounds_growth(tmp_path):
+    journal = make_journal(tmp_path, max_bytes=1 << 16)
+    for i in range(600):
+        journal.append("open", f"j{i}", fileId="c")
+        journal.append("state", f"j{i}", state="DONE")
+        journal.append("settle", f"j{i}", mode="ack", why="done")
+    assert journal.maybe_compact()
+    assert journal.size_bytes < 1 << 16
+    assert replay(journal.path).live() == {}
+    journal.close()
+
+
+def test_snapshot_roundtrip():
+    job = RecoveredJob(job_id="j", file_id="f", priority="BULK",
+                      tenant="t", ttl_seconds=5.0, state="PARKED",
+                      stage="download", reason="r", failures=3,
+                      settle="nack", updated_at="2026-01-01T00:00:00Z")
+    assert RecoveredJob.from_snapshot(job.to_snapshot()) == job
+
+
+# ---------------------------------------------------------------------------
+# Startup reconciliation (orchestrator._recover)
+# ---------------------------------------------------------------------------
+
+def make_download_msg(uri: str, job_id: str) -> bytes:
+    return schemas.encode(schemas.Download(media=schemas.Media(
+        id=job_id, creator_id="card-1", name="A Show",
+        type=schemas.MediaType.Value("MOVIE"),
+        source=schemas.SourceType.Value("HTTP"),
+        source_uri=uri,
+    )))
+
+
+async def make_orchestrator(tmp_path, broker, store, extra=None):
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")},
+        "retry": {"default": {"attempts": 1, "base": 0.01, "cap": 0.05},
+                  "redelivery": {"base": 0.01, "cap": 0.05}},
+        **(extra or {}),
+    })
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=config,
+        mq=MemoryQueue(broker),
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"jnl{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    return orchestrator
+
+
+def seed_journal(tmp_path, job_id, failures=0, settled=None):
+    """Pre-write the journal a dead incarnation would have left."""
+    downloads = tmp_path / "downloads"
+    journal = JobJournal(str(downloads / ".journal" / "journal.jsonl"),
+                        fsync_interval=0)
+    journal.append("open", job_id, fileId="card-1", priority="NORMAL",
+                   tenant="default", ttl=0.0)
+    journal.append("state", job_id, state="RUNNING", stage="pipeline")
+    if failures:
+        journal.append("retry", job_id, failures=failures)
+    if settled:
+        journal.append("settle", job_id, mode=settled, why="test")
+    journal.close()
+    return downloads
+
+
+async def test_recovery_opens_placeholder_and_restores_counter(tmp_path):
+    downloads = seed_journal(tmp_path, "re-1", failures=2)
+    # resumable workdir from the dead attempt + an orphan nobody owns
+    (downloads / "re-1").mkdir(parents=True)
+    (downloads / "re-1" / "show.mkv.partial").write_bytes(b"half")
+    (downloads / "zombie").mkdir()
+    (downloads / "zombie" / "junk.bin").write_bytes(b"x" * 64)
+
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore())
+    try:
+        record = orchestrator.registry.get("re-1")
+        assert record is not None and record.state == "PARKED"
+        assert record.recovered is True
+        assert record.reason.startswith("recovered")
+        assert orchestrator._failure_counts["re-1"] == 2
+        # sweep: resumable workdir kept, orphan gone
+        assert (downloads / "re-1" / "show.mkv.partial").exists()
+        assert not (downloads / "zombie").exists()
+        recovery = orchestrator.recovery
+        assert recovery["recoveredJobs"] == 1
+        assert recovery["restoredRetryCounters"] == 1
+        assert recovery["sweptWorkdirs"] == 1
+        assert recovery["resumableWorkdirs"] == 1
+        # boot compaction: the journal restarts as one snapshot line
+        orchestrator.journal.flush()  # beat the batched-fsync window
+        with open(orchestrator.journal.path, "r", encoding="utf-8") as fh:
+            lines = [json.loads(l) for l in fh if l.strip()]
+        assert lines[0]["op"] == "snapshot"
+        assert lines[0]["jobs"][0]["failures"] == 2
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_redelivery_adopts_placeholder_and_completes(tmp_path):
+    seed_journal(tmp_path, "re-2", failures=1)
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        placeholder = orchestrator.registry.get("re-2")
+        token_before = placeholder.cancel
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "re-2"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        record = orchestrator.registry.get("re-2")
+        assert record is placeholder  # SAME record: one story, two lives
+        assert record.cancel is token_before
+        assert record.state == "DONE"
+        assert record.recovered is True
+        assert record.to_dict()["recovered"] is True
+        kinds = [e["kind"] for e in record.recorder.events()]
+        assert "recovered" in kinds
+        assert "redelivered_after_recovery" in kinds
+        assert await store.get_object(
+            STAGING_BUCKET, object_name("re-2", "show.mkv")) == b"V" * 4096
+        # success cleared the restored counter
+        assert "re-2" not in orchestrator._failure_counts
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_restored_counter_feeds_poison_budget(tmp_path):
+    """A job that failed twice before the crash is on its final strike
+    after it: the restored counter + one more failure crosses the
+    poison threshold — the redelivery cannot start the budget over."""
+    seed_journal(tmp_path, "re-3", failures=2)
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker(max_redeliveries=10)
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"faults": {"plan": [
+            {"seam": "store.put", "kind": "error", "fault": "transient"},
+        ]}})
+    try:
+        assert orchestrator.poison_threshold == 5
+        # counters 3,4,5 accumulate across these redeliveries
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "re-3"))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        record = orchestrator.registry.get("re-3")
+        assert record.state == "DROPPED_POISON"
+        # the budget CONTINUED from the restored 2: three deliveries
+        # reached the threshold of 5.  Every delivery journals an "open"
+        # — the adopted one refreshes the placeholder's identity from
+        # the wire — and an open on a live job NEVER resets failures
+        orchestrator.journal.flush()  # beat the batched-fsync window
+        with open(orchestrator.journal.path, "r", encoding="utf-8") as fh:
+            lines = [json.loads(l) for l in fh if l.strip()]
+        opens = [l for l in lines if l.get("op") == "open"
+                 and l.get("id") == "re-3"]
+        assert len(opens) == 3
+        final = [l for l in lines if l.get("op") == "retry"
+                 and l.get("id") == "re-3"][-1]
+        assert final["failures"] == 5
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_cancel_during_reconciliation_window(tmp_path):
+    """ISSUE 8 satellite: cancel arrives while the recovered job is
+    still PARKED awaiting its redelivery -> CANCELLED, workdir gone, and
+    the redelivery (when it lands) is settled as cancelled instead of
+    silently re-running — with no slot leak for later jobs."""
+    downloads = seed_journal(tmp_path, "re-c")
+    (downloads / "re-c").mkdir(parents=True)
+    (downloads / "re-c" / "show.mkv.partial").write_bytes(b"half")
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        assert orchestrator.registry.get("re-c").state == "PARKED"
+        cancelled = orchestrator.registry.cancel("re-c", reason="operator")
+        assert cancelled
+        record = orchestrator.registry.get("re-c")
+        async with asyncio.timeout(5):
+            while record.state != "CANCELLED":
+                await asyncio.sleep(0.01)
+        assert not (downloads / "re-c").exists()
+
+        # the redelivery lands AFTER the cancel settled the placeholder:
+        # acked as cancelled, nothing staged
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "re-c"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("re-c").state == "CANCELLED"
+        assert STAGING_BUCKET not in store._buckets or not any(
+            name.startswith("re-c/")
+            for name in store._buckets[STAGING_BUCKET])
+
+        # no slot leak: an unrelated job still runs to DONE
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "fresh-1"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("fresh-1").state == "DONE"
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_cancel_survives_second_restart(tmp_path):
+    """The cancelled placeholder's CANCELLED transition is journaled, so
+    ANOTHER restart before the redelivery arrives replays it as a
+    cancel tombstone — never as a fresh run placeholder that would
+    silently resurrect an operator-cancelled job."""
+    downloads = seed_journal(tmp_path, "re-z")
+    (downloads / "re-z").mkdir(parents=True)
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    first = await make_orchestrator(tmp_path, broker, store)
+    try:
+        assert first.registry.get("re-z").state == "PARKED"
+        assert first.registry.cancel("re-z", reason="operator")
+        record = first.registry.get("re-z")
+        async with asyncio.timeout(5):
+            while record.state != "CANCELLED":
+                await asyncio.sleep(0.01)
+    finally:
+        await first.shutdown(grace_seconds=2)
+
+    # the second life over the same journal: no run placeholder, and
+    # the redelivery settles as cancelled on arrival — nothing staged
+    second = await make_orchestrator(tmp_path, broker, store)
+    try:
+        assert second.recovery["recoveredJobs"] == 1
+        assert second.registry.get("re-z") is None
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "re-z"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        record = second.registry.get("re-z")
+        assert record is not None and record.state == "CANCELLED"
+        assert record.recovered is True
+        assert STAGING_BUCKET not in store._buckets or not any(
+            name.startswith("re-z/")
+            for name in store._buckets[STAGING_BUCKET])
+    finally:
+        await second.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_expired_cancel_tombstone_is_retired(tmp_path):
+    """A cancelled placeholder whose redelivery never arrives
+    (dead-lettered, message TTL, queue purge) must not replay — and
+    re-count — on every boot forever: past ``journal.tombstone_ttl``
+    the boot retires it from the journal, and a delivery for the same
+    id thereafter runs as a fresh job."""
+    downloads = seed_journal(tmp_path, "re-t")
+    journal = JobJournal(str(downloads / ".journal" / "journal.jsonl"),
+                         fsync_interval=0)
+    journal.append("state", "re-t", state="CANCELLED", reason="operator")
+    journal.close()
+    await asyncio.sleep(0.2)
+
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store,
+        extra={"journal": {"tombstone_ttl": 0.05}})
+    try:
+        # retired: no placeholder, no tombstone — the boot compaction's
+        # snapshot no longer carries the job
+        assert orchestrator.registry.get("re-t") is None
+        orchestrator.journal.flush()
+        with open(orchestrator.journal.path, "r", encoding="utf-8") as fh:
+            lines = [json.loads(l) for l in fh if l.strip()]
+        assert lines[0]["op"] == "snapshot" and lines[0]["jobs"] == []
+
+        # the cancel decision aged out with the tombstone: a delivery
+        # for the same id now runs as a brand-new job
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "re-t"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("re-t").state == "DONE"
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_expired_placeholder_workdir_is_swept(tmp_path):
+    """A placeholder retired as ``recovery_expired`` (its redelivery
+    never came for a full tombstone_ttl) must not keep its workdir: the
+    boot that declared the job a ghost sweeps its partial state too,
+    instead of leaking the directory for the process lifetime."""
+    downloads = tmp_path / "downloads"
+    journal = JobJournal(str(downloads / ".journal" / "journal.jsonl"),
+                         fsync_interval=0)
+    # a placeholder re-opened by an EARLIER boot: recoveredAt far past
+    # any tombstone_ttl, delivery never settled
+    journal.append("open", "re-g", fileId="card-1", priority="NORMAL",
+                   tenant="default", ttl=0.0,
+                   recoveredAt="2020-01-01T00:00:00.000Z")
+    journal.append("state", "re-g", state="PARKED",
+                   reason="recovered: awaiting redelivery")
+    journal.close()
+    (downloads / "re-g").mkdir(parents=True)
+    (downloads / "re-g" / "show.mkv.partial").write_bytes(b"half")
+
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"journal": {"tombstone_ttl": 0.05}})
+    try:
+        assert orchestrator.registry.get("re-g") is None
+        assert not (downloads / "re-g").exists()
+        recovery = orchestrator.recovery
+        assert recovery["sweptWorkdirs"] == 1
+        assert recovery["resumableWorkdirs"] == 0
+        # retired from the journal too: the boot snapshot is empty
+        orchestrator.journal.flush()
+        with open(orchestrator.journal.path, "r", encoding="utf-8") as fh:
+            lines = [json.loads(l) for l in fh if l.strip()]
+        assert lines[0]["op"] == "snapshot" and lines[0]["jobs"] == []
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_journal_disabled_is_exact_legacy(tmp_path):
+    """``journal.enabled: false`` restores the pre-journal worker: no
+    .journal dir, no recovery block, jobs run exactly as before."""
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"journal": {"enabled": False}})
+    try:
+        assert orchestrator.journal is None
+        assert orchestrator.recovery is None
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "plain-1"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        assert orchestrator.registry.get("plain-1").state == "DONE"
+        assert not (tmp_path / "downloads" / ".journal").exists()
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+async def test_registry_transitions_feed_journal(tmp_path):
+    """The live registry journals every lifecycle move: after a normal
+    DONE job, replay shows the full story settled."""
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore())
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/show.mkv", "jj-1"))
+        await broker.join(schemas.DOWNLOAD_QUEUE)
+        state = orchestrator.journal.replay()
+        job = state.jobs["jj-1"]
+        assert job.state == "DONE" and job.settle == "ack"
+        assert state.live() == {}  # nothing owed after a clean DONE
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()

@@ -1,0 +1,144 @@
+"""Staged-artifact integrity tests (stages/manifest.py; ISSUE 8).
+
+The per-job content manifest and its pre-seal verification: entries
+record what landed, ``verify_staged`` re-stats the authoritative set
+and raises :class:`StagedSetMismatch` on divergence.  The end-to-end
+torn-publish scenario (SIGKILL between a staged file and the done
+marker) lives in tests/test_crash.py; these are the unit semantics,
+including the degrade contracts: etag-less backends verify size-only,
+and a backend whose stat fails for reasons OTHER than ObjectNotFound
+(write-only credentials, outage) makes the object unverifiable instead
+of failing a staging set the put path already proved landed.
+"""
+
+import hashlib
+
+import pytest
+
+from downloader_tpu.platform.errors import TRANSIENT
+from downloader_tpu.stages.manifest import JobManifest, StagedSetMismatch
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store.base import ObjectInfo
+
+pytestmark = pytest.mark.anyio
+
+
+def write_file(tmp_path, name: str, data: bytes) -> str:
+    path = tmp_path / name
+    path.write_bytes(data)
+    return str(path)
+
+
+async def stage(store, media_id: str, file_path: str, data: bytes,
+                manifest: JobManifest) -> str:
+    name = object_name(media_id, file_path)
+    await store.put_object(STAGING_BUCKET, name, data)
+    manifest.note(name, size=len(data),
+                  etag=hashlib.md5(data).hexdigest(), file=file_path)
+    return name
+
+
+async def test_verified_set_passes(tmp_path):
+    store = InMemoryObjectStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    files = []
+    for i in range(3):
+        data = b"payload-%d" % i
+        path = write_file(tmp_path, f"f{i}.mkv", data)
+        await stage(store, "m1", path, data, manifest)
+        files.append(path)
+
+    verified, unverifiable = await manifest.verify_staged(
+        store, STAGING_BUCKET, files, object_name)
+    assert (verified, unverifiable) == (3, 0)
+
+
+async def test_missing_object_is_mismatch(tmp_path):
+    store = InMemoryObjectStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    path = write_file(tmp_path, "f.mkv", b"payload")
+    name = await stage(store, "m1", path, b"payload", manifest)
+    await store.remove_object(STAGING_BUCKET, name)
+
+    with pytest.raises(StagedSetMismatch) as exc:
+        await manifest.verify_staged(store, STAGING_BUCKET, [path],
+                                     object_name)
+    assert "missing from store" in str(exc.value)
+    assert exc.value.fault_class is TRANSIENT
+
+
+async def test_short_set_is_mismatch(tmp_path):
+    """A file the walk lists but the crash beat to the store: no
+    manifest entry, no object — the torn window the marker must not
+    seal."""
+    store = InMemoryObjectStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    staged = write_file(tmp_path, "a.mkv", b"landed")
+    await stage(store, "m1", staged, b"landed", manifest)
+    never_staged = write_file(tmp_path, "b.mkv", b"lost")
+
+    with pytest.raises(StagedSetMismatch) as exc:
+        await manifest.verify_staged(store, STAGING_BUCKET,
+                                     [staged, never_staged], object_name)
+    assert "no manifest entry" in str(exc.value)
+
+
+async def test_diverged_content_is_mismatch(tmp_path):
+    """A same-size rewrite by a buggy peer between upload and seal:
+    size matches, etag does not."""
+    store = InMemoryObjectStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    path = write_file(tmp_path, "f.mkv", b"payload")
+    name = await stage(store, "m1", path, b"payload", manifest)
+    await store.put_object(STAGING_BUCKET, name, b"tampere")
+
+    with pytest.raises(StagedSetMismatch) as exc:
+        await manifest.verify_staged(store, STAGING_BUCKET, [path],
+                                     object_name)
+    assert "etag" in str(exc.value)
+
+
+async def test_etagless_backend_verifies_size_only(tmp_path):
+    store = InMemoryObjectStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    path = write_file(tmp_path, "f.mkv", b"payload")
+    name = object_name("m1", path)
+    await store.put_object(STAGING_BUCKET, name, b"payload")
+    manifest.note(name, size=7, etag="", file=path)
+
+    verified, unverifiable = await manifest.verify_staged(
+        store, STAGING_BUCKET, [path], object_name)
+    assert (verified, unverifiable) == (1, 0)
+
+
+async def test_unstattable_backend_degrades_not_fails(tmp_path):
+    """stat failing for any reason but ObjectNotFound (write-only
+    credentials answering 403, a store outage at verify time) must not
+    raise: before this contract such backends could never pass
+    verification and every attempt burned the poison budget."""
+
+    class WriteOnlyStore(InMemoryObjectStore):
+        async def stat_object(self, bucket, name) -> ObjectInfo:
+            raise PermissionError("HEAD forbidden")
+
+    store = WriteOnlyStore()
+    manifest = JobManifest(str(tmp_path), "m1")
+    path = write_file(tmp_path, "f.mkv", b"payload")
+    await stage(store, "m1", path, b"payload", manifest)
+
+    verified, unverifiable = await manifest.verify_staged(
+        store, STAGING_BUCKET, [path], object_name)
+    assert (verified, unverifiable) == (0, 1)
+
+
+async def test_persist_and_load_roundtrip(tmp_path):
+    manifest = JobManifest(str(tmp_path), "m1")
+    manifest.note("m1/original/YQ==", size=7, etag="abc", file="a.mkv")
+    manifest.persist()
+
+    again = JobManifest.load(str(tmp_path), "m1")
+    assert again.entries == manifest.entries
+    # a different job's manifest never bleeds in
+    other = JobManifest.load(str(tmp_path), "m2")
+    assert other.entries == {}

@@ -100,7 +100,10 @@ def test_field_numbers_frozen():
         # and old producers are untouched)
         "Download": {"media": 1, "created_at": 2, "priority": 3,
                      "tenant": 4, "ttl_seconds": 5},
-        "Convert": {"created_at": 1, "media": 2},
+        # deadline_seconds=3 added by the crash-durability PR (additive:
+        # absent/0 = no deadline, old consumers decode golden bytes
+        # identically)
+        "Convert": {"created_at": 1, "media": 2, "deadline_seconds": 3},
     }
     for message_name, fields in expected.items():
         descriptor = getattr(schemas, message_name).DESCRIPTOR
