@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash chaos crash fleet proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs chaos crash fleet obs proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -30,6 +30,13 @@ crash:
 fleet:
 	python -m pytest tests/test_fleet.py -v
 
+# observability suite: flight recorder + runtime introspection
+# (test_obs) plus the fleet-wide trace/RED/hop-ledger layer
+# (test_trace: 3-worker trace assembly, degraded-mode local-only view,
+# RED histogram seams, hop-ledger attribution)
+obs:
+	python -m pytest tests/test_obs.py tests/test_trace.py -v
+
 lint:
 	python -m pytest tests/test_lint.py -q
 
@@ -57,6 +64,13 @@ bench-fairness:
 # recovered job DONE through a real worker subprocess)
 bench-crash:
 	python bench.py --crash
+
+# standalone fleet-observability bench (one JSON line: hop-ledger and
+# trace-propagation A-B overheads must each stay < 1 ms/job;
+# hop_ledger_coverage = summed hop seconds / stage wall on a real
+# end-to-end job, must stay within 5% of 1.0)
+bench-obs:
+	python bench.py --obs
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

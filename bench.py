@@ -130,7 +130,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  reconciliation + redelivery + resumed staging, end to end).
 #  ``python bench.py --crash`` runs this workload standalone
 #  (`make bench-crash`).
-HARNESS_VERSION = 15
+# v16 (r14): fleet-observability workload — hop_ledger_overhead_ms: the
+#  per-job cost of the hop ledger's hot-loop traffic (256 per-chunk
+#  note_hop calls + the settle summary), measured as the enabled-minus-
+#  disabled A-B (obs.hop_ledger), guard < 1 ms/job;
+#  trace_overhead_ms: the per-job cost of cross-worker trace
+#  propagation (lease trace context build + settle digest build +
+#  coordination-store publish), same A-B discipline
+#  (fleet.telemetry_ttl 0 vs on), guard < 1 ms/job;
+#  hop_ledger_coverage: end-to-end barrier job over loopback HTTP +
+#  real-wire MiniS3 — summed hop seconds / summed stage wall, guard
+#  within 5% (the ledger must account for the wall it claims to
+#  attribute).  ``python bench.py --obs`` runs standalone
+#  (`make bench-obs`).
+HARNESS_VERSION = 16
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -1938,6 +1951,164 @@ def _bench_torrent_safe() -> dict:
         return {"torrent_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_obs() -> dict:
+    """Fleet-observability microbenches (harness v16).
+
+    - ``hop_ledger_overhead_ms``: the per-job cost of the hop ledger's
+      explicit hot-loop traffic — 256 per-chunk ``note_hop`` calls
+      (128 ingress chunks x read+write) plus the hash/filter/upload
+      notes and the settle summary — measured as enabled minus disabled
+      (the ``obs.hop_ledger`` A-B); guard < 1 ms/job.
+    - ``trace_overhead_ms``: the per-job cost of cross-worker trace
+      propagation — the lease trace-context build, the settle digest
+      build, and its coordination-store publish — measured as
+      telemetry-on minus telemetry-off against a MemoryCoordStore;
+      guard < 1 ms/job.
+    - ``hop_ledger_coverage``: one end-to-end barrier job (48 MiB over
+      loopback HTTP into a real-wire MiniS3) — summed hop seconds over
+      summed stage wall.  Guard: within 5% (0.95..1.05) — the ledger
+      must account for the wall it claims to attribute.
+    """
+    import sys as _sys
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.control.registry import ADMITTED, DONE, JobRegistry
+    from downloader_tpu.fleet.plane import FleetPlane, MemoryCoordStore
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store.s3 import S3ObjectStore
+
+    jobs = 2000
+    chunk = 1 << 20
+
+    # -- hop-ledger overhead (enabled minus disabled) -------------------
+    def _hop_walk(registry: JobRegistry) -> float:
+        record = registry.register("hop-bench", "card")
+        t0 = time.perf_counter()
+        for _ in range(jobs):
+            for _chunk in range(128):
+                record.note_hop("socket_read", chunk, 0.0001)
+                record.note_hop("disk_write", chunk, 0.0001)
+            record.note_hop("hash", 128 * chunk, 0.001)
+            record.note_hop("filter", 0, 0.0001)
+            record.note_hop("upload", 128 * chunk, 0.01)
+            if record.hops is not None:
+                record.hops.summary()
+        return (time.perf_counter() - t0) * 1000.0 / jobs
+
+    enabled_ms = _hop_walk(JobRegistry(hop_ledger=True))
+    disabled_ms = _hop_walk(JobRegistry(hop_ledger=False))
+    hop_ms = max(enabled_ms - disabled_ms, 0.0)
+
+    # -- trace-propagation overhead (telemetry on minus off) ------------
+    def _traced_record(registry: JobRegistry, tag: str):
+        record = registry.register(f"trace-bench-{tag}", "card")
+        record.trace_id = os.urandom(16).hex()
+        record.span_id = os.urandom(8).hex()
+        for i in range(24):  # a realistic settled timeline
+            record.event("throughput", stage="pipeline", bytes=chunk,
+                         bps=1e8, total=i * chunk, percent=i)
+        registry.transition(record, ADMITTED)
+        return record
+
+    async def _trace_walk(plane: FleetPlane) -> float:
+        registry = JobRegistry(terminal_ring=0)
+        records = [_traced_record(registry, f"{i}") for i in range(500)]
+        t0 = time.perf_counter()
+        for record in records:
+            plane._trace_context(record)
+            await plane.publish_telemetry(record)
+        return (time.perf_counter() - t0) * 1000.0 / len(records)
+
+    trace_on_ms = await _trace_walk(
+        FleetPlane(MemoryCoordStore(), "bench-on"))
+    trace_off_ms = await _trace_walk(
+        FleetPlane(MemoryCoordStore(), "bench-off", telemetry_ttl=0))
+    trace_ms = max(trace_on_ms - trace_off_ms, 0.0)
+
+    # -- end-to-end hop coverage ---------------------------------------
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from minis3 import MiniS3
+
+    payload = b"C" * (48 << 20)
+
+    async def serve(_request):
+        return web.Response(body=payload, headers={"ETag": '"obs-1"'})
+
+    app = web.Application()
+    app.router.add_get("/m.mkv", serve)
+    media_runner = web.AppRunner(app)
+    await media_runner.setup()
+    site = web.TCPSite(media_runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    s3 = MiniS3()
+    await s3.start()
+    client = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    coverage = None
+    try:
+        with tempfile.TemporaryDirectory() as work:
+            broker = InMemoryBroker()
+            telem_mq = MemoryQueue(broker)
+            await telem_mq.connect()
+            orchestrator = Orchestrator(
+                config=ConfigNode({"instance": {
+                    "download_path": os.path.join(work, "dl"),
+                    "max_concurrent_jobs": 1,
+                    # barrier: stages run sequentially, so hop seconds
+                    # and stage wall are directly comparable (the
+                    # streaming default overlaps them by design)
+                    "pipeline": "barrier",
+                }}),
+                mq=MemoryQueue(broker), store=client,
+                telemetry=Telemetry(telem_mq), logger=NullLogger(),
+            )
+            await orchestrator.start()
+            try:
+                msg = schemas.Download(media=schemas.Media(
+                    id="obs-cov-1", creator_id="c",
+                    type=schemas.MediaType.Value("MOVIE"),
+                    source=schemas.SourceType.Value("HTTP"),
+                    source_uri=f"http://127.0.0.1:{port}/m.mkv",
+                ))
+                broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+                await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
+                record = orchestrator.registry.get("obs-cov-1")
+                assert record.state == DONE, record.state
+                stage_wall = sum(record.stage_seconds.values())
+                coverage = record.hops.total_seconds() / stage_wall
+            finally:
+                await orchestrator.shutdown(grace_seconds=5)
+    finally:
+        await client.close()
+        await s3.stop()
+        await media_runner.cleanup()
+
+    return {
+        "hop_ledger_overhead_ms": round(hop_ms, 4),
+        "hop_ledger_overhead_ok": hop_ms < 1.0,
+        "trace_overhead_ms": round(trace_ms, 4),
+        "trace_overhead_ok": trace_ms < 1.0,
+        "hop_ledger_coverage": round(coverage, 4),
+        "hop_coverage_ok": 0.95 <= coverage <= 1.05,
+    }
+
+
+def _bench_obs_safe() -> dict:
+    """An observability-bench failure must not discard other metrics."""
+    try:
+        return asyncio.run(bench_obs())
+    except Exception as err:
+        return {"obs_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
 # Final-line headline keys, in keep-priority order (first = kept
 # longest under the size cap).  ~15 keys: the driver's 2,000-char tail
 # capture must always see the full final line (VERDICT r5 item 1);
@@ -1975,6 +2146,10 @@ HEADLINE_KEYS = [
     "journal_overhead_ms",        # r13 guard: job journal < 1 ms/job
     "restart_recovery_ms",        # r13: SIGKILL -> restart -> job DONE
     "crash_bench_error",          # present only on failure — visible
+    "hop_ledger_overhead_ms",     # r14 guard: hop ledger < 1 ms/job
+    "trace_overhead_ms",          # r14 guard: trace propagation < 1 ms/job
+    "hop_ledger_coverage",        # r14: hop seconds / stage wall, 0.95..1.05
+    "obs_bench_error",            # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -2017,6 +2192,10 @@ def main() -> None:
         # standalone crash-durability run (`make bench-crash`)
         print(json.dumps(_bench_crash_safe()))
         return
+    if "--obs" in sys.argv:
+        # standalone fleet-observability run (`make bench-obs`)
+        print(json.dumps(_bench_obs_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -2039,6 +2218,7 @@ def main() -> None:
         **_bench_control_safe(),
         **_bench_faults_safe(),
         **_bench_crash_safe(),
+        **_bench_obs_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

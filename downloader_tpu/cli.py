@@ -160,7 +160,16 @@ def _build_parser() -> argparse.ArgumentParser:
     _jobs_common(jobs_events)
     jobs_events.add_argument("id", help="media/job id")
     jobs_events.add_argument("--json", action="store_true",
-                             help="raw JSON instead of the timeline view")
+                             help="raw JSON instead of the timeline view "
+                                  "(with --follow: one JSON object per "
+                                  "new event)")
+    jobs_events.add_argument("--follow", "-f", action="store_true",
+                             help="live-tail: re-poll until the job "
+                                  "reaches a terminal state, printing "
+                                  "only new events (incident triage)")
+    jobs_events.add_argument("--interval", type=float, default=1.0,
+                             help="--follow poll interval in seconds "
+                                  "(default 1)")
 
     jobs_cancel = jobs_sub.add_parser(
         "cancel", help="cooperatively cancel a job (settled, not requeued)"
@@ -190,6 +199,28 @@ def _build_parser() -> argparse.ArgumentParser:
     fleet_show.add_argument("id", help="worker id (see `fleet list`)")
     fleet_show.add_argument("--url", default="http://127.0.0.1:3401",
                             help="service base URL")
+
+    trace = sub.add_parser(
+        "trace", help="cross-worker trace timelines (GET /v1/trace/{id}: "
+                      "this worker's segments + peer digests + live "
+                      "peer admin APIs, joined on one trace id)"
+    )
+    trace_sub = trace.add_subparsers(dest="trace_command", required=True)
+    trace_show = trace_sub.add_parser(
+        "show", help="one trace's assembled timeline: every worker's "
+                     "events merged in wall-clock order, spans, hop "
+                     "ledgers"
+    )
+    trace_show.add_argument("id", help="32-hex trace id (see `jobs show` "
+                                       "traceId, or any log line)")
+    trace_show.add_argument("--url", default="http://127.0.0.1:3401",
+                            help="service base URL (default local health "
+                                 "port)")
+    trace_show.add_argument("--json", action="store_true",
+                            help="raw JSON instead of the timeline view")
+    trace_show.add_argument("--local", action="store_true",
+                            help="this worker's view only (skip the "
+                                 "coordination store and peer hops)")
 
     tenants = sub.add_parser(
         "tenants", help="tenancy + overload posture: per-tenant weights/"
@@ -478,27 +509,55 @@ async def _jobs(args) -> int:
                     print(json.dumps(body, indent=2, sort_keys=True))
                     return 0 if resp.status == 200 else 1
             if args.jobs_command == "events":
-                async with session.get(
-                    f"{base}/v1/jobs/{args.id}/events"
-                ) as resp:
-                    body = await resp.json()
-                    if resp.status != 200:
-                        print(json.dumps(body), file=sys.stderr)
-                        return 1
-                if args.json:
-                    print(json.dumps(body, indent=2, sort_keys=True))
-                    return 0
-                print(f"# {body['id']}\tstate={body['state']}\t"
-                      f"traceId={body.get('traceId')}")
-                if body.get("eventsDropped"):
-                    print(f"# {body['eventsDropped']} older events "
-                          "dropped (ring bound)", file=sys.stderr)
-                for event in body.get("events", []):
-                    ts = event.pop("t", "")
-                    kind = event.pop("kind", "?")
-                    rest = " ".join(f"{k}={v}" for k, v in event.items())
-                    print(f"{ts}\t{kind}\t{rest}")
-                return 0
+                from .control.registry import TERMINAL_STATES
+
+                # --follow: re-poll until the job settles, printing only
+                # events not yet shown.  ``eventsDropped + len(events)``
+                # is the record's total-events-ever counter, so new
+                # events are exactly the tail past what was printed —
+                # correct even when the bounded ring wraps mid-tail.
+                printed_total = 0
+                header_shown = False
+                while True:
+                    async with session.get(
+                        f"{base}/v1/jobs/{args.id}/events"
+                    ) as resp:
+                        body = await resp.json()
+                        if resp.status != 200:
+                            print(json.dumps(body), file=sys.stderr)
+                            return 1
+                    if args.json and not args.follow:
+                        print(json.dumps(body, indent=2, sort_keys=True))
+                        return 0
+                    if not header_shown and not args.json:
+                        header_shown = True
+                        print(f"# {body['id']}\tstate={body['state']}\t"
+                              f"traceId={body.get('traceId')}")
+                        if body.get("eventsDropped"):
+                            print(f"# {body['eventsDropped']} older "
+                                  "events dropped (ring bound)",
+                                  file=sys.stderr)
+                    dropped = body.get("eventsDropped", 0)
+                    events = body.get("events", [])
+                    start = max(printed_total - dropped, 0)
+                    for event in events[start:]:
+                        if args.json:
+                            # --follow --json: one JSON object per NEW
+                            # event (jq-able stream), not repeated
+                            # whole-body dumps
+                            print(json.dumps(event, sort_keys=True),
+                                  flush=True)
+                            continue
+                        event = dict(event)
+                        ts = event.pop("t", "")
+                        kind = event.pop("kind", "?")
+                        rest = " ".join(
+                            f"{k}={v}" for k, v in event.items())
+                        print(f"{ts}\t{kind}\t{rest}", flush=True)
+                    printed_total = dropped + len(events)
+                    if not args.follow or body["state"] in TERMINAL_STATES:
+                        return 0
+                    await asyncio.sleep(max(args.interval, 0.1))
             # cancel
             async with session.post(
                 f"{base}/v1/jobs/{args.id}/cancel",
@@ -562,6 +621,68 @@ async def _fleet(args) -> int:
         print(f"lease {lease.get('key', '')[:16]}\t{flag}"
               f"\towner={lease.get('owner')}"
               f"\tfence={lease.get('fence')}")
+    return 0
+
+
+async def _trace(args) -> int:
+    """Render GET /v1/trace/{id}: one wall-clock-ordered timeline of
+    every worker's events for the trace, plus spans and hop ledgers."""
+    import json
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    timeout = aiohttp.ClientTimeout(total=30)  # peer hops can add up
+    params = {"scope": "local"} if args.local else {}
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        try:
+            async with session.get(f"{base}/v1/trace/{args.id}",
+                                   params=params) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    print(json.dumps(body), file=sys.stderr)
+                    return 1
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(body, indent=2, sort_keys=True))
+        return 0
+    from .control.trace import merged_timeline
+
+    print(f"# trace {body['traceId']}\tworkers="
+          f"{','.join(body.get('workers') or []) or '-'}")
+    if body.get("degraded"):
+        print("# DEGRADED view (coordination/peer trouble): "
+              + "; ".join(body.get("errors") or []), file=sys.stderr)
+    for segment in body.get("segments") or []:
+        hops = segment.get("hopLedger") or {}
+        hop_view = " ".join(
+            f"{hop}={entry.get('seconds')}s/{entry.get('bytes')}B"
+            for hop, entry in hops.items()
+        )
+        print(f"# job {segment.get('jobId')}\t{segment.get('state')}"
+              f"\tworker={segment.get('workerId')}"
+              f"\tsource={segment.get('source')}"
+              + (f"\tlink={segment['link']}" if segment.get("link")
+                 else "")
+              + (f"\n#   hops: {hop_view}" if hop_view else ""))
+    for row in merged_timeline(body):
+        ts = row.pop("t", "")
+        kind = row.pop("kind", "?")
+        worker = row.pop("workerId", "-")
+        job = row.pop("jobId", "-")
+        rest = " ".join(f"{k}={v}" for k, v in row.items())
+        print(f"{ts}\t{worker}\t{job}\t{kind}\t{rest}")
+    spans = body.get("spans") or []
+    if spans:
+        print(f"# {len(spans)} span(s)")
+        for span in sorted(spans, key=lambda s: s.get("startTime") or 0):
+            print(f"{span.get('startTime')}\t{span.get('workerId') or '-'}"
+                  f"\tspan\t{span.get('name')}"
+                  f"\tduration={round(span.get('duration', 0), 4)}s"
+                  + (f"\terror={span['error']}" if span.get("error")
+                     else ""))
     return 0
 
 
@@ -847,6 +968,8 @@ def main(argv=None) -> int:
         return asyncio.run(_jobs(args))
     if args.command == "fleet":
         return asyncio.run(_fleet(args))
+    if args.command == "trace":
+        return asyncio.run(_trace(args))
     if args.command == "tenants":
         return asyncio.run(_tenants(args))
     if args.command == "debug":

@@ -10,6 +10,12 @@ serves probes, metrics, and operations:
                                     samples, cache/retry/settle decisions,
                                     correlation ids)
     POST /v1/jobs/{id}/cancel       fire the job's cancel token
+    GET  /v1/trace/{trace_id}       cross-worker timeline for one trace:
+                                    local segments + peer digests from
+                                    the coordination store + live peer
+                                    admin APIs (?scope=local = this
+                                    worker only; fleet trouble degrades
+                                    to the local view, never an error)
     GET  /v1/fleet                  fleet membership: live workers (with
                                     heartbeat payloads), live content
                                     leases, this worker's fleet stats
@@ -132,6 +138,25 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
             "eventsDropped": record.recorder.dropped,
             "events": record.recorder.events(),
         })
+
+    async def trace_show(request: web.Request) -> web.Response:
+        """The cross-worker timeline for one trace id: local registry
+        segments + tracer spans, merged with peer digests from the
+        coordination store and live peer admin APIs.  Coordination
+        trouble degrades to the local view (``degraded: true``) — this
+        endpoint never 5xxes on fleet trouble.  ``?scope=local`` (what
+        peers send each other) skips every remote hop."""
+        assemble = getattr(orchestrator, "assemble_trace", None)
+        if assemble is None:
+            return _unavailable()
+        trace_id = request.match_info["id"]
+        remote = request.query.get("scope") != "local"
+        document = await assemble(trace_id, remote=remote)
+        if not document["segments"] and not document["spans"]:
+            return web.json_response(
+                {"error": "unknown trace", **document}, status=404
+            )
+        return web.json_response(document)
 
     async def fleet_list(_request: web.Request) -> web.Response:
         """Fleet membership: live workers (heartbeat payloads incl. the
@@ -295,6 +320,8 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     app.router.add_get("/v1/jobs/{id}", job_show)
     app.router.add_get("/v1/jobs/{id}/events", job_events)
     app.router.add_post("/v1/jobs/{id}/cancel", job_cancel)
+    # cross-worker trace assembly: local + digests + live peers
+    app.router.add_get("/v1/trace/{id}", trace_show)
     # fleet plane: membership, leases, per-worker heartbeat payloads
     app.router.add_get("/v1/fleet", fleet_list)
     app.router.add_get("/v1/fleet/{id}", fleet_show)

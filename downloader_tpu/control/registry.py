@@ -44,7 +44,7 @@ import itertools
 import time
 from typing import Any, Deque, Dict, List, Optional
 
-from ..platform.obs import DEFAULT_EVENT_LIMIT, FlightRecorder
+from ..platform.obs import DEFAULT_EVENT_LIMIT, FlightRecorder, HopLedger
 from ..utils import utcnow_iso as _utcnow_iso
 from .cancel import CancelToken
 
@@ -129,14 +129,15 @@ class JobRecord:
         "stage_seconds", "_entered_mono", "_created_mono",
         "recorder", "trace_id", "span_id", "transferred", "retry",
         "worker_id", "tenant", "ttl_seconds", "deadline_mono",
-        "recovered",
+        "recovered", "hops",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
                  recorder_events: int = DEFAULT_EVENT_LIMIT,
                  worker_id: Optional[str] = None,
                  tenant: str = "default",
-                 ttl_seconds: float = 0.0):
+                 ttl_seconds: float = 0.0,
+                 hop_ledger: bool = True):
         self.uid = uid
         self.job_id = job_id
         self.file_id = file_id
@@ -201,6 +202,10 @@ class JobRecord:
         # unlike ``bytes`` (committed at stage completion) these move
         # WHILE a transfer runs, so a stalled job is visibly flat
         self.transferred: Dict[str, int] = {}
+        # per-hop byte+time attribution (platform/obs.py HopLedger), fed
+        # by the stages' transfer loops; None (``obs.hop_ledger: false``)
+        # makes note_hop a no-op — the bench's disabled/enabled A-B leg
+        self.hops: Optional[HopLedger] = HopLedger() if hop_ledger else None
 
     @property
     def terminal(self) -> bool:
@@ -231,6 +236,11 @@ class JobRecord:
     def note_transfer(self, kind: str, total: int) -> None:
         """Live absolute transfer counter (cheap: called per chunk)."""
         self.transferred[kind] = int(total)
+
+    def note_hop(self, hop: str, nbytes: int, seconds: float) -> None:
+        """Accumulate one hop sample (cheap: called per chunk/slice)."""
+        if self.hops is not None:
+            self.hops.note(hop, nbytes, seconds)
 
     def note_progress(self, percent: int) -> None:
         self.percent = int(percent)
@@ -265,6 +275,8 @@ class JobRecord:
             "stageSeconds": {
                 k: round(v, 3) for k, v in self.stage_seconds.items()
             },
+            "hopLedger": (self.hops.summary()
+                          if self.hops is not None and self.hops else None),
         }
 
 
@@ -277,10 +289,14 @@ class JobRegistry:
 
     def __init__(self, metrics=None, terminal_ring: int = DEFAULT_TERMINAL_RING,
                  logger=None, recorder_events: int = DEFAULT_EVENT_LIMIT,
-                 worker_id: Optional[str] = None, journal=None):
+                 worker_id: Optional[str] = None, journal=None,
+                 hop_ledger: bool = True):
         self.metrics = metrics
         self.logger = logger
         self.worker_id = worker_id
+        # per-hop transfer attribution (``obs.hop_ledger``, default on):
+        # False hands records no ledger, so every note_hop is a no-op
+        self.hop_ledger = bool(hop_ledger)
         # crash-safe durability (control/journal.py): every register/
         # transition appends one journal line, so a killed worker's
         # replacement can replay the lifecycle it lost.  None = the
@@ -315,7 +331,8 @@ class JobRegistry:
         record = JobRecord(next(self._seq), job_id, file_id, priority,
                            recorder_events=self.recorder_events,
                            worker_id=self.worker_id,
-                           tenant=tenant, ttl_seconds=ttl_seconds)
+                           tenant=tenant, ttl_seconds=ttl_seconds,
+                           hop_ledger=self.hop_ledger)
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
         record.event("received", priority=priority)
@@ -423,6 +440,14 @@ class JobRegistry:
         return record
 
     def _retire(self, record: JobRecord) -> None:
+        if record.hops is not None and record.hops:
+            # the job's byte/time attribution, sealed into the timeline
+            # at settle (one event) and into the fleet-wide
+            # hop_seconds_per_gb/hop_bytes metrics — where this
+            # gigabyte's wall time actually went, per hop
+            record.event("hop_ledger", hops=record.hops.summary())
+            if self.metrics is not None:
+                record.hops.observe(self.metrics)
         if (record.state in (FAILED, DROPPED_POISON)
                 and self.logger is not None):
             # terminal debug bundle: the timeline's tail + correlation

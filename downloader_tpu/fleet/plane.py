@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..control.cancel import JobCancelled
 from ..platform.config import cfg_get
+from ..platform.tracing import parse_traceparent
 from ..stages.upload import STAGING_BUCKET
 from ..store.base import ObjectNotFound
 from .coord import (ABSENT, ANY, BucketCoordStore, CoordError, CoordStore,
@@ -60,6 +61,9 @@ from .coord import (ABSENT, ANY, BucketCoordStore, CoordError, CoordStore,
 # coordination-store key namespaces
 WORKERS_PREFIX = "workers/"
 LEASES_PREFIX = "leases/"
+# per-job trace digests: telemetry/<trace_id>/<worker_id>/<job_id> (on the bucket
+# backend that is `.fleet/telemetry/...` in the staging bucket)
+TELEMETRY_PREFIX = "telemetry/"
 # shared-tier object layout in the staging bucket
 SHARED_PREFIX = ".fleet-cache/"
 MANIFEST_NAME = "manifest.json"
@@ -76,6 +80,12 @@ DEFAULT_MAX_WAIT = 600.0
 DEFAULT_GC_INTERVAL = 300.0
 DEFAULT_SHARED_MAX_AGE = 24 * 3600.0
 DEFAULT_SHARED_MAX_BYTES = 0  # 0 = no size budget (age bound only)
+# per-job trace digests published at settle live this long before the
+# fleet GC reclaims them (0 disables publishing entirely)
+DEFAULT_TELEMETRY_TTL = 1800.0
+# events kept in one digest: enough for the lifecycle + failure tail,
+# bounded so a digest document stays a few KB
+DIGEST_EVENT_LIMIT = 48
 
 # a lease is only treated as dead once expired by this fraction of the
 # TTL: lease math compares the WRITER's wall clock against the READER's,
@@ -105,12 +115,16 @@ def resolve_worker_id(config) -> str:
 class _Lease:
     """One held lease: its CAS token and the renewal task keeping it."""
 
-    __slots__ = ("key", "token", "fence", "renewer")
+    __slots__ = ("key", "token", "fence", "renewer", "trace")
 
-    def __init__(self, key: str, token: str, fence: int):
+    def __init__(self, key: str, token: str, fence: int,
+                 trace: Optional[dict] = None):
         self.key = key
         self.token = token
         self.fence = fence
+        # the leading job's W3C trace context, re-stamped on every
+        # renewal so waiters always see which trace their wait joins
+        self.trace = trace
         self.renewer: Optional[asyncio.Task] = None
 
 
@@ -133,6 +147,8 @@ class FleetPlane:
         gc_interval: float = DEFAULT_GC_INTERVAL,
         shared_max_age: float = DEFAULT_SHARED_MAX_AGE,
         shared_max_bytes: int = DEFAULT_SHARED_MAX_BYTES,
+        telemetry_ttl: float = DEFAULT_TELEMETRY_TTL,
+        advertise_url: Optional[str] = None,
         metrics=None,
         logger=None,
         retrier=None,
@@ -158,6 +174,14 @@ class FleetPlane:
         self.gc_interval = float(gc_interval)
         self.shared_max_age = float(shared_max_age)
         self.shared_max_bytes = int(shared_max_bytes)
+        # cross-worker trace digests (``fleet.telemetry_ttl``; 0 = off):
+        # settled jobs publish a compact timeline digest the fleet's
+        # trace assembly (control/trace.py) joins across workers
+        self.telemetry_ttl = float(telemetry_ttl)
+        # this worker's admin-API base URL, advertised in heartbeats so
+        # peers can assemble LIVE (pre-settle) trace segments over HTTP
+        # (``fleet.advertise_url``; None = digests/local only)
+        self.advertise_url = advertise_url
         self.metrics = metrics
         self.logger = logger
         self.retrier = retrier
@@ -184,6 +208,7 @@ class FleetPlane:
             "coordErrors": 0, "uncoordinatedFallbacks": 0,
             "gcSharedEvicted": 0, "gcTombstonesCompacted": 0,
             "gcBytesReclaimed": 0,
+            "telemetryPublished": 0, "gcTelemetryEvicted": 0,
         }
 
     # -- config ---------------------------------------------------------
@@ -251,6 +276,9 @@ class FleetPlane:
             shared_max_bytes=int(cfg_get(
                 config, "fleet.shared_max_bytes",
                 DEFAULT_SHARED_MAX_BYTES)),
+            telemetry_ttl=float(cfg_get(
+                config, "fleet.telemetry_ttl", DEFAULT_TELEMETRY_TTL)),
+            advertise_url=cfg_get(config, "fleet.advertise_url", None),
             metrics=metrics, logger=logger, retrier=retrier,
             payload_fn=payload_fn,
         )
@@ -284,6 +312,10 @@ class FleetPlane:
             "leases": sorted(self._held),
             "stats": dict(self.stats),
         }
+        if self.advertise_url:
+            # peers use this to assemble LIVE cross-worker traces over
+            # the admin API (control/trace.py); absent = digests only
+            doc["adminUrl"] = self.advertise_url
         if self.payload_fn is not None:
             try:
                 doc["signals"] = dict(self.payload_fn())
@@ -415,16 +447,41 @@ class FleetPlane:
         return out
 
     # -- leases ---------------------------------------------------------
-    def _lease_doc(self, fence: int) -> dict:
-        now = time.time()
+    def _trace_context(self, record) -> Optional[dict]:
+        """The job's W3C trace context as a small carry-able document —
+        what lease docs and shared-tier manifests propagate so the
+        cross-worker trace assembly can join waiter and leader."""
+        trace_id = getattr(record, "trace_id", None)
+        span_id = getattr(record, "span_id", None)
+        if not trace_id or not span_id:
+            # no span id, no context: an all-zero placeholder would
+            # round-trip into a traceparent that parse_traceparent
+            # rejects by spec — a silently unfollowable link
+            return None
         return {
+            "traceparent": f"00-{trace_id}-{span_id}-01",
+            "jobId": getattr(record, "job_id", None),
+            "worker": self.worker_id,
+        }
+
+    def _lease_doc(self, fence: int, trace: Optional[dict] = None) -> dict:
+        now = time.time()
+        doc = {
             "owner": self.worker_id,
             "fence": fence,
             "acquiredAt": round(now, 3),
             "expiresAt": round(now + self.lease_ttl, 3),
         }
+        if trace:
+            # the leading job's traceparent rides the lease: a waiter
+            # parked on this key knows exactly which trace (and which
+            # worker's fetch) it is waiting on
+            doc["trace"] = dict(trace)
+        return doc
 
-    async def try_acquire_lease(self, key: str) -> Optional[_Lease]:
+    async def try_acquire_lease(self, key: str,
+                                trace: Optional[dict] = None
+                                ) -> Optional[_Lease]:
         """One conditional-put attempt on ``leases/<key>``.
 
         Returns the held lease, or None when a live peer holds it.  An
@@ -435,7 +492,7 @@ class FleetPlane:
         entry = await self.coord.get(lease_key)
         if entry is None:
             token = await self.coord.put(
-                lease_key, self._lease_doc(1), expect=ABSENT
+                lease_key, self._lease_doc(1, trace), expect=ABSENT
             )
             fence, takeover = 1, False
         else:
@@ -452,12 +509,12 @@ class FleetPlane:
                 return None  # live (or skew-ambiguous) leader
             fence = int(doc.get("fence", 0)) + 1
             token = await self.coord.put(
-                lease_key, self._lease_doc(fence), expect=old_token
+                lease_key, self._lease_doc(fence, trace), expect=old_token
             )
             takeover = True
         if token is None:
             return None  # lost the race: someone else just took it
-        lease = _Lease(key, token, fence)
+        lease = _Lease(key, token, fence, trace=trace)
         self._held[key] = lease
         lease.renewer = asyncio.create_task(
             self._renew_loop(lease), name=f"fleet-lease-{key[:12]}"
@@ -484,7 +541,8 @@ class FleetPlane:
             await asyncio.sleep(interval)
             try:
                 token = await self.coord.put(
-                    LEASES_PREFIX + lease.key, self._lease_doc(lease.fence),
+                    LEASES_PREFIX + lease.key,
+                    self._lease_doc(lease.fence, lease.trace),
                     expect=lease.token,
                 )
             except asyncio.CancelledError:
@@ -564,7 +622,8 @@ class FleetPlane:
             return posixpath.join(self.shared_prefix + key, "files", rel)
         return posixpath.join(self.shared_prefix + key, MANIFEST_NAME)
 
-    async def publish_entry(self, key: str, cache) -> bool:
+    async def publish_entry(self, key: str, cache,
+                            trace: Optional[dict] = None) -> bool:
         """Spill the local cache entry for ``key`` to the shared tier.
 
         Payload objects first, ``manifest.json`` LAST — the manifest is
@@ -604,6 +663,11 @@ class FleetPlane:
                     "worker": self.worker_id,
                     "created": round(time.time(), 3),
                 }
+                if trace:
+                    # the filling job's traceparent: peers materializing
+                    # this entry can name the exact origin fetch (trace
+                    # + worker) their bytes came from
+                    manifest["trace"] = dict(trace)
                 await self.store.put_object(
                     self.shared_bucket, self._shared_name(key),
                     _json_bytes(manifest),
@@ -622,7 +686,7 @@ class FleetPlane:
                              key=key[:16], bytes=entry.size)
         return True
 
-    async def fetch_entry(self, key: str, cache) -> bool:
+    async def fetch_entry(self, key: str, cache, record=None) -> bool:
         """Materialize a shared-tier entry into the LOCAL cache.
 
         Streams the manifest's files into a pid-tagged staging dir on
@@ -673,6 +737,18 @@ class FleetPlane:
         finally:
             await asyncio.to_thread(shutil.rmtree, staging, True)
         got = entry.size if entry is not None else size
+        if record is not None:
+            # provenance on the waiter's own timeline: whose origin
+            # fetch (worker + trace) these bytes actually came from
+            origin = {"worker": manifest.get("worker")}
+            remote = parse_traceparent(
+                (manifest.get("trace") or {}).get("traceparent"))
+            if remote is not None:
+                origin["originTraceId"] = remote.trace_id
+                origin["originJobId"] = (manifest.get("trace")
+                                         or {}).get("jobId")
+            record.event("shared_origin", key=key[:16], bytes=got,
+                         **origin)
         self.stats["sharedHits"] += 1
         self.stats["sharedBytesIn"] += got
         if self.metrics is not None:
@@ -683,6 +759,74 @@ class FleetPlane:
             self.logger.info("fleet: materialized shared-tier entry",
                              key=key[:16], bytes=got)
         return True
+
+    # -- cross-worker trace digests -------------------------------------
+    def _digest(self, record) -> dict:
+        """One settled job's compact timeline digest — the document the
+        cross-worker trace assembly (control/trace.py) joins with the
+        other workers' segments.  Bounded: the event tail is capped at
+        :data:`DIGEST_EVENT_LIMIT` (events are already small, truncated
+        dicts), so a digest stays a few KB."""
+        hops = getattr(record, "hops", None)
+        return {
+            "traceId": record.trace_id,
+            "spanId": record.span_id,
+            "jobId": record.job_id,
+            "workerId": self.worker_id,
+            "state": record.state,
+            "stage": record.stage,
+            "stageSeconds": {k: round(v, 3)
+                             for k, v in record.stage_seconds.items()},
+            "hopLedger": hops.summary() if hops is not None and hops
+            else None,
+            "events": record.recorder.tail(DIGEST_EVENT_LIMIT),
+            "settledAt": round(time.time(), 3),
+        }
+
+    async def publish_telemetry(self, record) -> bool:
+        """Publish a settled job's timeline digest to the coordination
+        store at ``telemetry/<trace_id>/<worker_id>/<job_id>``.
+
+        Keyed per JOB: a submitter may stamp one traceparent across a
+        whole batch, and one worker settling several of those jobs must
+        not clobber its earlier digests in a shared per-worker slot.
+        Best-effort (a digest is observability, never worth a job or a
+        settle delay — the orchestrator fires this as a detached task)
+        and bounded: digests age out of the store after
+        ``fleet.telemetry_ttl`` via the fleet GC sweep.
+        """
+        trace_id = getattr(record, "trace_id", None)
+        if self.telemetry_ttl <= 0 or not trace_id:
+            return False
+        key = (f"{TELEMETRY_PREFIX}{trace_id}/{self.worker_id}/"
+               f"{record.job_id}")
+        try:
+            # unconditional: this worker owns its own digest slot, and a
+            # redelivered job's later settle should win
+            await self.coord.put(key, self._digest(record), expect=ANY)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            if self.metrics is not None:
+                self.metrics.fleet_telemetry.labels(op="error").inc()
+            self._note_coord_error("telemetry_publish", err)
+            return False
+        self.stats["telemetryPublished"] += 1
+        if self.metrics is not None:
+            self.metrics.fleet_telemetry.labels(op="published").inc()
+        return True
+
+    async def fetch_telemetry(self, trace_id: str) -> List[dict]:
+        """Every worker's digest for ``trace_id`` (empty when none).
+        Coordination trouble RAISES — the trace assembler downgrades to
+        its local-only view and says so, instead of silently presenting
+        a partial fleet picture as complete."""
+        docs = [doc for _key, doc in await self._get_all(
+            TELEMETRY_PREFIX + trace_id + "/")]
+        if self.metrics is not None and docs:
+            self.metrics.fleet_telemetry.labels(
+                op="fetched").inc(len(docs))
+        return docs
 
     # -- shared-tier / tombstone GC -------------------------------------
     async def _should_gc(self) -> bool:
@@ -746,7 +890,8 @@ class FleetPlane:
         this worker's or a peer's (a slow multi-GB spill is manifest-
         less for its whole upload) — are skipped.
         """
-        out = {"shared_evicted": 0, "bytes_reclaimed": 0, "tombstones": 0}
+        out = {"shared_evicted": 0, "bytes_reclaimed": 0, "tombstones": 0,
+               "telemetry": 0}
         if self.store is not None:
             try:
                 entries: Dict[str, list] = {}
@@ -840,6 +985,32 @@ class FleetPlane:
                 raise
             except Exception as err:
                 self._note_coord_error("gc_shared", err)
+        # per-job trace digests: every settled job writes one, so without
+        # this sweep the telemetry prefix grows one doc per job forever.
+        # A digest's useful life is an incident window, not an archive —
+        # aged ones are deleted (token-CAS, so a concurrent republish
+        # from a redelivery is never clobbered).  Swept at the DEFAULT
+        # ttl even by a worker whose own publishing is off
+        # (telemetry_ttl 0): peers may still publish, and the elected
+        # sweeper is the only one who ever cleans up after them.
+        telemetry_ttl = (self.telemetry_ttl if self.telemetry_ttl > 0
+                         else DEFAULT_TELEMETRY_TTL)
+        try:
+            now = time.time()
+            for key in await self.coord.list_keys(TELEMETRY_PREFIX):
+                entry = await self.coord.get(key)
+                if entry is None:
+                    continue
+                doc, token = entry
+                if now - float(doc.get("settledAt", 0) or 0) \
+                        < telemetry_ttl:
+                    continue
+                if await self.coord.delete(key, expect=token):
+                    out["telemetry"] += 1
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("gc_telemetry", err)
         sweep = getattr(self.coord, "sweep_tombstones", None)
         if sweep is not None:
             # a tombstone is compactable once every CAS that could have
@@ -856,6 +1027,7 @@ class FleetPlane:
         self.stats["gcSharedEvicted"] += out["shared_evicted"]
         self.stats["gcBytesReclaimed"] += out["bytes_reclaimed"]
         self.stats["gcTombstonesCompacted"] += out["tombstones"]
+        self.stats["gcTelemetryEvicted"] += out["telemetry"]
         if self.metrics is not None:
             if out["shared_evicted"]:
                 self.metrics.fleet_gc_removed.labels(
@@ -863,6 +1035,9 @@ class FleetPlane:
             if out["tombstones"]:
                 self.metrics.fleet_gc_removed.labels(
                     kind="tombstone").inc(out["tombstones"])
+            if out["telemetry"]:
+                self.metrics.fleet_gc_removed.labels(
+                    kind="telemetry").inc(out["telemetry"])
             if out["bytes_reclaimed"]:
                 self.metrics.fleet_gc_bytes.inc(out["bytes_reclaimed"])
         return out
@@ -895,11 +1070,15 @@ class FleetPlane:
         deadline = time.monotonic() + self.max_wait
         parked = False
         waited = False
+        # the job's W3C trace context rides the lease doc and the
+        # shared-tier manifest, so waiters (and later trace assembly)
+        # can join this fetch to the trace that caused it
+        trace = self._trace_context(record)
         try:
             while True:
                 try:
                     # 1) a finished leader's bytes beat any lease dance
-                    if await self.fetch_entry(key, cache):
+                    if await self.fetch_entry(key, cache, record=record):
                         if record is not None:
                             record.event("fleet", outcome="shared",
                                          key=key[:16])
@@ -907,7 +1086,7 @@ class FleetPlane:
                     # 2) contend for the content lease
                     lease = await self._coord_op(
                         "coord.lease",
-                        lambda: self.try_acquire_lease(key),
+                        lambda: self.try_acquire_lease(key, trace),
                         cancel=cancel,
                     )
                 except (JobCancelled, asyncio.CancelledError):
@@ -930,7 +1109,31 @@ class FleetPlane:
                     if self.metrics is not None:
                         self.metrics.fleet_lease_waits.inc()
                     if record is not None:
-                        record.event("fleet", outcome="wait", key=key[:16])
+                        # name the leader this wait is actually behind:
+                        # its worker id and — when the lease doc carries
+                        # a traceparent — the leader job's trace id, the
+                        # link GET /v1/trace follows to merge the
+                        # leader's fetch into this waiter's timeline
+                        leader_fields: Dict[str, Any] = {}
+                        try:
+                            entry = await self.coord.get(
+                                LEASES_PREFIX + key)
+                        except Exception:
+                            entry = None  # wait event still emits bare
+                        if entry is not None:
+                            doc = entry[0]
+                            leader_fields["leaderWorker"] = doc.get(
+                                "owner")
+                            remote = parse_traceparent(
+                                (doc.get("trace") or {}).get(
+                                    "traceparent"))
+                            if remote is not None:
+                                leader_fields["leaderTraceId"] = \
+                                    remote.trace_id
+                                leader_fields["leaderJobId"] = (
+                                    doc.get("trace") or {}).get("jobId")
+                        record.event("fleet", outcome="wait",
+                                     key=key[:16], **leader_fields)
                 if not parked and record is not None and registry is not None:
                     parked = True
                     if self.metrics is not None:
@@ -981,7 +1184,7 @@ class FleetPlane:
                          fence=lease.fence)
         try:
             await origin_fill()
-            await self.publish_entry(key, cache)
+            await self.publish_entry(key, cache, trace=trace)
         finally:
             await self.release_lease(key)
         return LED

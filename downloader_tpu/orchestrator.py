@@ -206,6 +206,10 @@ class Orchestrator:
         # whose redelivery has not arrived yet (the replay window)
         self._recovered: Dict[str, dict] = {}
         self._recovery_watchers: List[asyncio.Task] = []
+        # detached per-job trace-digest publishes (fleet/plane.py
+        # publish_telemetry): fired after settle so a coordination-store
+        # round trip never delays an ack; drained at shutdown
+        self._telemetry_tasks: "set[asyncio.Task]" = set()
         self.registry = JobRegistry(
             metrics=metrics, logger=self.logger,
             recorder_events=int(cfg_get(
@@ -213,6 +217,9 @@ class Orchestrator:
             )),
             worker_id=self.worker_id,
             journal=self.journal,
+            # per-hop transfer attribution (platform/obs.py HopLedger):
+            # on by default, `obs.hop_ledger: false` is the bench A-B leg
+            hop_ledger=bool(cfg_get(config, "obs.hop_ledger", True)),
         )
         # runtime introspection (platform/obs.py): loop-lag sampling
         # into /metrics, and the transfer profiler feeding throughput /
@@ -439,6 +446,17 @@ class Orchestrator:
             "cache_headroom_bytes": headroom,
             "active_jobs": len(self.active_jobs),
         }
+
+    async def assemble_trace(self, trace_id: str,
+                             remote: bool = True) -> dict:
+        """The cross-worker timeline for one trace id (``GET
+        /v1/trace/{id}``): local registry segments + tracer spans,
+        joined with peer workers' coordination-store digests and live
+        admin APIs (control/trace.py).  Coordination trouble degrades
+        to the local-only view — never an error."""
+        from .control.trace import assemble
+
+        return await assemble(self, trace_id, remote=remote)
 
     def tenant_staging_bytes(self) -> Dict[str, int]:
         """Live per-tenant staging footprint: bytes on disk under each
@@ -788,6 +806,18 @@ class Orchestrator:
             await asyncio.gather(*self._recovery_watchers,
                                  return_exceptions=True)
             self._recovery_watchers.clear()
+        if self._telemetry_tasks:
+            # give in-flight digest publishes a moment (they are one
+            # coordination put), then cut them — digests are best-effort
+            pending = list(self._telemetry_tasks)
+            try:
+                async with asyncio.timeout(2):
+                    await asyncio.gather(*pending, return_exceptions=True)
+            except TimeoutError:
+                for task in pending:
+                    task.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
+            self._telemetry_tasks.clear()
         await self.mq.close()
         await self.telemetry.close()
         if self.journal is not None:
@@ -1033,6 +1063,18 @@ class Orchestrator:
                 self.metrics.tenant_jobs.labels(
                     tenant=record.tenant, outcome=record.state
                 ).inc()
+            if (self.fleet is not None and record.terminal
+                    and record.trace_id):
+                # publish the job's trace digest to the coordination
+                # store (fleet/plane.py) as a detached task: the settle
+                # is already acked, and a store round trip must not
+                # extend the handler.  Best-effort by contract.
+                task = asyncio.create_task(
+                    self.fleet.publish_telemetry(record),
+                    name=f"telemetry-digest-{record.job_id[:12]}",
+                )
+                self._telemetry_tasks.add(task)
+                task.add_done_callback(self._telemetry_tasks.discard)
 
     async def _settle_cancelled(self, msg: schemas.Download,
                                 delivery: Delivery, logger: Logger,

@@ -455,6 +455,18 @@ class Retrier:
             self._policies[dependency] = policy
         return policy
 
+    def _observe(self, dependency: str, seam: str, outcome: str,
+                 elapsed: float) -> None:
+        """One RED sample per dependency *attempt* — the latency
+        distribution behind every seam (``dependency_request_seconds``),
+        labeled with how the dependency answered.  Breaker rejections
+        are NOT observed: no request was made, and a wall of sub-ms
+        "failures" would bury the real latency signal."""
+        if self.metrics is not None:
+            self.metrics.dependency_request_seconds.labels(
+                dependency=dependency, op=seam, outcome=outcome
+            ).observe(elapsed)
+
     async def run(self, seam: str, factory: Callable[[], Any], *,
                   cancel=None, record=None, logger=None) -> Any:
         """Await ``factory()`` with bounded transient retries.
@@ -477,9 +489,11 @@ class Retrier:
         for attempt in range(1, policy.attempts + 1):
             if breaker is not None and not breaker.allow():
                 raise BreakerOpen(dependency, breaker.retry_after())
+            attempt_started = time.monotonic()
             try:
                 result = await factory()
             except Exception as err:
+                elapsed = time.monotonic() - attempt_started
                 if _passthrough_code(err):
                     # cancellation / stall: never retried, never tagged —
                     # and no breaker verdict (the dependency didn't get
@@ -487,8 +501,10 @@ class Retrier:
                     # be freed or the breaker wedges
                     if breaker is not None:
                         breaker.release_probe()
+                    self._observe(dependency, seam, "cancelled", elapsed)
                     raise
                 fault = classify(err)
+                self._observe(dependency, seam, fault, elapsed)
                 if fault != TRANSIENT:
                     # the dependency ANSWERED (404, 403, bad request) —
                     # not an outage, so no failure is recorded; but not
@@ -532,6 +548,8 @@ class Retrier:
                 else:
                     await asyncio.sleep(delay)
             else:
+                self._observe(dependency, seam, "ok",
+                              time.monotonic() - attempt_started)
                 if breaker is not None:
                     breaker.record_success()
                 if record is not None:

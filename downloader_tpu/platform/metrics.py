@@ -91,6 +91,19 @@ class Metrics:
             ["seam"],
             registry=self.registry,
         )
+        self.dependency_request_seconds = Histogram(
+            f"{ns}_dependency_request_seconds",
+            "Latency of every dependency call made through the Retrier "
+            "seams (store put/stat/bucket, publish, http origin, tracker, "
+            "coord ops), per attempt: dependency = breaker/policy key, "
+            "op = the exact seam, outcome = ok|transient|permanent|"
+            "poison|cancelled.  The R.E.D. signal breaker thresholds and "
+            "retry budgets are tuned against",
+            ["dependency", "op", "outcome"],
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+        )
         self.breaker_state = Gauge(
             f"{ns}_breaker_state",
             "Per-dependency circuit-breaker state: 0=closed, 1=open, "
@@ -109,6 +122,33 @@ class Metrics:
             f"{ns}_stage_seconds",
             "Wall-clock seconds per pipeline stage",
             ["stage"],
+            registry=self.registry,
+        )
+        # -- per-job hop ledger (platform/obs.py HopLedger) ------------
+        self.hop_seconds_per_gb = Histogram(
+            f"{ns}_hop_seconds_per_gb",
+            "Seconds spent per gigabyte moved through each transfer hop "
+            "(socket_read/splice/disk_write/hash/filter/upload/"
+            "bucket_fetch), observed once per job at settle — the "
+            "attribution the zero-copy staging work (ROADMAP item 3) "
+            "ratchets against",
+            ["hop"],
+            registry=self.registry,
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0,
+                     32.0, 64.0),
+        )
+        self.hop_bytes = Counter(
+            f"{ns}_hop_bytes_total",
+            "Bytes moved through each transfer hop (the weight behind "
+            "hop_seconds_per_gb)",
+            ["hop"],
+            registry=self.registry,
+        )
+        self.hop_seconds = Counter(
+            f"{ns}_hop_seconds_total",
+            "Seconds spent in each transfer hop (with hop_bytes_total: "
+            "fleet-wide where-does-a-gigabyte's-time-go attribution)",
+            ["hop"],
             registry=self.registry,
         )
         self.queue_wait_seconds = Histogram(
@@ -274,8 +314,18 @@ class Metrics:
             f"{ns}_fleet_gc_removed_total",
             "Objects reclaimed by the fleet GC sweep, by kind "
             "(shared_entry = an evicted .fleet-cache/ entry, tombstone = "
-            "a compacted .fleet/ coordination tombstone)",
+            "a compacted .fleet/ coordination tombstone, telemetry = an "
+            "aged .fleet/telemetry/ per-job trace digest)",
             ["kind"],
+            registry=self.registry,
+        )
+        self.fleet_telemetry = Counter(
+            f"{ns}_fleet_telemetry_digests_total",
+            "Per-job trace-digest traffic through the coordination store, "
+            "by op (published = digest written at settle, fetched = "
+            "digests read during cross-worker trace assembly, error = "
+            "either direction degraded)",
+            ["op"],
             registry=self.registry,
         )
         self.fleet_gc_bytes = Counter(

@@ -61,6 +61,67 @@ DEFAULT_STALL_SAMPLES = 3
 PIPELINE_STAGE = "pipeline"
 
 
+class HopLedger:
+    """Monotonic per-hop byte + time attribution for one job's transfer
+    path (socket/splice read, disk write, hashing, filter, upload).
+
+    Each ``note`` is two dict lookups and two adds — cheap enough for
+    per-chunk calls on the hot transfer loops (the ``hop_ledger_overhead_ms``
+    bench guard keeps it under 1 ms/job).  The summary is read once per
+    job: the ``hopLedger`` block on ``GET /v1/jobs/{id}``, a
+    ``hop_ledger`` flight-recorder event at settle, and the
+    ``hop_seconds_per_gb{hop}`` observations — the attribution data
+    ROADMAP item 3's zero-copy work ratchets against.
+    """
+
+    __slots__ = ("_hops",)
+
+    # per-GB observations below this weight are noise (a 4 KiB marker
+    # write "per GB" says nothing about the copy floor)
+    MIN_OBSERVE_BYTES = 1 << 20
+
+    def __init__(self) -> None:
+        # hop -> [bytes, seconds], both monotonically accumulated
+        self._hops: Dict[str, list] = {}
+
+    def note(self, hop: str, nbytes: int, seconds: float) -> None:
+        entry = self._hops.get(hop)
+        if entry is None:
+            self._hops[hop] = [int(nbytes), float(seconds)]
+        else:
+            entry[0] += int(nbytes)
+            entry[1] += seconds
+
+    def __bool__(self) -> bool:
+        return bool(self._hops)
+
+    def total_seconds(self) -> float:
+        return sum(entry[1] for entry in self._hops.values())
+
+    def summary(self) -> Dict[str, dict]:
+        """``hop -> {bytes, seconds, secondsPerGb}`` (secondsPerGb only
+        for hops that moved enough bytes to make the rate meaningful)."""
+        out: Dict[str, dict] = {}
+        for hop, (nbytes, seconds) in sorted(self._hops.items()):
+            entry = {"bytes": nbytes, "seconds": round(seconds, 6)}
+            if nbytes >= self.MIN_OBSERVE_BYTES:
+                entry["secondsPerGb"] = round(seconds / (nbytes / 1e9), 3)
+            out[hop] = entry
+        return out
+
+    def observe(self, metrics) -> None:
+        """Feed the job's totals into the fleet-wide hop metrics."""
+        for hop, (nbytes, seconds) in self._hops.items():
+            if nbytes:
+                metrics.hop_bytes.labels(hop=hop).inc(nbytes)
+            if seconds:
+                metrics.hop_seconds.labels(hop=hop).inc(seconds)
+            if nbytes >= self.MIN_OBSERVE_BYTES:
+                metrics.hop_seconds_per_gb.labels(hop=hop).observe(
+                    seconds / (nbytes / 1e9)
+                )
+
+
 class FlightRecorder:
     """Bounded ring of structured events for one job.
 

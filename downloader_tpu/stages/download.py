@@ -30,6 +30,7 @@ import os
 import posixpath
 import re
 import socket
+import time
 import urllib.parse
 import urllib.request
 import zlib
@@ -633,6 +634,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         fetched = [0]  # cumulative across resume rounds, for the watchdog
 
+        def _note_origin_wait(mark: float) -> None:
+            # request -> response-headers latency: the origin's
+            # time-to-first-byte, billed as its own hop so "slow origin"
+            # and "slow copy path" are separable in the ledger
+            if record is not None:
+                record.note_hop("origin_wait", 0, time.monotonic() - mark)
+
         async def _splice_body(resp, out_fd, offset=None, limit=None,
                                strict=True) -> int:
             """Kernel-path body landing: socket -> pipe -> file, no
@@ -705,6 +713,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             try:
                 if head:
                     landed = min(len(head), cap)
+                    write_mark = time.monotonic()
                     if offset is None:
                         _write_all(out_dup, memoryview(head)[:cap], None)
                     else:
@@ -714,6 +723,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         await asyncio.to_thread(
                             _write_all, out_dup, memoryview(head)[:cap],
                             offset)
+                    if record is not None:
+                        record.note_hop("disk_write", landed,
+                                        time.monotonic() - write_mark)
                     total = landed
                     fetched[0] += landed
                     watchdog.feed(fetched[0])
@@ -735,6 +747,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     pass  # pipe stays at the kernel default: just slower
                 while remaining > 0:
                     cancel.raise_if_cancelled()
+                    slice_mark = time.monotonic()
                     fut = asyncio.ensure_future(asyncio.to_thread(
                         _splice_slice_blocking, sock_fd, pipe_r, pipe_w,
                         out_dup, min(remaining, _SPLICE_SLICE),
@@ -755,6 +768,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             # finally handles that case (review r5)
                             pass
                         raise
+                    if record is not None and moved:
+                        # one hop for the whole kernel path: socket ->
+                        # pipe -> file never touches userspace, so there
+                        # is no read/write boundary to attribute across
+                        record.note_hop("splice", moved,
+                                        time.monotonic() - slice_mark)
                     if moved == 0:
                         if not strict:
                             break  # segment range loop re-requests
@@ -793,7 +812,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     fh.seek(0, os.SEEK_END)
                 if use_splice:
                     return await _splice_body(resp, fh.fileno())
+                # hop ledger: socket_read = waiting on (and draining) the
+                # response stream, disk_write = the write call itself.
+                # Limiter sleeps are deliberate pacing, not a copy hop,
+                # so the read clock restarts after each loop body.
+                hop_mark = time.monotonic()
                 async for raw in resp.content.iter_any():
+                    if record is not None:
+                        record.note_hop("socket_read", len(raw),
+                                        time.monotonic() - hop_mark)
                     cancel.raise_if_cancelled()
                     if limiter is not None:
                         await limiter.consume(len(raw))
@@ -803,8 +830,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     watchdog.feed(fetched[0])
                     data = decoder.decompress(raw) if decoder else raw
                     if data:
+                        write_mark = time.monotonic()
                         fh.write(data)
+                        if record is not None:
+                            record.note_hop("disk_write", len(data),
+                                            time.monotonic() - write_mark)
                         total += len(data)
+                    hop_mark = time.monotonic()
                 if decoder is not None:
                     tail = decoder.flush()
                     if tail:
@@ -940,9 +972,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         "Range": f"bytes={seg[1]}-{seg[2] - 1}",
                         "If-Range": validator,
                     }
+                    request_mark = time.monotonic()
                     async with session.get(
                         resource_url, headers=headers
                     ) as resp:
+                        _note_origin_wait(request_mark)
                         if resp.status == 200:
                             raise _EntityChangedDuringSegments()
                         if resp.status != 206:
@@ -966,7 +1000,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 limit=seg[2] - seg[1], strict=False)
                             seg[1] += got
                         else:
+                            hop_mark = time.monotonic()
                             async for raw in resp.content.iter_any():
+                                if record is not None:
+                                    # per-segment busy time: concurrent
+                                    # segments each bill their own wait,
+                                    # so the hop sums are busy-seconds,
+                                    # not wall (like CPU time)
+                                    record.note_hop(
+                                        "socket_read", len(raw),
+                                        time.monotonic() - hop_mark)
                                 cancel.raise_if_cancelled()
                                 if limiter is not None:
                                     await limiter.consume(len(raw))
@@ -975,11 +1018,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 # never write past our segment: a peer
                                 # segment owns the bytes after seg[2]
                                 data = raw[:seg[2] - seg[1]]
+                                write_mark = time.monotonic()
                                 await loop.run_in_executor(
                                     io_pool, os.pwrite, fd, data, seg[1])
+                                if record is not None:
+                                    record.note_hop(
+                                        "disk_write", len(data),
+                                        time.monotonic() - write_mark)
                                 seg[1] += len(data)
                                 if len(data) < len(raw):
                                     break  # server over-delivered; done
+                                hop_mark = time.monotonic()
                     if seg[1] == before:
                         # a capped/empty 206 must still advance, else
                         # this loops forever against a broken origin
@@ -1113,9 +1162,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         "Range": f"bytes={offset}-",
                         "If-Range": validator,
                     }
+                    request_mark = time.monotonic()
                     async with session.get(
                         resource_url, headers=headers
                     ) as resp:
+                        _note_origin_wait(request_mark)
                         crange = _content_range(resp)
                         if (
                             resp.status == 206
@@ -1172,9 +1223,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         # mis-ranged/unparseable 206 or stale 416: restart
                         break
                 _discard_partial()
+                request_mark = time.monotonic()
                 async with session.get(
                     resource_url, headers=base_headers
                 ) as resp:
+                    _note_origin_wait(request_mark)
                     resp.raise_for_status()
                     try:
                         expected = int(resp.headers.get("Content-Length", 0))
@@ -1273,10 +1326,29 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # placeholder is never read as content.
                 with open(local, "ab"):
                     pass
+            # live per-chunk transfer counters (ObjectStore.fget_object
+            # progress callback): a multi-GB object is then visibly
+            # moving in GET /v1/jobs/{id}/events instead of flat until
+            # its final byte
+            moved = [0]
+
+            async def _on_chunk(n: int) -> None:
+                moved[0] += n
+                if ctx.record is not None:
+                    ctx.record.note_transfer("download", total + moved[0])
+
             for item, local in items:
                 cancel.raise_if_cancelled()
                 logger.info("bucket fetch", object=item.name, to=local)
-                await client.fget_object(params["bucket"], item.name, local)
+                moved[0] = 0
+                fetch_mark = time.monotonic()
+                await client.fget_object(params["bucket"], item.name,
+                                         local, progress=_on_chunk)
+                if ctx.record is not None:
+                    # one combined hop: the driver streams socket -> disk
+                    # inside fget, so read/write are not separable here
+                    ctx.record.note_hop("bucket_fetch", item.size,
+                                        time.monotonic() - fetch_mark)
                 total += item.size
                 if ctx.record is not None:
                     ctx.record.note_transfer("download", total)
@@ -1466,8 +1538,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 return
             fleet = ctx.resources.get("fleet_plane")
             if fleet is not None:
+                async def _led_fill() -> None:
+                    # the fetch every parked waiter is actually waiting
+                    # on, as a named span in THIS job's trace — the
+                    # lease doc carries our traceparent, so a waiter's
+                    # assembled trace (GET /v1/trace) shows this span
+                    # under the leader's worker id
+                    with ctx.tracer.span("fleet.origin_fetch",
+                                         key=key[:16]):
+                        await origin_fill(report)
+
                 outcome = await fleet.coordinate(
-                    key, cache, lambda: origin_fill(report),
+                    key, cache, _led_fill,
                     cancel=cancel, record=ctx.record,
                     registry=ctx.resources.get("job_registry"),
                     slot=ctx.slot, logger=logger,

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import os
 import re
+import time
 from typing import List
 
 from .. import schemas
@@ -197,9 +198,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         logger.info("processing directory", path=download_path)
 
         with ctx.tracer.span("stage.process", path=download_path):
+            walk_mark = time.monotonic()
             found = await asyncio.to_thread(
                 find_media_files, download_path, job.media, logger, exts
             )
+            if ctx.record is not None:
+                # the media-filter walk, on the hop ledger (barrier
+                # dispatch; the streaming pipeline bills its own)
+                ctx.record.note_hop("filter", 0,
+                                    time.monotonic() - walk_mark)
 
         if len(found) == 0:
             raise NoMediaFilesError("Failed to find any suitable media files")

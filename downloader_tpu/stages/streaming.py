@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import List
 
 from .. import schemas
@@ -220,7 +221,12 @@ async def run_streaming_job(ctx: StageContext, media) -> None:
             name = os.path.basename(event.path)
             if record is not None:
                 record.event("file_complete", file=name, bytes=event.size)
-            if await asyncio.to_thread(allow, event.path):
+            filter_mark = time.monotonic()
+            verdict = await asyncio.to_thread(allow, event.path)
+            if record is not None:
+                record.note_hop("filter", event.size,
+                                time.monotonic() - filter_mark)
+            if verdict:
                 logger.info("pipeline: file complete, queued for upload",
                             file=name)
                 await _enqueue(event.path)
@@ -273,10 +279,13 @@ async def run_streaming_job(ctx: StageContext, media) -> None:
             # the process stage: it catches files the stream never
             # announced (cache hits materialize a whole workdir at once)
             # and decides the zero-matches error
+            walk_mark = time.monotonic()
             found = await asyncio.to_thread(
                 find_media_files, download_path, media, logger, exts
             )
             if record is not None:
+                record.note_hop("filter", 0,
+                                time.monotonic() - walk_mark)
                 record.event("process", files=len(found))
             if len(found) == 0:
                 raise NoMediaFilesError(

@@ -53,7 +53,8 @@ def done_marker_name(media_id: str) -> str:
     return posixpath.join(media_id, "original", DONE_MARKER)
 
 
-async def _already_staged(store, name: str, file_path: str):
+async def _already_staged(store, name: str, file_path: str, record=None,
+                          size=None):
     """The staged object's info when it provably holds this file's
     bytes, else None (truthy/falsy, so it still reads as a predicate).
 
@@ -67,12 +68,31 @@ async def _already_staged(store, name: str, file_path: str):
     hit the returned ``ObjectInfo`` carries the verified size + etag, so
     the caller's content manifest (stages/manifest.py) records the SAME
     hash the skip decision trusted — no second stat, no re-read.
+
+    Hop-ledger billing lives here because only this function knows
+    whether a local re-hash actually ran: the ``hash`` hop gets the
+    file's bytes only when md5/multipart-etag computed over them (the
+    seconds-per-GB hashing rate the attribution exists for); a probe
+    that stopped at stat/size/etag gating bills its wall at ZERO bytes,
+    so the common first-upload path can't drag the fleet-wide
+    ``hop_seconds_per_gb{hop="hash"}`` rate toward "hashing is free".
     """
+    probe_mark = time.monotonic()
+
+    def _bill(hashed_bytes: int) -> None:
+        if record is not None:
+            record.note_hop("hash", hashed_bytes,
+                            time.monotonic() - probe_mark)
+
     try:
         info = await store.stat_object(STAGING_BUCKET, name)
     except Exception:
+        _bill(0)
         return None
-    if not info.etag or info.size != os.path.getsize(file_path):
+    if size is None:
+        size = os.path.getsize(file_path)
+    if not info.etag or info.size != size:
+        _bill(0)
         return None
     if "-" in info.etag:
         # multipart object: its etag is md5-of-part-md5s at the store's
@@ -81,12 +101,15 @@ async def _already_staged(store, name: str, file_path: str):
         # the files resume matters for
         part_size = getattr(store, "multipart_part_size", None)
         if not part_size:
+            _bill(0)
             return None
         expected = await asyncio.to_thread(
             multipart_etag_hex, file_path, part_size
         )
+        _bill(size)
         return info if info.etag == expected else None
     expected = await asyncio.to_thread(md5_file_hex, file_path)
+    _bill(size)
     return info if info.etag == expected else None
 
 
@@ -180,9 +203,15 @@ class Uploader:
             if not await self.store.bucket_exists(STAGING_BUCKET):
                 await self.store.make_bucket(STAGING_BUCKET)
 
+        bucket_mark = time.monotonic()
         await self.retrier.run("store.bucket", _ensure,
                                cancel=self.ctx.cancel,
                                record=self.ctx.record, logger=self.logger)
+        if self.ctx.record is not None:
+            # zero-byte control traffic still bills the upload hop: the
+            # ledger's hop seconds should tile the staging wall
+            self.ctx.record.note_hop("upload", 0,
+                                     time.monotonic() - bucket_mark)
         self.ctx.resources["staging_bucket_ready"] = True
 
     def _put_supports_progress(self) -> bool:
@@ -216,11 +245,18 @@ class Uploader:
             raise FileNotFoundError(f"{file_path} not found.")
 
         name = object_name(media_id, file_path)
+        # size BEFORE the put: consume=True permits the backend to take
+        # the path destructively (also the hash hop's byte weight)
+        size = os.path.getsize(file_path)
         # file-level resume: a redelivered job (crash/nack before the
         # done marker was written) skips files whose bytes are provably
         # already staged — the reference re-uploads everything from
-        # scratch (lib/upload.js:34-52)
-        staged = await _already_staged(self.store, name, file_path)
+        # scratch (lib/upload.js:34-52).  The probe bills the ``hash``
+        # hop itself: file bytes only when a re-hash actually ran — the
+        # "hashing still copies through userspace" slice of ROADMAP
+        # item 3's copy floor
+        staged = await _already_staged(self.store, name, file_path,
+                                       record=ctx.record, size=size)
         if staged is not None:
             self.logger.info("already staged, skipping", file=file_path)
             manifest = await self.manifest_for(media_id)
@@ -235,9 +271,6 @@ class Uploader:
                                  skipped=True)
             return 0
 
-        # size BEFORE the put: consume=True permits the backend to take
-        # the path destructively
-        size = os.path.getsize(file_path)
         if ctx.record is not None:
             ctx.record.event("upload_start", file=basename, bytes=size)
         started = time.monotonic()
@@ -286,6 +319,7 @@ class Uploader:
         # charged for bytes that actually moved, so a retried part is
         # paced again like any other bytes); the store breaker opens on
         # a hard-down backend and parks intake at the orchestrator
+        upload_mark = time.monotonic()
         await self.retrier.run("store.put", _put, cancel=ctx.cancel,
                                record=ctx.record, logger=self.logger)
         manifest = await self.manifest_for(media_id)
@@ -305,6 +339,11 @@ class Uploader:
                 manifest.note(name, size=size, etag="", file=file_path)
             await asyncio.to_thread(manifest.persist)
         if ctx.record is not None:
+            # the put + manifest seal, as one egress hop (pacing sleeps
+            # inside the limiter are part of the hop here: egress wall
+            # is what the attribution answers for uploads)
+            ctx.record.note_hop("upload", size,
+                                time.monotonic() - upload_mark)
             ctx.record.add_bytes("uploaded", size)
             ctx.record.event(
                 "upload_done", file=basename, bytes=size,
@@ -330,6 +369,7 @@ class Uploader:
             return
         from .manifest import StagedSetMismatch
 
+        verify_mark = time.monotonic()
         try:
             verified, unverifiable = await manifest.verify_staged(
                 self.store, STAGING_BUCKET, files, object_name
@@ -347,6 +387,8 @@ class Uploader:
             self.logger.warn("staged objects unverifiable, sealing on "
                              "put success alone", count=unverifiable)
         if self.ctx.record is not None:
+            self.ctx.record.note_hop("upload", 0,
+                                     time.monotonic() - verify_mark)
             self.ctx.record.event("manifest_verified", files=verified,
                                   unverifiable=unverifiable)
 
@@ -360,8 +402,12 @@ class Uploader:
                 await faults.fire("store.put", key=name)
             await self.store.put_object(STAGING_BUCKET, name, b"true")
 
+        seal_mark = time.monotonic()
         await self.retrier.run("store.put", _seal, cancel=self.ctx.cancel,
                                record=self.ctx.record, logger=self.logger)
+        if self.ctx.record is not None:
+            self.ctx.record.note_hop("upload", 0,
+                                     time.monotonic() - seal_mark)
 
     async def cleanup_workdir(self, download_path: str) -> None:
         """Best-effort download-dir removal (reference lib/upload.js:60-64)."""

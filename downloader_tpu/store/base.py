@@ -56,9 +56,14 @@ class ObjectStore(abc.ABC):
         """Store bytes as an object (reference lib/upload.js:55)."""
 
     @abc.abstractmethod
-    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fget_object(self, bucket: str, name: str, file_path: str,
+                          *, progress=None) -> None:
         """Download an object to a local file, creating parent dirs
-        (reference lib/download.js:225)."""
+        (reference lib/download.js:225).
+
+        ``progress`` is an optional ``async (bytes_moved)`` callback for
+        live transfer counters; backends that land the file in one step
+        may fire it once with the full size."""
 
     @abc.abstractmethod
     async def fput_object(self, bucket: str, name: str, file_path: str,

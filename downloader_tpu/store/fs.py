@@ -156,12 +156,16 @@ class FilesystemObjectStore(ObjectStore):
             self._should_sweep(path),
         )
 
-    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fget_object(self, bucket: str, name: str, file_path: str,
+                          *, progress=None) -> None:
         src = self._object_path(bucket, name)
         if not await asyncio.to_thread(os.path.isfile, src):
             raise ObjectNotFound(bucket, name)
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
         await asyncio.to_thread(shutil.copyfile, src, file_path)
+        if progress is not None:
+            await progress(
+                await asyncio.to_thread(os.path.getsize, file_path))
 
     async def fput_object(self, bucket: str, name: str, file_path: str,
                           *, consume: bool = False) -> None:

@@ -39,10 +39,13 @@ class InMemoryObjectStore(ObjectStore):
         async with self._lock:
             self._buckets.setdefault(bucket, {})[name] = bytes(data)
 
-    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fget_object(self, bucket: str, name: str, file_path: str,
+                          *, progress=None) -> None:
         data = await self.get_object(bucket, name)
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
         await asyncio.to_thread(_write_file, file_path, data)
+        if progress is not None:
+            await progress(len(data))
 
     async def fput_object(self, bucket: str, name: str, file_path: str,
                           *, consume: bool = False) -> None:

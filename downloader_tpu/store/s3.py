@@ -277,9 +277,16 @@ class S3ObjectStore(ObjectStore):
         if resp.status not in (200, 204, 404):
             raise _status_error("remove_object", resp.status, body)
 
-    async def fget_object(self, bucket: str, name: str, file_path: str) -> None:
+    async def fget_object(self, bucket: str, name: str, file_path: str,
+                          *, progress=None) -> None:
         """Streaming GET straight to disk — media files can be tens of GB,
-        so the body must never be buffered whole in memory."""
+        so the body must never be buffered whole in memory.
+
+        ``progress`` is an optional ``async (bytes_moved)`` callback
+        fired after each chunk lands on disk, so callers (the download
+        stage's ``bucket`` method, the fleet shared tier) can keep live
+        transfer counters moving during a multi-GB object instead of
+        jumping once at the end."""
         path = self._object_path(bucket, name)
         resp = await self._request("GET", path)
         try:
@@ -292,6 +299,8 @@ class S3ObjectStore(ObjectStore):
             with open(file_path, "wb") as fh:
                 async for chunk in resp.content.iter_chunked(1 << 20):
                     fh.write(chunk)
+                    if progress is not None:
+                        await progress(len(chunk))
         finally:
             resp.release()
 

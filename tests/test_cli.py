@@ -209,3 +209,81 @@ async def test_cli_status_against_live_service(tmp_path, capsys):
         assert rc == 2
     finally:
         await runner.cleanup()
+
+
+async def _admin_rig(tmp_path):
+    """A live orchestrator + admin server (no broker consumption): the
+    rig the jobs/trace CLI tests poke over real HTTP."""
+    from downloader_tpu.health import start_server
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform import metrics as prom
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    metrics = prom.new("downloader")
+    orch = Orchestrator(
+        config=ConfigNode({"instance": {"download_path": str(tmp_path)}}),
+        mq=MemoryQueue(broker), store=None,
+        telemetry=Telemetry(telem_mq), metrics=metrics, logger=NullLogger(),
+    )
+    runner = await start_server(orch, metrics=metrics, port=0)
+    return orch, runner, runner.addresses[0][1]
+
+
+async def test_cli_jobs_events_follow_tails_until_terminal(tmp_path, capsys):
+    """ISSUE 9 satellite: ``jobs events --follow`` live-tails — events
+    recorded *after* the first poll still print, and the loop exits on
+    its own once the job settles."""
+    from downloader_tpu.control.registry import CANCELLED
+
+    orch, runner, port = await _admin_rig(tmp_path)
+    try:
+        record = orch.registry.register("job-follow-1", "card")
+        record.event("queue_wait", seconds=0.12)
+        follow = asyncio.create_task(asyncio.to_thread(
+            cli.main,
+            ["jobs", "events", "job-follow-1", "--follow",
+             "--interval", "0.1", "--url", f"http://127.0.0.1:{port}"],
+        ))
+        await asyncio.sleep(0.5)
+        record.event("settle", outcome="cancelled")
+        orch.registry.transition(record, CANCELLED)
+        rc = await asyncio.wait_for(follow, 15)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "queue_wait" in out          # pre-follow event
+        assert "settle" in out              # event recorded mid-follow
+        assert "state=RECEIVED" in out      # header shows receipt state
+    finally:
+        await runner.cleanup()
+
+
+async def test_cli_trace_show_renders_local_view(tmp_path, capsys):
+    """``cli trace show`` renders the assembled trace (local-only here:
+    no fleet plane attached) and exits 1 on an unknown trace id."""
+    trace_id = "ab" * 16
+
+    orch, runner, port = await _admin_rig(tmp_path)
+    try:
+        record = orch.registry.register("job-trace-1", "card")
+        record.trace_id = trace_id
+        record.span_id = "cd" * 8
+        record.event("span", spanId=record.span_id)
+        base = f"http://127.0.0.1:{port}"
+        rc = await asyncio.to_thread(
+            cli.main, ["trace", "show", trace_id, "--url", base])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"# trace {trace_id}" in out
+        assert "job-trace-1" in out
+
+        rc = await asyncio.to_thread(
+            cli.main, ["trace", "show", "ff" * 16, "--url", base])
+        assert rc == 1
+    finally:
+        await runner.cleanup()

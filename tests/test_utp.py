@@ -694,19 +694,35 @@ async def test_syn_flood_is_bounded(monkeypatch):
 
 async def test_idle_connection_reaped(monkeypatch):
     """A connected peer that goes silent is aborted after IDLE_TIMEOUT
-    (healthy BT connections keep-alive every 60 s)."""
+    (healthy BT connections keep-alive every 60 s).
+
+    Margins are deliberately wide (ISSUE 9 satellite): under full-suite
+    load the event loop can stall long enough that a 0.2 s idle window
+    expires between the handshake and the first assert — the conn was
+    then reaped *early*, which the old ``== 1`` read as a failure — and
+    a loaded box can also need more than 5 s of wall for a timer that
+    only has to fire once.  The reap itself is proven by the accept
+    handler's pending read raising the idle-timeout reset (not by conn
+    counts, which are also empty when tracking never happened at all).
+    """
     from downloader_tpu.torrent import utp as utp_mod
 
-    monkeypatch.setattr(utp_mod, "IDLE_TIMEOUT", 0.2)
+    monkeypatch.setattr(utp_mod, "IDLE_TIMEOUT", 0.75)
+    reaped = asyncio.get_running_loop().create_future()
 
     async def handler(reader, _writer):
-        await reader.read(1)
+        try:
+            await reader.read(1)
+        except ConnectionResetError as err:  # the reap's abort(exc)
+            if not reaped.done():
+                reaped.set_result(str(err))
 
     server = await UtpEndpoint.create("127.0.0.1", 0, accept_cb=handler)
     try:
         _reader, writer = await open_utp_connection(*server.local_addr)
-        assert len(server._conns) == 1
-        async with asyncio.timeout(5):
+        assert len(server._conns) <= 1  # 0 = already reaped, still a reap
+        async with asyncio.timeout(20):
+            assert "idle" in await reaped
             while server._conns:
                 await asyncio.sleep(0.05)
         writer.close()
