@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs chaos crash fleet obs proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing chaos crash fleet obs origins proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -37,6 +37,13 @@ fleet:
 obs:
 	python -m pytest tests/test_obs.py tests/test_trace.py -v
 
+# origin-plane suite: multi-origin racing fetch (work-stealing ranges,
+# per-origin breakers, straggler duplication, failover) + HLS-style
+# segment-manifest ingest (live polling, VOD fast path, live window,
+# overlap acceptance through the full orchestrator)
+origins:
+	python -m pytest tests/test_origins.py -v
+
 lint:
 	python -m pytest tests/test_lint.py -q
 
@@ -71,6 +78,12 @@ bench-crash:
 # end-to-end job, must stay within 5% of 1.0)
 bench-obs:
 	python bench.py --obs
+
+# standalone origin-plane racing bench (one JSON line: with one fast +
+# one throttled mirror, racing must beat the slow origin alone by
+# >= 1.5x AND stay within 10% of the fast origin alone)
+bench-racing:
+	python bench.py --racing
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

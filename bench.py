@@ -143,7 +143,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  within 5% (the ledger must account for the wall it claims to
 #  attribute).  ``python bench.py --obs`` runs standalone
 #  (`make bench-obs`).
-HARNESS_VERSION = 16
+HARNESS_VERSION = 17
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2109,6 +2109,126 @@ def _bench_obs_safe() -> dict:
         return {"obs_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_racing() -> dict:
+    """Racing-fetch bench (harness v17, origin plane): one fast + one
+    throttled mirror serving the same entity, three arms driven through
+    the REAL download stage (racing scheduler, per-origin seams, splice
+    landing):
+
+    - ``slow``: the throttled origin alone (the racing job's primary)
+    - ``fast``: the fast origin alone (the no-regression reference)
+    - ``racing``: slow primary + fast mirror
+
+    Both origins pace via token-bucket-style sleeps, so each arm's wall
+    is pacing-dominated and the RATIOS are robust to this host's CPU
+    contention (the de-noising discipline every bench here uses).
+
+    Guards: ``racing_speedup`` = slow/racing >= 1.5 (racing must beat
+    the slow origin it was submitted against) AND ``racing_vs_fast`` =
+    racing/fast <= 1.10 (when the mirror adds nothing — the entity is
+    fast-origin-bound — racing must cost at most 10%).
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from helpers import RangeOrigin
+
+    from downloader_tpu import schemas
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.stages.base import Job, StageContext
+    from downloader_tpu.stages.download import stage_factory
+    from downloader_tpu.utils import EventEmitter
+
+    mib = int(os.environ.get("BENCH_RACING_MIB", 16))
+    slow_rate = int(os.environ.get("BENCH_RACING_SLOW_RATE", 2 << 20))
+    fast_rate = int(os.environ.get("BENCH_RACING_FAST_RATE", 8 << 20))
+    reps = int(os.environ.get("BENCH_RACING_REPS", 2))
+    # env knobs outrank config (repo convention): an exported
+    # HTTP_SEGMENTS would change every arm's connection count, a cache
+    # dir would serve later arms from the first arm's bytes
+    for knob in ("HTTP_SEGMENTS", "CACHE_DIR", "CACHE_ENABLED"):
+        os.environ.pop(knob, None)
+
+    payload = os.urandom(mib << 20)
+    tmp = tempfile.mkdtemp()
+
+    async def run_arm(tag: str, primary, mirror=None) -> float:
+        ctx = StageContext(
+            config=ConfigNode({"instance": {
+                "download_path": os.path.join(tmp, f"dl-{tag}"),
+            }}),
+            emitter=EventEmitter(), logger=NullLogger(),
+        )
+        download = await stage_factory(ctx)
+        job = Job(
+            media=schemas.Media(
+                id=f"race-{tag}", creator_id="bench",
+                type=schemas.MediaType.Value("MOVIE"),
+                source=schemas.SourceType.Value("HTTP"),
+                source_uri=primary.url,
+            ),
+            mirrors=(mirror.url,) if mirror is not None else (),
+        )
+        started = time.monotonic()
+        result = await download(job)
+        elapsed = time.monotonic() - started
+        out = os.path.join(result["path"], "media.bin")
+        assert os.path.getsize(out) == len(payload), \
+            f"{tag}: short download"
+        shutil.rmtree(os.path.join(tmp, f"dl-{tag}"),
+                      ignore_errors=True)
+        return elapsed
+
+    speedups, vs_fast, racing_walls = [], [], []
+    try:
+        for _rep in range(reps):
+            slow = RangeOrigin(payload, etag='"bench"', rate=slow_rate)
+            fast = RangeOrigin(payload, etag='"bench"', rate=fast_rate)
+            await slow.start()
+            await fast.start()
+            try:
+                # interleaved rounds, per-round ratios (BASELINE.md
+                # de-noising: never mix host states across rounds)
+                slow_wall = await run_arm("slow", slow)
+                fast_wall = await run_arm("fast", fast)
+                racing_wall = await run_arm("racing", slow, fast)
+            finally:
+                await slow.stop()
+                await fast.stop()
+            speedups.append(slow_wall / racing_wall)
+            vs_fast.append(racing_wall / fast_wall)
+            racing_walls.append(racing_wall)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    speedup = statistics.median(speedups)
+    regression = statistics.median(vs_fast)
+    return {
+        "racing_speedup": round(speedup, 2),
+        "racing_vs_fast": round(regression, 3),
+        "racing_ok": speedup >= 1.5 and regression <= 1.10,
+        "racing_wall_ms": round(
+            statistics.median(racing_walls) * 1000, 1),
+        "racing_mib": mib,
+        "racing_slow_mibps": round(slow_rate / (1 << 20), 1),
+        "racing_fast_mibps": round(fast_rate / (1 << 20), 1),
+        "racing_reps": reps,
+    }
+
+
+def _bench_racing_safe() -> dict:
+    """A racing-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_racing())
+    except Exception as err:
+        return {"racing_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
 # Final-line headline keys, in keep-priority order (first = kept
 # longest under the size cap).  ~15 keys: the driver's 2,000-char tail
 # capture must always see the full final line (VERDICT r5 item 1);
@@ -2150,6 +2270,9 @@ HEADLINE_KEYS = [
     "trace_overhead_ms",          # r14 guard: trace propagation < 1 ms/job
     "hop_ledger_coverage",        # r14: hop seconds / stage wall, 0.95..1.05
     "obs_bench_error",            # present only on failure — visible
+    "racing_speedup",             # r15: racing vs the slow origin, >= 1.5
+    "racing_vs_fast",             # r15 guard: <= 1.10 of fast-alone
+    "racing_bench_error",         # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -2196,6 +2319,10 @@ def main() -> None:
         # standalone fleet-observability run (`make bench-obs`)
         print(json.dumps(_bench_obs_safe()))
         return
+    if "--racing" in sys.argv:
+        # standalone origin-plane racing run (`make bench-racing`)
+        print(json.dumps(_bench_racing_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -2219,6 +2346,7 @@ def main() -> None:
         **_bench_faults_safe(),
         **_bench_crash_safe(),
         **_bench_obs_safe(),
+        **_bench_racing_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

@@ -85,6 +85,21 @@ def _build_parser() -> argparse.ArgumentParser:
              "dropped (EXPIRED), expired HIGH/NORMAL jobs are flagged "
              "but still run (0 = no deadline)",
     )
+    submit.add_argument(
+        "--mirror", action="append", default=[], metavar="URL",
+        help="redundant origin for the SAME entity (repeatable): http(s) "
+             "mirror URLs the racing fetcher spreads byte ranges across "
+             "(per-origin breakers, straggler duplication, failover), or "
+             "extra webseeds for a torrent source",
+    )
+    submit.add_argument(
+        "--source-kind", default="AUTO", type=str.upper,
+        choices=list(schemas.SourceKind.keys()),
+        help="how the source URI is interpreted: AUTO (historical "
+             "dispatch on --source), DIRECT (whole-entity fetch), or "
+             "MANIFEST (HLS-style media playlist ingested segment by "
+             "segment, live or VOD)",
+    )
     submit.add_argument("--queue", default=schemas.DOWNLOAD_QUEUE)
     submit.add_argument("--wait", action="store_true",
                         help="tap telemetry and block until the job's "
@@ -340,7 +355,9 @@ async def _submit(args) -> int:
         priority=schemas.JobPriority.Value(args.priority),
         tenant=args.tenant,
         ttl_seconds=max(args.ttl, 0.0),
+        source_kind=schemas.SourceKind.Value(args.source_kind),
     )
+    msg.mirrors.extend(args.mirror)
     from .platform.tracing import format_traceparent, init_tracer
 
     tracer = init_tracer("downloader-cli", logger, config)

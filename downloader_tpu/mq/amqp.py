@@ -707,11 +707,15 @@ class AmqpQueue(MessageQueue):
         self._pending_publishes[entry] = None
         try:
             await self._send_publish(entry)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, EOFError):
             # connection died mid-send (possibly before the read loop
             # noticed): _establish resends everything unconfirmed, so just
             # fall through to waiting on the confirm.  Worst case is a
             # duplicate publish — at-least-once, like the broker's delivery.
+            # EOFError covers asyncio.IncompleteReadError: a drop DURING a
+            # declare/bind RPC surfaces through the rpc future as the read
+            # loop's readexactly EOF, not as a ConnectionError — it used
+            # to escape here and fail a publish a reconnect would repair.
             if self._closing:
                 self._pending_publishes.pop(entry, None)
                 raise
@@ -748,7 +752,9 @@ class AmqpQueue(MessageQueue):
         self._consuming = True
         try:
             await self._start_consumer(sub)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, EOFError):
+            # EOFError = IncompleteReadError from a drop mid-RPC, same
+            # repairable case as the publish path
             if self._closing:
                 raise
             # the subscription is registered: the reconnect loop will

@@ -1511,7 +1511,13 @@ class Orchestrator:
                     logger.info("invoking streaming pipeline")
                     started = time.monotonic()
                     try:
-                        await token.guard(run_streaming_job(ctx, msg.media))
+                        await token.guard(run_streaming_job(
+                            ctx, msg.media,
+                            mirrors=tuple(msg.mirrors),
+                            source_kind=schemas.enum_to_string(
+                                schemas.SourceKind, msg.source_kind
+                            ),
+                        ))
                     finally:
                         if self.metrics is not None:
                             self.metrics.stage_seconds.labels(
@@ -1523,7 +1529,12 @@ class Orchestrator:
                                                  stage=name)
                         token.raise_if_cancelled()
                         job = Job(media=msg.media,
-                                  last_stage=last_stage_data)
+                                  last_stage=last_stage_data,
+                                  mirrors=tuple(msg.mirrors),
+                                  source_kind=schemas.enum_to_string(
+                                      schemas.SourceKind,
+                                      msg.source_kind,
+                                  ))
                         logger.info("invoking stage", stage=name)
                         started = time.monotonic()
                         try:

@@ -113,6 +113,40 @@ DEFAULTS: dict = {
     # # Download.ttl_seconds (deadline from receipt): expired BULK is
     # # dropped as EXPIRED, expired HIGH/NORMAL is surfaced but runs.
     #
+    # Origin plane (origins/; docs/OPERATIONS.md "Origins & live
+    # ingest").  Zero config needed: a job without Download.mirrors
+    # and with source_kind AUTO behaves exactly as before.
+    # "origins": {
+    #   "max_labels": 16,      # distinct origin metric/breaker labels
+    #       # per process; overflow hosts collapse to "other" (job
+    #       # payloads must not mint Prometheus series — the tenant
+    #       # posture)
+    #   "dup_factor": 1.25,    # an idle origin duplicates a straggler
+    #       # tail only when its EWMA beats the owner's by this factor
+    #   "min_dup_bytes": 1048576,  # tails smaller than this are waited
+    #       # out, not duplicated
+    #   "stall_takeover": 10.0,    # an in-flight range that lands
+    #       # nothing for this long is treated as black-holed: idle
+    #       # origins may duplicate/take it over regardless of the
+    #       # EWMA and min-tail gates
+    #   "hedge_delay": 1.0,    # manifest segment fetch: seconds to wait
+    #       # for an origin's FIRST byte before hedging to the next
+    #   "manifest": {
+    #     "min_poll": 0.25,    # playlist refresh clamp (refresh runs at
+    #     "max_poll": 6.0,     # target_duration/2 between these bounds)
+    #     "stall_timeout": 240.0,  # live playlist unchanged this long
+    #         # => ERRDLSTALL (ack + drop, the dead-stream policy)
+    #     "live_window": 0,    # join a live playlist at most this many
+    #         # segments behind the live edge (0 = from the beginning)
+    #   },
+    # },
+    # # Per-origin fault seams inherit family config:
+    # # retry.origin.{attempts,base,cap} and
+    # # breakers.origin.{threshold,reset,enabled} cover every
+    # # origin:<host> dependency (breakers default ON per origin — a
+    # # dead mirror must open ITS breaker without parking the fleet;
+    # # admission still keys only on store/publish).
+    #
     # Fleet coordination plane (fleet/): disabled by default — a lone
     # worker pays nothing.  See docs/ARCHITECTURE.md "Fleet plane".
     # "fleet": {

@@ -86,6 +86,18 @@ def seam_dependency(seam: str) -> str:
     return seam.split(".", 1)[0]
 
 
+def dependency_family(dependency: str) -> Optional[str]:
+    """``origin:mirror-a:8080`` -> ``origin``: the config family a
+    *labeled* dependency inherits knobs from.  Per-origin breakers and
+    retry budgets key on ``origin:<label>`` so each origin trips
+    independently, but nobody configures per-host thresholds — the
+    ``retry.origin`` / ``breakers.origin`` sections cover the family.
+    None for plain (unlabeled) dependencies."""
+    if ":" not in dependency:
+        return None
+    return dependency.split(":", 1)[0]
+
+
 def classify(err: BaseException) -> str:
     """Bucket ``err`` into TRANSIENT / PERMANENT / POISON.
 
@@ -182,11 +194,14 @@ class RetryPolicy:
 
     @classmethod
     def from_config(cls, config, dependency: str) -> "RetryPolicy":
+        family = dependency_family(dependency)
+
         def knob(name: str, fallback):
-            return cfg_get(
-                config, f"retry.{dependency}.{name}",
-                cfg_get(config, f"retry.default.{name}", fallback),
-            )
+            fallback = cfg_get(config, f"retry.default.{name}", fallback)
+            if family is not None:
+                fallback = cfg_get(config, f"retry.{family}.{name}",
+                                   fallback)
+            return cfg_get(config, f"retry.{dependency}.{name}", fallback)
 
         attempts = int(knob("attempts", cls.attempts))
         base = float(knob("base", cls.base))
@@ -349,18 +364,29 @@ class BreakerBoard:
         self._breakers: Dict[str, CircuitBreaker] = {}
 
     def get(self, dependency: str) -> Optional[CircuitBreaker]:
+        family = dependency_family(dependency)
+        enabled_fallback = dependency not in _PER_JOB_DEPENDENCIES
+        if family is not None:
+            enabled_fallback = bool(cfg_get(
+                self.config, f"breakers.{family}.enabled",
+                enabled_fallback,
+            ))
         if not bool(cfg_get(
             self.config, f"breakers.{dependency}.enabled",
-            dependency not in _PER_JOB_DEPENDENCIES,
+            enabled_fallback,
         )):
             return None
         breaker = self._breakers.get(dependency)
         if breaker is None:
             def knob(name: str, fallback):
+                fallback = cfg_get(self.config,
+                                   f"breakers.default.{name}", fallback)
+                if family is not None:
+                    fallback = cfg_get(
+                        self.config, f"breakers.{family}.{name}", fallback
+                    )
                 return cfg_get(
-                    self.config, f"breakers.{dependency}.{name}",
-                    cfg_get(self.config, f"breakers.default.{name}",
-                            fallback),
+                    self.config, f"breakers.{dependency}.{name}", fallback
                 )
 
             breaker = CircuitBreaker(

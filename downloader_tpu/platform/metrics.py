@@ -414,6 +414,33 @@ class Metrics:
             ["tenant"],
             registry=self.registry,
         )
+        # -- origin plane (downloader_tpu/origins/) --------------------
+        # label cardinality is bounded by origins.max_labels (overflow
+        # collapses to "other"), the tenant-table posture: job payloads
+        # must not mint Prometheus series
+        self.origin_bytes = Counter(
+            f"{ns}_origin_bytes_total",
+            "Bytes landed from each origin by the racing fetcher / "
+            "manifest ingest (who actually served the fleet's bytes)",
+            ["origin"],
+            registry=self.registry,
+        )
+        self.origin_active_ranges = Gauge(
+            f"{ns}_origin_active_ranges",
+            "Byte ranges currently being fetched from each origin by "
+            "the racing scheduler (owners + straggler duplicates)",
+            ["origin"],
+            registry=self.registry,
+        )
+        self.origin_race_wins = Counter(
+            f"{ns}_origin_race_win_total",
+            "Ranges an origin completed, by how it got them: fastest = "
+            "work-stealing pull, failover = re-assigned after another "
+            "origin died mid-range, straggler_dup = duplicate tail "
+            "fetch that beat the original owner (first-byte-wins)",
+            ["origin", "reason"],
+            registry=self.registry,
+        )
         self.torrent_hash_failures = Counter(
             f"{ns}_torrent_piece_hash_failures_total",
             "Torrent pieces that failed SHA-1 verification",

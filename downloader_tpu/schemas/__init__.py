@@ -22,6 +22,7 @@ from .downloader_pb2 import (  # noqa: F401  (re-exported)
     JobPriority,
     Media,
     MediaType,
+    SourceKind,
     SourceType,
     TelemetryProgressEvent,
     TelemetryStatus,

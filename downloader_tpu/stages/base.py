@@ -123,6 +123,16 @@ class Job:
     # bytes are final — None (barrier mode / standalone stage use) keeps
     # the exact pre-streaming behavior
     file_stream: Optional[FileStream] = None
+    # origin plane (downloader_tpu/origins/): redundant origins for the
+    # SAME entity from Download.mirrors — http(s) URLs the racing fetch
+    # spreads ranges across (or extra webseeds for a torrent source).
+    # Empty = the exact single-origin behavior.
+    mirrors: tuple = ()
+    # Download.source_kind as an enum NAME ("AUTO" | "DIRECT" |
+    # "MANIFEST"): MANIFEST ingests an http(s) source_uri as an
+    # HLS-style media playlist; AUTO/DIRECT keep the historical
+    # whole-entity dispatch on Media.source.
+    source_kind: str = "AUTO"
 
 
 @dataclasses.dataclass

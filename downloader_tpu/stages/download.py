@@ -194,7 +194,21 @@ def _splice_slice_blocking(sock_fd: int, pipe_r: int, pipe_w: int,
 
 
 class _EntityChangedDuringSegments(Exception):
-    """A segment's If-Range missed: the origin entity changed mid-flight."""
+    """A segment's If-Range missed: the origin entity changed mid-flight.
+
+    ``race_abort`` marks it fatal to a whole racing attempt when the
+    PRIMARY origin raises it (origins/racing.py re-raises instead of
+    failing over): every already-landed byte was validated against the
+    old entity, so no mirror can rescue the attempt.  ``fault_class``
+    permanent keeps the per-origin Retrier from re-asking an origin
+    that just answered deterministically (a mirror serving a different
+    entity fails over instantly; the single-origin segmented path never
+    routes this through a retrier, so its restart behavior is
+    unchanged).
+    """
+
+    race_abort = True
+    fault_class = "permanent"
 
 
 def _is_encoded(headers) -> bool:
@@ -486,6 +500,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # file's last overlapping piece is verified and on disk
             await _announce_file(job, path, entry.length)
 
+        # origin plane: a torrent job's http(s) mirrors are webseeds by
+        # another name (BEP 19) — the swarm treats them as always-on
+        # HTTP origins for the same piece-verified content, which is
+        # exactly the webseed/HTTP-mirror equivalence
+        extra_webseeds = [
+            m for m in (getattr(job, "mirrors", ()) or ())
+            if isinstance(m, str)
+            and m.startswith(("http://", "https://"))
+        ]
         await client.download(
             resource_url,
             download_path,
@@ -496,6 +519,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             seed_linger=seed_linger,
             stats_out=stats,
             cancel=cancel,
+            extra_webseeds=extra_webseeds or None,
             # live verified-byte counter for the transfer profiler's
             # per-job throughput/stall sampling (rides the client's own
             # watchdog feeds)
@@ -642,7 +666,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 record.note_hop("origin_wait", 0, time.monotonic() - mark)
 
         async def _splice_body(resp, out_fd, offset=None, limit=None,
-                               strict=True) -> int:
+                               strict=True, progress=None) -> int:
             """Kernel-path body landing: socket -> pipe -> file, no
             userspace copies (see SPLICE_OK).  ~70% of staging CPU per
             byte was the two memcpys this skips (profiled r5).
@@ -654,7 +678,10 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             end; surplus response bytes die with the connection).
             ``strict`` raises on early EOF; the segmented caller
             instead returns short and lets its range loop re-request.
-            Returns bytes landed."""
+            ``progress`` (racing fetch) is called with each landed
+            byte count; returning False stops the transfer early —
+            the bytes already landed stay valid, the connection dies
+            with the response.  Returns bytes landed."""
             import fcntl
 
             transport = resp.connection.transport
@@ -731,6 +758,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     watchdog.feed(fetched[0])
                     if limiter is not None:
                         await limiter.consume(landed)
+                    if progress is not None and not progress(landed):
+                        return total
                 remaining = min(cap - total, resp_left)
                 sock = transport.get_extra_info("socket")
                 sock_fd = sock.fileno()
@@ -786,6 +815,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     watchdog.feed(fetched[0])
                     if limiter is not None:
                         await limiter.consume(moved)
+                    if progress is not None and not progress(moved):
+                        break
             finally:
                 if fut is not None and not fut.done():
                     # join interrupted: the worker may still be in
@@ -857,36 +888,173 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 except OSError:
                     pass
 
-        async def _fetch_segmented(session) -> "int | None":
-            """Download with ``seg_count`` concurrent ranged connections.
+        async def _fetch_segmented(session, job: Job) -> "int | None":
+            """Download with concurrent ranged connections — ``seg_count``
+            lanes against one origin, or (origin plane,
+            downloader_tpu/origins/) work-stealing ranges RACED across
+            the job's mirror set when ``Download.mirrors`` names
+            redundant origins for this entity.
 
             Returns fetched bytes on success, or None when the entity
             isn't segmentable (no range support, no strong validator,
             encoded body, or too small) — the caller then runs the
             sequential path.  Every segment request carries If-Range, so
             a mid-flight entity change surfaces as a 200 and aborts the
-            whole attempt instead of stitching two versions.
+            whole attempt instead of stitching two versions; a MIRROR
+            whose probe disagrees with the primary's validator/length is
+            excluded up front (it serves a different entity).
 
             Progress survives crashes: segment positions checkpoint to a
             ``.partial-seg.state`` sidecar every few seconds, and a
             redelivered job resumes each segment from its recorded
-            position when the validator still matches.
+            position when the validator still matches — racing and
+            single-origin runs share the state format, so either can
+            resume the other's partial.
             """
+            from ..origins.plan import OriginHealth, build_origin_set
+
+            health = OriginHealth.shared(ctx.resources, ctx.config)
+            origins = build_origin_set(
+                resource_url, getattr(job, "mirrors", ()) or (),
+                health=health,
+            )
             probe_headers = {**base_headers, "Range": "bytes=0-0"}
-            async with session.get(
-                resource_url, headers=probe_headers
-            ) as probe:
-                if probe.status != 206:
-                    return None  # no byte-range support
-                crange = _content_range(probe)
-                if crange is None:
-                    return None
-                total_len = crange[2]
-                validator = choose_validator(probe.headers)
-                if (not validator or _is_encoded(probe.headers)
-                        or total_len < SEG_MIN_SIZE):
-                    return None
-                await probe.read()
+
+            async def _probe_reference(origin) -> "tuple | None":
+                """Probe one origin as the entity REFERENCE: 206 +
+                strong validator + identity body, else None.
+
+                With mirrors to fail over to, even the PRIMARY's probe
+                is bounded (10 s): a black-holed primary must cost
+                seconds before a mirror is promoted, not the 240 s
+                watchdog (an explicit ``timeout=None`` would be
+                UNBOUNDED in aiohttp — not the session default).  A
+                lone origin keeps the session default, the legacy
+                behavior."""
+                kwargs = {}
+                if not origin.primary or len(origins) > 1:
+                    kwargs["timeout"] = aiohttp.ClientTimeout(total=10)
+                request_mark = time.monotonic()
+                async with session.get(
+                    origin.url, headers=probe_headers, **kwargs
+                ) as probe:
+                    _note_origin_wait(request_mark)
+                    if probe.status != 206:
+                        return None  # no byte-range support
+                    crange = _content_range(probe)
+                    if crange is None:
+                        return None
+                    ref_validator = choose_validator(probe.headers)
+                    if not ref_validator or _is_encoded(probe.headers):
+                        return None
+                    await probe.read()
+                    return ref_validator, crange[2]
+
+            # the PRIMARY defines the entity; a primary that cannot even
+            # answer its probe fails over to the first mirror that can
+            # (promoted to reference — the failover promise must cover
+            # an origin that died before the job started), while a
+            # primary that ANSWERS "not segmentable" keeps the legacy
+            # sequential path (its entity stays authoritative).
+            reference = None
+            try:
+                reference = await _probe_reference(origins[0])
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as err:
+                if len(origins) == 1:
+                    raise
+                origins[0].dead = True
+                logger.warn("primary origin probe failed; trying "
+                            "mirrors", error=str(err)[:200])
+                if record is not None:
+                    record.event("origin_probe",
+                                 origin=origins[0].label, ok=False,
+                                 primary=True,
+                                 reason=f"probe_failed: {str(err)[:80]}")
+                for mirror in origins[1:]:
+                    try:
+                        reference = await _probe_reference(mirror)
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as mirror_err:
+                        if record is not None:
+                            record.event(
+                                "origin_probe", origin=mirror.label,
+                                ok=False,
+                                reason="probe_failed: "
+                                       f"{str(mirror_err)[:80]}",
+                            )
+                        mirror.dead = True
+                        continue
+                    if reference is None:
+                        mirror.dead = True  # answered, not segmentable
+                        continue
+                    # this mirror now DEFINES the entity: mid-flight
+                    # changes on it abort the attempt like a primary's
+                    mirror.primary = True
+                    if record is not None:
+                        record.event("origin_failover",
+                                     origin=origins[0].label,
+                                     promoted=mirror.label,
+                                     what="reference_probe")
+                    break
+                if reference is None:
+                    raise  # nobody could define the entity
+            if reference is None:
+                return None
+            validator, total_len = reference
+            reference_origin = next(o for o in origins if not o.dead)
+            if total_len < SEG_MIN_SIZE and len(origins) == 1:
+                # small entities aren't worth extra connections — unless
+                # mirrors exist: racing's failover must cover small
+                # files too, and one range is cheap
+                return None
+            if record is not None:
+                record.event("origin_probe",
+                             origin=reference_origin.label, ok=True,
+                             primary=True, total=total_len,
+                             bps=round(
+                                 health.bps(reference_origin.label), 1))
+
+            async def _probe_mirror(origin) -> None:
+                """Admit a mirror only when it provably serves the SAME
+                entity: 206, equal length, equal strong validator."""
+                why = None
+                try:
+                    request_mark = time.monotonic()
+                    async with session.get(
+                        origin.url, headers=probe_headers,
+                        timeout=aiohttp.ClientTimeout(total=10),
+                    ) as resp:
+                        _note_origin_wait(request_mark)
+                        mirror_range = _content_range(resp)
+                        if resp.status != 206 or mirror_range is None:
+                            why = "no_range_support"
+                        elif mirror_range[2] != total_len:
+                            why = "length_mismatch"
+                        elif choose_validator(resp.headers) != validator:
+                            why = "validator_mismatch"
+                        elif _is_encoded(resp.headers):
+                            why = "encoded_body"
+                        else:
+                            await resp.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as err:
+                    why = f"probe_failed: {str(err)[:80]}"
+                if why is not None:
+                    origin.dead = True
+                    logger.warn("mirror excluded from racing",
+                                origin=origin.label, reason=why)
+                if record is not None:
+                    record.event("origin_probe", origin=origin.label,
+                                 ok=why is None, reason=why,
+                                 bps=round(health.bps(origin.label), 1))
+
+            unprobed = [o for o in origins
+                        if not o.dead and o is not reference_origin]
+            if unprobed:
+                await asyncio.gather(*(_probe_mirror(o)
+                                       for o in unprobed))
+            racing = [o for o in origins if not o.dead]
 
             # segments are [start, pos, end): pos = next absolute byte
             segments = None
@@ -912,7 +1080,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             except (OSError, ValueError, KeyError, TypeError, IndexError):
                 pass
             if segments is None:
-                span = -(-total_len // seg_count)
+                # racing wants more, smaller ranges than the per-origin
+                # lane count: work-stealing balances load only at range
+                # granularity, so ~4 ranges per origin (bounded: >= 2 MiB
+                # each, <= 64 total) keeps a slow origin from holding a
+                # quarter of the file
+                lanes = seg_count
+                if len(racing) > 1:
+                    lanes = max(seg_count, min(len(racing) * 4, 64))
+                span = -(-total_len // lanes)
+                if len(racing) > 1:
+                    span = max(span, 2 << 20)
                 segments = [
                     [lo, lo, min(lo + span, total_len)]
                     for lo in range(0, total_len, span)
@@ -963,9 +1141,30 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             await _save_state()
             fd = os.open(seg_partial, os.O_WRONLY)
 
-            async def _segment(seg) -> None:
-                while seg[1] < seg[2]:
+            async def _fetch_range(seg, url=resource_url,
+                                   guard=None) -> None:
+                """Fetch ``[seg[1], seg[2])`` from ``url`` into the
+                shared fd at absolute offsets — the single-origin
+                segment loop, parameterized so the racing scheduler can
+                point it at any origin.  ``guard(delta) -> bool`` (the
+                scheduler's merge/first-byte-wins hook) is consulted
+                after every landed chunk; False stops the fetch with
+                the landed bytes intact."""
+                stopped = [False]
+
+                def advance(n: int) -> bool:
+                    seg[1] += n
+                    if guard is None:
+                        return True
+                    ok = guard(n)
+                    if not ok:
+                        stopped[0] = True
+                    return ok
+
+                while seg[1] < seg[2] and not stopped[0]:
                     cancel.raise_if_cancelled()
+                    if faults.enabled():
+                        await faults.fire("origin.fetch", key=url)
                     before = seg[1]
                     headers = {
                         **base_headers,
@@ -974,7 +1173,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     }
                     request_mark = time.monotonic()
                     async with session.get(
-                        resource_url, headers=headers
+                        url, headers=headers
                     ) as resp:
                         _note_origin_wait(request_mark)
                         if resp.status == 200:
@@ -994,11 +1193,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 and not _is_encoded(resp.headers)):
                             # kernel landing at the segment's offset;
                             # non-strict: a short/closed 206 just
-                            # re-ranges like the streaming loop would
-                            got = await _splice_body(
+                            # re-ranges like the streaming loop would.
+                            # ``advance`` (not a post-hoc +=) keeps
+                            # seg[1] honest while slices land, so the
+                            # racing guard sees live progress.
+                            await _splice_body(
                                 resp, fd, offset=seg[1],
-                                limit=seg[2] - seg[1], strict=False)
-                            seg[1] += got
+                                limit=seg[2] - seg[1], strict=False,
+                                progress=advance)
                         else:
                             hop_mark = time.monotonic()
                             async for raw in resp.content.iter_any():
@@ -1025,10 +1227,13 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                     record.note_hop(
                                         "disk_write", len(data),
                                         time.monotonic() - write_mark)
-                                seg[1] += len(data)
+                                if not advance(len(data)):
+                                    break
                                 if len(data) < len(raw):
                                     break  # server over-delivered; done
                                 hop_mark = time.monotonic()
+                    if stopped[0]:
+                        return  # the scheduler ended this writer's turn
                     if seg[1] == before:
                         # a capped/empty 206 must still advance, else
                         # this loops forever against a broken origin
@@ -1042,7 +1247,35 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     await _save_state()
 
             saver = asyncio.create_task(_checkpoint())
-            tasks = [asyncio.create_task(_segment(s)) for s in segments]
+            if len(racing) > 1:
+                # origin plane: one work-stealing scheduler instead of
+                # one task per segment — each origin pulls the next
+                # pending range, stragglers get duplicated tails, and a
+                # dying origin fails over without failing the job.  The
+                # canonical triples are the SAME lists the checkpoint
+                # snapshots, so crash-resume is unchanged.
+                from ..origins.racing import RangeScheduler
+
+                async def _race_fetch(origin, triple, guard) -> None:
+                    await _fetch_range(triple, url=origin.url,
+                                       guard=guard)
+
+                scheduler = RangeScheduler(
+                    racing, segments, _race_fetch,
+                    retrier=retrier, health=health, cancel=cancel,
+                    record=record, metrics=ctx.metrics, logger=logger,
+                    config=ctx.config,
+                )
+                tasks = [asyncio.create_task(scheduler.run())]
+            else:
+                # one surviving origin (usually the primary; after a
+                # reference promotion, the mirror that answered)
+                tasks = [
+                    asyncio.create_task(
+                        _fetch_range(s, url=racing[0].url)
+                    )
+                    for s in segments
+                ]
             try:
                 await asyncio.gather(*tasks)
             finally:
@@ -1129,12 +1362,19 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         file=output,
                     )
                     os.remove(output)
-                # segmented fast path: only when configured, and never
+                # segmented fast path: when configured — or whenever the
+                # job carries racing mirrors (origin plane) — and never
                 # while a sequential .partial is mid-resume (finish what
                 # the cheaper path started)
-                if seg_count > 1 and not os.path.exists(partial):
+                from ..origins.plan import resolve_mirrors
+
+                has_mirrors = bool(resolve_mirrors(
+                    resource_url, getattr(job, "mirrors", ()) or ()
+                ))
+                if ((seg_count > 1 or has_mirrors)
+                        and not os.path.exists(partial)):
                     try:
-                        got = await _fetch_segmented(session)
+                        got = await _fetch_segmented(session, job)
                     except _EntityChangedDuringSegments:
                         logger.warn(
                             "http: entity changed mid-segments, restarting"
@@ -1259,6 +1499,57 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         # at ``output`` (fresh promote, resumed promote, or a previous
         # attempt's validated file), so this IS the file's durable moment
         await _announce_file(job, output)
+
+    async def manifest(resource_url: str, file_id: str,
+                       download_path: str, job: Job):
+        """HLS-style segment-manifest ingest (origins/manifest.py):
+        ``source_kind: MANIFEST`` jobs treat the http(s) source URI as a
+        media playlist, landing each segment as its own durable file —
+        announced into the FileStream the moment it completes, so the
+        streaming pipeline stages early segments while later ones are
+        still being produced (live) or still downloading (VOD).
+
+        Mirrors are playlist-level: each ``Download.mirrors`` URL is
+        that origin's copy of the playlist, and relative segment URIs
+        resolve against whichever origin serves them (EWMA-ordered,
+        first-byte hedge, per-origin breaker/retry seams).  No outer
+        watchdog: a live playlist legitimately idles between segments,
+        so liveness is the ingest's own ``origins.manifest.stall_timeout``
+        (raised as ``ERRDLSTALL`` — the orchestrator's dead-stream
+        drop policy, same as a stalled transfer).
+        """
+        from ..origins.manifest import ManifestIngest
+        from ..origins.plan import OriginHealth, build_origin_set
+
+        logger.info("manifest", url=resource_url)
+        health = OriginHealth.shared(ctx.resources, ctx.config)
+        origins = build_origin_set(
+            resource_url, getattr(job, "mirrors", ()) or (),
+            health=health,
+        )
+
+        async def progress(percent: int) -> None:
+            await telemetry.emit_progress(file_id, downloading, percent)
+
+        async def announce(path: str, size: int) -> None:
+            await _announce_file(job, path, size)
+
+        async with aiohttp.ClientSession(
+            read_bufsize=_CHUNK, auto_decompress=False, trust_env=True,
+        ) as session:
+            ingest = ManifestIngest(
+                origins, session, retrier=retrier, health=health,
+                cancel=cancel, record=ctx.record, metrics=ctx.metrics,
+                logger=logger, config=ctx.config, limiter=limiter,
+                announce=announce, progress=progress,
+            )
+            total = await ingest.run(resource_url, download_path)
+        if ctx.record is not None:
+            ctx.record.add_bytes("downloaded", total)
+        if ctx.metrics is not None:
+            ctx.metrics.bytes_downloaded.labels(
+                protocol="manifest").inc(total)
+        logger.info("manifest complete", bytes=total)
 
     async def file(resource_url: str, file_id: str, download_path: str, job: Job):
         # (reference lib/download.js:177-189)
@@ -1610,10 +1901,23 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         method = methods.get(protocol.lower())
         if method is None:
             raise ValueError("Protocol not supported.")
+        # origin plane: Download.source_kind steers interpretation of
+        # the URI.  MANIFEST rides the http transport but is its own
+        # ingest loop; AUTO/DIRECT keep the historical dispatch.
+        source_kind = (getattr(job, "source_kind", "AUTO")
+                       or "AUTO").upper()
+        if source_kind == "MANIFEST":
+            if protocol.lower() != "http":
+                raise ValueError(
+                    "source_kind MANIFEST requires an http(s) source"
+                )
+            method = manifest
 
         with ctx.tracer.span("stage.download", protocol=protocol, mediaId=file_id):
             try:
-                key = await cache_identity(protocol.lower(), url)
+                # live manifests are not immutable content: never cached
+                key = (None if source_kind == "MANIFEST"
+                       else await cache_identity(protocol.lower(), url))
                 if key is None:
                     await method(url, file_id, download_path, job)
                 else:

@@ -81,13 +81,23 @@ def _dir_allowed(root: str, dir_path: str, is_movie: bool, logger) -> bool:
     return bool(_SEASON_RE.search(name))
 
 
-def stage_exts(config):
+# what an HLS-style packager emits per segment: MPEG-TS pieces and fMP4
+# fragments.  Only MANIFEST jobs widen the filter to them — a stray .ts
+# in a torrent payload stays excluded, exactly the parity behavior.
+MANIFEST_EXTS = {".ts", ".m4s"}
+
+
+def stage_exts(config, source_kind: str = "AUTO"):
     """The extension whitelist the stage actually runs with: the parity
     set, plus raw ``.y4m`` when the upscale stage is enabled (shared by
-    the barrier stage below and the streaming pipeline's filter)."""
+    the barrier stage below and the streaming pipeline's filter), plus
+    the segment-container extensions for MANIFEST-ingest jobs."""
     from .upscale import upscale_enabled
 
-    return MEDIA_EXTS | {".y4m"} if upscale_enabled(config) else MEDIA_EXTS
+    exts = MEDIA_EXTS | {".y4m"} if upscale_enabled(config) else MEDIA_EXTS
+    if (source_kind or "AUTO").upper() == "MANIFEST":
+        exts = exts | MANIFEST_EXTS
+    return exts
 
 
 def incremental_filter(root: str, media: schemas.Media, logger,
@@ -184,15 +194,16 @@ def find_media_files(root: str, media: schemas.Media, logger,
 async def stage_factory(ctx: StageContext) -> StageFn:
     logger = ctx.logger
 
-    # config-gated divergence: with the upscale stage enabled, raw .y4m
-    # streams (what a decode front-end emits) count as media too.  The
-    # parity default stays the reference's exact whitelist.
-    exts = stage_exts(ctx.config)
-
     async def process(job: Job):
         # cooperative cancellation: the walk itself is fast local I/O,
         # so one check before it starts is the stage's whole window
         ctx.cancel.raise_if_cancelled()
+        # config-gated divergence: with the upscale stage enabled, raw
+        # .y4m streams (what a decode front-end emits) count as media
+        # too, and MANIFEST-ingest jobs accept segment containers.  The
+        # parity default stays the reference's exact whitelist.
+        exts = stage_exts(ctx.config,
+                          getattr(job, "source_kind", "AUTO"))
         last = job.last_stage
         download_path = last["path"] if isinstance(last, dict) else last.path
         logger.info("processing directory", path=download_path)

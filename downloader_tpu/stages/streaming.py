@@ -167,13 +167,22 @@ async def _await_with_failfast(primary: asyncio.Task,
             return await primary
 
 
-async def run_streaming_job(ctx: StageContext, media) -> None:
+async def run_streaming_job(ctx: StageContext, media, mirrors=(),
+                            source_kind: str = "AUTO") -> None:
     """Run one job through the eager per-file pipeline.
 
     Raises exactly what the barrier stage loop would: the download
     stage's own errors (``ERRDLSTALL`` code preserved),
     ``NoMediaFilesError``, upload errors, ``JobCancelled`` — the
     orchestrator's failure policy is unchanged.
+
+    ``mirrors``/``source_kind`` are the origin-plane fields from the
+    Download message (downloader_tpu/origins/): mirrors ride into the
+    download stage's racing fetch, and a MANIFEST source kind both
+    selects the playlist-ingest method and widens the media filter to
+    segment containers — each live segment announced into the
+    FileStream stages through this pipeline while later segments are
+    still being produced.
     """
     import dataclasses
 
@@ -195,9 +204,10 @@ async def run_streaming_job(ctx: StageContext, media) -> None:
     download_fn = await get_stage_factory("download")(dl_ctx)
 
     stream = FileStream()
-    job = Job(media=media, last_stage={}, file_stream=stream)
+    job = Job(media=media, last_stage={}, file_stream=stream,
+              mirrors=tuple(mirrors or ()), source_kind=source_kind)
     uploader = Uploader(ctx)
-    exts = stage_exts(ctx.config)
+    exts = stage_exts(ctx.config, source_kind)
     allow = incremental_filter(workdir, media, logger, exts)
 
     accepted: asyncio.Queue = asyncio.Queue()

@@ -309,6 +309,7 @@ class TorrentClient:
         cancel=None,
         progress_sink=None,
         on_file_complete=None,
+        extra_webseeds=None,
     ) -> Metainfo:
         """Fetch the torrent behind ``uri`` into ``download_path``.
 
@@ -341,6 +342,13 @@ class TorrentClient:
         early files while later ones still download.  Resumed/already-
         on-disk files are announced too, so a redelivered job streams
         its whole inventory.
+
+        ``extra_webseeds`` is an optional list of additional BEP 19
+        HTTP(S) webseed base URLs, merged (de-duplicated) with the ones
+        the magnet/metainfo already carries — the origin plane's
+        webseed/HTTP-mirror equivalence: a torrent job's
+        ``Download.mirrors`` become always-on HTTP origins for the same
+        piece-verified content.
         """
         meta, peers = await self._resolve(uri, peers, metadata_timeout)
         self._log("metainfo resolved", name=meta.name, pieces=meta.num_pieces)
@@ -375,6 +383,9 @@ class TorrentClient:
             return meta
 
         webseeds = self._webseed_urls(uri, meta)
+        for url in extra_webseeds or ():
+            if url not in webseeds:
+                webseeds.append(url)
         if not peers and not webseeds:
             raise TorrentError("no peers available")
 

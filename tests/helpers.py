@@ -1,6 +1,7 @@
 """Shared test helpers."""
 
 import asyncio
+import re
 
 
 async def start_http_server(handler, path: str = "/show.mkv"):
@@ -36,3 +37,101 @@ async def start_media_server(payload: bytes = b"V" * 4096,
         return web.Response(body=payload)
 
     return await start_http_server(serve, path)
+
+
+class RangeOrigin:
+    """One HTTP origin serving a single payload with byte-range +
+    If-Range support — the fixture the origin-plane racing tests and
+    the racing bench share.
+
+    Knobs model origin pathologies deterministically:
+
+    - ``rate``: bytes/s pacing (a throttled mirror)
+    - ``fail_after``: total payload bytes this origin will ever serve;
+      past the budget the connection is cut mid-body (an origin dying
+      mid-range) and later requests are cut immediately (it stays dead)
+    - ``hang``: never send response headers (a black-holed origin —
+      exercises first-byte hedges and straggler duplication)
+
+    Counters: ``served`` (payload bytes actually written to sockets)
+    and ``requests``.
+    """
+
+    def __init__(self, payload: bytes, *, etag: str = '"range-origin"',
+                 rate: float = 0.0, path: str = "/media.bin",
+                 fail_after: int = None, hang: bool = False):
+        self.payload = payload
+        self.etag = etag
+        self.rate = rate
+        self.path = path
+        self.fail_after = fail_after
+        self.hang = hang
+        self.served = 0
+        self.requests = 0
+        self._runner = None
+        self.url = None
+
+    async def start(self) -> str:
+        self._runner, base = await start_http_server(self._serve,
+                                                     self.path)
+        self.url = base + self.path
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _serve(self, request):
+        from aiohttp import web
+
+        self.requests += 1
+        if self.hang:
+            await asyncio.Event().wait()  # until the client gives up
+        payload = self.payload
+        start, end, status = 0, len(payload), 200
+        rng = request.headers.get("Range")
+        if_range = request.headers.get("If-Range")
+        if rng and (if_range is None or if_range == self.etag):
+            match = re.fullmatch(r"bytes=(\d+)-(\d*)", rng)
+            if match:
+                start = int(match.group(1))
+                end = (int(match.group(2)) + 1 if match.group(2)
+                       else len(payload))
+                end = min(end, len(payload))
+                status = 206
+        resp = web.StreamResponse(status=status)
+        resp.headers["ETag"] = self.etag
+        if status == 206:
+            resp.headers["Content-Range"] = (
+                f"bytes {start}-{end - 1}/{len(payload)}"
+            )
+        resp.content_length = end - start
+        await resp.prepare(request)
+        chunk = 64 << 10
+        if self.rate:
+            # small chunks keep the pacing smooth at low rates
+            chunk = max(min(chunk, int(self.rate / 10)), 4 << 10)
+        pos = start
+        try:
+            while pos < end:
+                n = min(chunk, end - pos)
+                if (self.fail_after is not None
+                        and self.served + n > self.fail_after):
+                    n = max(self.fail_after - self.served, 0)
+                    if n:
+                        await resp.write(payload[pos:pos + n])
+                        self.served += n
+                    # cut the connection mid-body: the origin is dead
+                    request.transport.close()
+                    return resp
+                await resp.write(payload[pos:pos + n])
+                self.served += n
+                pos += n
+                if self.rate:
+                    await asyncio.sleep(n / self.rate)
+        except (ConnectionError, OSError):
+            # a racing loser's connection was cancelled mid-write:
+            # normal, not a server error worth a traceback
+            return resp
+        return resp

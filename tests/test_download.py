@@ -959,6 +959,12 @@ def test_allocated_bytes_sees_through_sparse_files(tmp_path):
         fh.truncate(1 << 20)
     dense = tmp_path / "dense.bin"
     dense.write_bytes(b"x" * (1 << 20))
+    if os.stat(sparse).st_blocks * 512 >= (1 << 20):
+        # the filesystem materialized the hole (gVisor/overlayfs hosts
+        # back truncate with real blocks): there IS no sparseness here
+        # for allocated_bytes to see through — the production concern
+        # (st_blocks < st_size) cannot occur on this volume at all
+        pytest.skip("filesystem does not create sparse files")
     assert allocated_bytes(str(sparse)) < (1 << 16)
     assert allocated_bytes(str(dense)) >= (1 << 20) - 4096
     assert allocated_bytes(str(tmp_path / "missing")) == 0

@@ -156,6 +156,93 @@ async def test_transient_store_outage_retries_and_completes(
         await orchestrator.shutdown(grace_seconds=2)
 
 
+async def test_racing_origin_killed_and_hung_mirror_chaos(tmp_path):
+    """Racing chaos (origin plane): three origins serve one entity; the
+    fault plan kills one mirror after its first range (transient errors
+    forever after) and black-holes another (hang-kind — never answers).
+    The job must settle DONE with a byte-identical staged set and ZERO
+    poison charges, the killed origin's breaker must be OPEN, and the
+    surviving origin's breaker must still be admitting (closed)."""
+    from downloader_tpu.origins.plan import origin_label
+    from downloader_tpu.stages.upload import object_name
+    from helpers import RangeOrigin
+
+    payload = os.urandom(12 << 20)
+    healthy = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
+    killed = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
+    hung = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
+    for origin in (healthy, killed, hung):
+        await origin.start()
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")},
+        "retry": {
+            "default": {"attempts": 3, "base": 0.01, "cap": 0.05},
+            "origin": {"attempts": 2, "base": 0.01, "cap": 0.05},
+            "redelivery": {"base": 0.02, "cap": 0.1},
+        },
+        "breakers": {"origin": {"threshold": 2, "reset": 60.0}},
+        "faults": {"plan": [
+            # one range is allowed through, then the origin dies
+            # mid-transfer: every later range request errors transient
+            {"seam": "origin.fetch", "match": killed.url,
+             "kind": "error", "after": 1},
+            # the stalled mirror: black-holed from its first range —
+            # exercises straggler duplication (first-byte-wins) and the
+            # scheduler's refusal to let a hung loser park the job
+            {"seam": "origin.fetch", "match": hung.url,
+             "kind": "hang"},
+        ]},
+    })
+    orchestrator = await make_orchestrator(tmp_path, broker, store,
+                                           config)
+    try:
+        msg = schemas.Download(media=schemas.Media(
+            id="race-chaos", creator_id="card-1", name="A Movie",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=healthy.url,
+        ))
+        msg.mirrors.extend([killed.url, hung.url])
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=60)
+
+        # DONE with a byte-identical staged set, sealed exactly once
+        record = orchestrator.registry.get("race-chaos")
+        assert record.state == "DONE"
+        staged = await store.get_object(
+            "triton-staging", object_name("race-chaos", "media.mkv"),
+        )
+        assert staged == payload
+        assert await store.get_object(
+            "triton-staging", "race-chaos/original/done") == b"true"
+
+        # ZERO poison: the origin deaths were failovers, not failures
+        metrics = orchestrator.metrics
+        assert counter_value(metrics.jobs_failed, reason="poison") == 0
+        assert not orchestrator.registry.jobs("DROPPED_POISON")
+
+        # the killed origin's breaker is open; the survivor's admits
+        breakers = orchestrator.breakers
+        dead_breaker = breakers.get(f"origin:{origin_label(killed.url)}")
+        live_breaker = breakers.get(
+            f"origin:{origin_label(healthy.url)}")
+        assert dead_breaker.state == "open"
+        assert live_breaker.state == "closed"
+
+        # the story is on the timeline: failover + straggler dup
+        events = record.recorder.events()
+        assert any(e["kind"] == "origin_failover" for e in events)
+        assert any(e["kind"] == "range_assign"
+                   and e.get("reason") == "straggler_dup"
+                   for e in events)
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        for origin in (healthy, killed, hung):
+            await origin.stop()
+
+
 async def test_permanent_fault_short_circuits(tmp_path, http_server):
     """A permanent-classified failure must not burn retries or
     redeliveries: one attempt, ack, FAILED."""

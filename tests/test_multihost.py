@@ -100,6 +100,19 @@ def _run_two_workers(worker_src: str, timeout: int = 300) -> list:
         for proc in procs:
             out, _ = proc.communicate(timeout=timeout)
             outputs.append(out)
+            if (proc.returncode != 0
+                    and "Multiprocess computations aren't implemented"
+                    in out):
+                # this jaxlib build ships no multi-process CPU
+                # collectives (the gloo/MPI CPU backend is compiled
+                # out): the topology under test cannot exist in this
+                # image, on ANY code path — environmental, not a
+                # regression.  Real TPU/GPU images (and CPU builds
+                # with collectives) run the test for real.
+                import pytest
+
+                pytest.skip("jaxlib lacks multi-process CPU "
+                            "collectives in this image")
             assert proc.returncode == 0, out[-2000:]
     finally:
         # a hung/failed worker must not stay alive to steal the rest of

@@ -1,0 +1,860 @@
+"""Origin-plane tests (downloader_tpu/origins/): racing fetch across
+mirrors, per-origin breaker/retry seams, failover without job failure,
+and HLS-style segment-manifest ingest.
+
+Acceptance (ISSUE 10):
+
+- origin failover: killing one origin mid-transfer completes the job
+  with ZERO re-fetch of already-landed ranges and zero poison charges
+- live-ingest overlap: the first staged upload for a segment precedes
+  the last segment's download completing (PR 4 FileStream invariants:
+  the done marker still only lands after the authoritative walk)
+"""
+
+import asyncio
+import hashlib
+import os
+import time
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.control.registry import JobRegistry
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.origins.manifest import (ManifestStalled,
+                                             parse_playlist)
+from downloader_tpu.origins.plan import (OriginHealth, origin_label,
+                                         resolve_mirrors)
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.errors import RetryPolicy
+from downloader_tpu.platform.logging import NullLogger, get_logger
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.stages.base import FileStream, Job, StageContext
+from downloader_tpu.stages.download import stage_factory
+from downloader_tpu.stages.process import stage_exts
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store.s3 import S3ObjectStore
+from downloader_tpu.utils import EventEmitter
+
+from helpers import RangeOrigin
+from minis3 import MiniS3
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# plan: labels, health, mirror resolution
+# ---------------------------------------------------------------------------
+
+def test_origin_label_host_port():
+    assert origin_label("http://mirror-a:8080/x/y.mkv") == "mirror-a:8080"
+    assert origin_label("https://mirror-b/y.mkv") == "mirror-b"
+    # dots flatten: the label must survive dotted seam/config paths
+    # without splitting (seam_dependency splits on the first ".")
+    assert origin_label("http://cdn.example.com/y.mkv") \
+        == "cdn-example-com"
+    assert origin_label("http://10.0.0.9:81/y") == "10-0-0-9:81"
+    assert origin_label("not a url at all ://") == "other"
+
+
+def test_origin_health_label_cardinality_bounded():
+    health = OriginHealth(max_labels=2)
+    a = health.label("http://a/x")
+    b = health.label("http://b/x")
+    c = health.label("http://c/x")
+    assert (a, b) == ("a", "b")
+    assert c == "other"  # overflow collapses: payloads can't mint series
+    assert health.label("http://a/other-path") == "a"  # stable
+
+
+def test_origin_health_ewma_tracks_rate():
+    health = OriginHealth()
+    for _ in range(10):
+        health.feed("fast", 1 << 20, 0.01)   # ~100 MB/s
+        health.feed("slow", 1 << 20, 1.0)    # ~1 MB/s
+    assert health.bps("fast") > health.bps("slow") * 10
+    assert health.bps("never-seen") == 0.0
+    assert health.total_bytes("fast") == 10 << 20
+
+
+def test_resolve_mirrors_filters_and_dedupes():
+    primary = "http://origin/a.mkv"
+    assert resolve_mirrors(primary, [
+        "http://m1/a.mkv",
+        "http://origin/a.mkv",      # the primary itself: dropped
+        "http://m1/a.mkv",          # duplicate: dropped
+        "ftp://m2/a.mkv",           # non-http scheme: dropped
+        "https://m3/a.mkv",
+        None,                       # junk survives decoding: dropped
+    ]) == ["http://m1/a.mkv", "https://m3/a.mkv"]
+
+
+def test_labeled_dependency_inherits_family_config():
+    config = ConfigNode({
+        "retry": {"origin": {"attempts": 7, "base": 0.01, "cap": 0.5}},
+    })
+    policy = RetryPolicy.from_config(config, "origin:mirror-a:8080")
+    assert policy.attempts == 7
+    assert policy.base == 0.01
+    # plain dependencies keep the default chain
+    assert RetryPolicy.from_config(config, "store").attempts == 3
+
+
+def test_manifest_exts_gate_on_source_kind():
+    config = ConfigNode({})
+    assert ".ts" not in stage_exts(config)
+    assert ".ts" in stage_exts(config, "MANIFEST")
+    assert ".m4s" in stage_exts(config, "MANIFEST")
+    assert ".mkv" in stage_exts(config, "MANIFEST")
+
+
+# ---------------------------------------------------------------------------
+# playlist parser
+# ---------------------------------------------------------------------------
+
+def test_parse_playlist_live_and_vod():
+    live = parse_playlist(
+        "#EXTM3U\n#EXT-X-TARGETDURATION:4\n#EXT-X-MEDIA-SEQUENCE:17\n"
+        "#EXTINF:3.9,\nseg17.ts\n#EXTINF:4.0,title\nseg18.ts\n"
+    )
+    assert not live.ended
+    assert live.target_duration == 4.0
+    assert [(s.seq, s.uri) for s in live.segments] == [
+        (17, "seg17.ts"), (18, "seg18.ts"),
+    ]
+    vod = parse_playlist(
+        "#EXTM3U\n#EXTINF:2,\na.ts\n#EXTINF:2,\nb.ts\n#EXT-X-ENDLIST\n"
+    )
+    assert vod.ended
+    assert [s.seq for s in vod.segments] == [0, 1]
+    # unknown tags are ignored like real players
+    tagged = parse_playlist(
+        "#EXTM3U\n#EXT-X-VERSION:3\n#EXTINF:2,\nx.ts\n"
+    )
+    assert [s.uri for s in tagged.segments] == ["x.ts"]
+
+
+def test_parse_playlist_rejects_non_playlists():
+    with pytest.raises(ValueError):
+        parse_playlist("<html>definitely not a playlist</html>")
+
+
+# ---------------------------------------------------------------------------
+# stage-level racing harness
+# ---------------------------------------------------------------------------
+
+def make_ctx(tmp_path, instance=None, extra=None, job_id="race"):
+    registry = JobRegistry(logger=NullLogger())
+    record = registry.register(job_id, "card")
+    metrics = prom.Metrics(f"orig{os.urandom(4).hex()}")
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "dl"),
+                     **(instance or {})},
+        **(extra or {}),
+    })
+    ctx = StageContext(config=config, emitter=EventEmitter(),
+                       logger=get_logger("test-origins"),
+                       metrics=metrics, record=record)
+    return ctx, record, metrics
+
+
+def http_media(url, job_id):
+    return schemas.Media(
+        id=job_id, creator_id="card", name="A Movie",
+        type=schemas.MediaType.Value("MOVIE"),
+        source=schemas.SourceType.Value("HTTP"), source_uri=url,
+    )
+
+
+def counter_value(metrics, counter, **labels):
+    try:
+        return counter.labels(**labels)._value.get()
+    except Exception:
+        return 0.0
+
+
+async def test_racing_fast_mirror_serves_most_bytes(tmp_path):
+    """Slow primary + fast mirror: the raced download is byte-identical
+    and the fast origin ends up serving the bulk of the entity (work
+    stealing), with race-win attribution on /metrics."""
+    payload = os.urandom(12 << 20)
+    slow = RangeOrigin(payload, etag='"e1"', rate=2 << 20)
+    fast = RangeOrigin(payload, etag='"e1"')
+    await slow.start()
+    await fast.start()
+    ctx, record, metrics = make_ctx(tmp_path, job_id="race-fast")
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(slow.url, "race-fast"),
+                  mirrors=(fast.url,))
+        result = await download(job)
+        got = open(os.path.join(result["path"], "media.bin"), "rb").read()
+        assert hashlib.sha256(got).digest() \
+            == hashlib.sha256(payload).digest()
+        assert fast.served > slow.served
+        fast_label = origin_label(fast.url)
+        wins = sum(
+            counter_value(metrics, metrics.origin_race_wins,
+                          origin=fast_label, reason=reason)
+            for reason in ("fastest", "failover", "straggler_dup")
+        )
+        assert wins >= 1
+        assert counter_value(metrics, metrics.origin_bytes,
+                             origin=fast_label) > len(payload) / 2
+        probes = [e for e in record.recorder.events()
+                  if e["kind"] == "origin_probe"]
+        assert len(probes) == 2
+        assert all(p["ok"] for p in probes)
+    finally:
+        await slow.stop()
+        await fast.stop()
+
+
+async def test_racing_failover_zero_refetch(tmp_path):
+    """ACCEPTANCE: an origin dying mid-transfer fails over without
+    failing the job, re-fetches ZERO already-landed bytes (the landed
+    counter equals the entity exactly), and never burns poison (the
+    stage returns success — nothing for the orchestrator to charge)."""
+    payload = os.urandom(16 << 20)
+    dying = RangeOrigin(payload, etag='"e1"', fail_after=5 << 20)
+    healthy = RangeOrigin(payload, etag='"e1"')
+    await dying.start()
+    await healthy.start()
+    ctx, record, _metrics = make_ctx(
+        tmp_path, job_id="race-fo",
+        extra={
+            # deterministic: no straggler duplication (it would land
+            # some bytes twice by design and cloud the exact count)
+            "origins": {"dup_factor": 1e9},
+            "retry": {"origin": {"attempts": 2, "base": 0.01,
+                                 "cap": 0.05}},
+        },
+    )
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(dying.url, "race-fo"),
+                  mirrors=(healthy.url,))
+        result = await download(job)
+        got = open(os.path.join(result["path"], "media.bin"), "rb").read()
+        assert hashlib.sha256(got).digest() \
+            == hashlib.sha256(payload).digest()
+        # zero re-fetch of landed ranges: every landed byte was landed
+        # exactly once
+        assert record.bytes.get("downloaded") == len(payload)
+        events = record.recorder.events()
+        assert any(e["kind"] == "origin_failover" for e in events)
+        # the failed-over range's re-assignment is attributed
+        assert any(e["kind"] == "range_assign"
+                   and e.get("reason") == "failover" for e in events)
+    finally:
+        await dying.stop()
+        await healthy.stop()
+
+
+async def test_racing_mirror_serving_different_entity_excluded(tmp_path):
+    """A mirror whose validator disagrees with the primary serves a
+    DIFFERENT entity: it is excluded at probe time and the download is
+    correct from the primary alone."""
+    payload = os.urandom(9 << 20)
+    primary = RangeOrigin(payload, etag='"genuine"')
+    imposter = RangeOrigin(os.urandom(9 << 20), etag='"imposter"')
+    await primary.start()
+    await imposter.start()
+    ctx, record, _metrics = make_ctx(tmp_path, job_id="race-mm")
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(primary.url, "race-mm"),
+                  mirrors=(imposter.url,))
+        result = await download(job)
+        got = open(os.path.join(result["path"], "media.bin"), "rb").read()
+        assert hashlib.sha256(got).digest() \
+            == hashlib.sha256(payload).digest()
+        assert imposter.served <= 1  # its 0-0 probe byte, nothing more
+        probes = {e["origin"]: e for e in record.recorder.events()
+                  if e["kind"] == "origin_probe"}
+        assert probes[origin_label(imposter.url)]["ok"] is False
+        assert probes[origin_label(imposter.url)]["reason"] \
+            == "validator_mismatch"
+    finally:
+        await primary.stop()
+        await imposter.stop()
+
+
+async def test_dead_origin_breaker_opens_sibling_keeps_serving(tmp_path):
+    """The dead origin's ``origin:<label>`` breaker opens while the
+    sibling origin keeps admitting: a SECOND job against the same
+    origin set completes without touching the dead origin again."""
+    payload = os.urandom(12 << 20)
+    dying = RangeOrigin(payload, etag='"e1"', fail_after=512 << 10)
+    healthy = RangeOrigin(payload, etag='"e1"')
+    await dying.start()
+    await healthy.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="race-brk",
+        extra={
+            "origins": {"dup_factor": 1e9},
+            "retry": {"origin": {"attempts": 2, "base": 0.01,
+                                 "cap": 0.05}},
+            "breakers": {"origin": {"threshold": 2, "reset": 60.0}},
+        },
+    )
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(dying.url, "race-brk"),
+                  mirrors=(healthy.url,))
+        await download(job)
+        breakers = ctx.resources["retrier"].breakers
+        breaker = breakers.get(f"origin:{origin_label(dying.url)}")
+        assert breaker.state == "open"
+        # cache-less second job (fresh id), same origins: the open
+        # breaker keeps the dead origin out, the sibling serves alone
+        dying_requests_before = dying.requests
+        registry = JobRegistry(logger=NullLogger())
+        ctx.record = registry.register("race-brk2", "card")
+        job2 = Job(media=http_media(dying.url + "?job=2", "race-brk2"),
+                   mirrors=(healthy.url + "?job=2",))
+        result = await download(job2)
+        got = open(os.path.join(result["path"], "media.bin"), "rb").read()
+        assert hashlib.sha256(got).digest() \
+            == hashlib.sha256(payload).digest()
+        # probe traffic aside, the open breaker blocked range fetches
+        assert dying.requests <= dying_requests_before + 1
+    finally:
+        await dying.stop()
+        await healthy.stop()
+
+
+async def test_small_entity_still_races_with_mirrors(tmp_path):
+    """Entities under SEG_MIN_SIZE race too when mirrors exist (the
+    failover guarantee must cover small files), while staying on the
+    sequential path with no mirrors."""
+    payload = os.urandom(2 << 20)
+    primary = RangeOrigin(payload, etag='"e1"')
+    mirror = RangeOrigin(payload, etag='"e1"')
+    await primary.start()
+    await mirror.start()
+    ctx, record, _metrics = make_ctx(tmp_path, job_id="race-small")
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(primary.url, "race-small"),
+                  mirrors=(mirror.url,))
+        result = await download(job)
+        got = open(os.path.join(result["path"], "media.bin"), "rb").read()
+        assert got == payload
+        assert any(e["kind"] == "origin_probe"
+                   for e in record.recorder.events())
+    finally:
+        await primary.stop()
+        await mirror.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level hang/takeover regressions (review round)
+# ---------------------------------------------------------------------------
+
+def scheduler_fixture(segments, origins_spec, config=None):
+    """A RangeScheduler over fake origins with an in-memory retrier."""
+    from downloader_tpu.origins.plan import Origin
+    from downloader_tpu.origins.racing import RangeScheduler
+    from downloader_tpu.platform.errors import BreakerBoard, Retrier
+
+    cfg = ConfigNode(config or {})
+    origins = [Origin(url=f"http://{name}/x", label=name,
+                      primary=(i == 0))
+               for i, name in enumerate(origins_spec)]
+    retrier = Retrier(cfg, breakers=BreakerBoard(cfg))
+    health = OriginHealth()
+    return origins, retrier, health, cfg, RangeScheduler
+
+
+async def test_scheduler_takes_over_black_holed_small_tail():
+    """REGRESSION (review): a hung owner holding a sub-min_dup_bytes
+    tail must not park the job until the 240 s watchdog — past
+    origins.stall_takeover an idle origin duplicates it regardless of
+    the EWMA/min-tail gates, and completion is judged on BYTES even
+    when credit bookkeeping raced."""
+    segments = [[0, 0, 64 << 10], [64 << 10, 64 << 10, 128 << 10]]
+    origins, retrier, health, cfg, RangeScheduler = scheduler_fixture(
+        segments, ["hangs", "works"],
+        config={"origins": {"stall_takeover": 0.2}},
+    )
+
+    async def fetch(origin, triple, guard):
+        if origin.label == "hangs":
+            # land a little, then black-hole (no error to fail over)
+            triple[1] += 1 << 10
+            guard(1 << 10)
+            await asyncio.Event().wait()
+        while triple[1] < triple[2]:
+            n = min(16 << 10, triple[2] - triple[1])
+            triple[1] += n
+            if not guard(n):
+                return
+            await asyncio.sleep(0)
+
+    scheduler = RangeScheduler(origins, segments, fetch,
+                               retrier=retrier, health=health,
+                               config=cfg)
+    async with asyncio.timeout(10):
+        await scheduler.run()
+    assert all(seg[1] >= seg[2] for seg in segments)
+
+
+async def test_scheduler_reassigns_range_held_by_hung_duplicate():
+    """REGRESSION (review): owner failed over AND the straggler dup is
+    black-holed — the range's slots must not deadlock; a healthy third
+    origin takes it over after stall_takeover."""
+    segments = [[0, 0, 4 << 20], [4 << 20, 4 << 20, 8 << 20]]
+    origins, retrier, health, cfg, RangeScheduler = scheduler_fixture(
+        segments, ["dies", "hangs", "works"],
+        config={"origins": {"stall_takeover": 0.2, "dup_factor": 0.0},
+                "retry": {"origin": {"attempts": 1, "base": 0.01,
+                                     "cap": 0.02}}},
+    )
+    # the healthy origin must look fast so it dups eagerly; the hung
+    # one must look slow (it will own nothing after its dup stalls)
+    for _ in range(5):
+        health.feed("works", 1 << 20, 0.01)
+
+    async def fetch(origin, triple, guard):
+        if origin.label == "dies":
+            triple[1] += 1 << 10
+            guard(1 << 10)
+            raise RuntimeError("origin died mid-range")
+        if origin.label == "hangs":
+            await asyncio.Event().wait()
+        while triple[1] < triple[2]:
+            n = min(256 << 10, triple[2] - triple[1])
+            triple[1] += n
+            if not guard(n):
+                return
+            await asyncio.sleep(0)
+
+    scheduler = RangeScheduler(origins, segments, fetch,
+                               retrier=retrier, health=health,
+                               config=cfg)
+    async with asyncio.timeout(10):
+        await scheduler.run()
+    assert all(seg[1] >= seg[2] for seg in segments)
+
+
+async def test_scheduler_evicts_range_with_both_writers_stalled():
+    """REGRESSION (review round 2): a range whose owner AND straggler
+    dup are both black-holed must still be claimable by a healthy third
+    origin — the stalled owner slot is evicted (identity-guarded
+    releases make the replaced writer a harmless zombie)."""
+    segments = [[0, 0, 4 << 20]]
+    origins, retrier, health, cfg, RangeScheduler = scheduler_fixture(
+        segments, ["hung-owner", "hung-dup", "healthy"],
+        config={"origins": {"stall_takeover": 0.2}},
+    )
+    scheduler = RangeScheduler(origins, segments, None,
+                               retrier=retrier, health=health,
+                               config=cfg)
+    rng = scheduler.ranges[0]
+    rng.owner, rng.dup = origins[0], origins[1]
+    rng.winner = "dup"
+    rng.last_progress = time.monotonic() - 1.0  # both writers stalled
+    picked = scheduler._pick(origins[2])
+    assert picked is not None
+    assert picked[1] == "owner"
+    assert rng.owner is origins[2]   # evicted the stalled owner slot
+    assert rng.winner is None        # writers re-race from here
+    # a LIVE pair keeps its slots: fresh progress blocks the eviction
+    rng.owner, rng.dup = origins[0], origins[1]
+    rng.last_progress = time.monotonic()
+    assert scheduler._pick(origins[2]) is None
+
+
+async def test_segment_fetcher_raises_breaker_open_when_all_blocked():
+    """REGRESSION (review): every origin breaker open must surface
+    BreakerOpen (park-without-poison) from the segment fetcher, not a
+    bare transient error that burns the poison budget."""
+    from downloader_tpu.origins.plan import Origin
+    from downloader_tpu.origins.racing import SegmentFetcher
+    from downloader_tpu.platform.errors import (BreakerBoard, BreakerOpen,
+                                                Retrier)
+
+    cfg = ConfigNode({"breakers": {"origin": {"threshold": 1,
+                                              "reset": 60.0}}})
+    board = BreakerBoard(cfg)
+    retrier = Retrier(cfg, breakers=board)
+    origins = [Origin(url="http://only/x", label="only", primary=True)]
+    board.get("origin:only").record_failure()  # threshold 1: open
+    fetcher = SegmentFetcher(origins, retrier=retrier,
+                             health=OriginHealth(), config=cfg)
+
+    async def fetch_one(_origin, _hedge):
+        raise AssertionError("must not be called: breaker is open")
+
+    with pytest.raises(BreakerOpen):
+        await fetcher.fetch(fetch_one, what="segment")
+
+
+# ---------------------------------------------------------------------------
+# manifest ingest (stage level)
+# ---------------------------------------------------------------------------
+
+class LiveOrigin:
+    """Serves an HLS-style playlist that reveals one more segment every
+    ``period`` seconds until ``total``, then appends ENDLIST.  ``vod``
+    serves the complete, ended playlist from the first request."""
+
+    def __init__(self, total=6, period=0.12, seg_bytes=48 << 10,
+                 vod=False, initial=2, hang_segments=False,
+                 gzip_segments=False, stall_mid_body=False):
+        self.total = total
+        self.period = period
+        self.segments = [os.urandom(seg_bytes) for _ in range(total)]
+        self.vod = vod
+        self.initial = initial
+        self.hang_segments = hang_segments
+        self.gzip_segments = gzip_segments
+        self.stall_mid_body = stall_mid_body
+        self.playlist_requests = 0
+        self.segment_requests = 0
+        self._started = None
+        self._runner = None
+        self.url = None
+
+    def _visible(self):
+        if self.vod:
+            return self.total
+        if self._started is None:
+            self._started = time.monotonic()
+        grown = self.initial + int(
+            (time.monotonic() - self._started) / self.period
+        )
+        return min(max(grown, self.initial), self.total)
+
+    async def _playlist(self, _request):
+        self.playlist_requests += 1
+        visible = self._visible()
+        lines = ["#EXTM3U", "#EXT-X-TARGETDURATION:1",
+                 "#EXT-X-MEDIA-SEQUENCE:0"]
+        for i in range(visible):
+            lines.append("#EXTINF:0.5,")
+            lines.append(f"seg{i:04d}.ts")
+        if visible >= self.total:
+            lines.append("#EXT-X-ENDLIST")
+        return web.Response(text="\n".join(lines))
+
+    async def _segment(self, request):
+        self.segment_requests += 1
+        if self.hang_segments:
+            await asyncio.Event().wait()
+        index = int(request.match_info["i"])
+        payload = self.segments[index]
+        if self.gzip_segments:
+            import gzip as gzip_mod
+
+            body = gzip_mod.compress(payload)
+            resp = web.Response(
+                body=body, headers={"Content-Encoding": "gzip"})
+            # aiohttp would otherwise re-encode; body is pre-compressed
+            resp._compressed_body = body
+            return resp
+        if self.stall_mid_body:
+            resp = web.StreamResponse()
+            resp.content_length = len(payload)
+            await resp.prepare(request)
+            await resp.write(payload[: len(payload) // 2])
+            await asyncio.Event().wait()  # black-hole mid-body
+        return web.Response(body=payload)
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_get("/live.m3u8", self._playlist)
+        app.router.add_get(r"/seg{i:\d+}.ts", self._segment)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/live.m3u8"
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def manifest_instance():
+    return {"origins": {"manifest": {"min_poll": 0.05,
+                                     "stall_timeout": 10.0}}}
+
+
+async def run_manifest_job(ctx, url, job_id, mirrors=()):
+    download = await stage_factory(ctx)
+    stream = FileStream()
+    announced = []
+
+    async def reader():
+        while (event := await stream.next()) is not None:
+            announced.append(event)
+
+    job = Job(media=http_media(url, job_id), source_kind="MANIFEST",
+              file_stream=stream, mirrors=tuple(mirrors))
+    reader_task = asyncio.create_task(reader())
+    result = await download(job)
+    await stream.close()
+    await reader_task
+    return result, announced
+
+
+async def test_manifest_vod_fast_path(tmp_path):
+    """An already-ended playlist drains in one pass: no polling loop,
+    every segment staged byte-identical, playlist kept for provenance
+    but NOT announced as media."""
+    live = LiveOrigin(total=4, vod=True)
+    await live.start()
+    ctx, record, _metrics = make_ctx(
+        tmp_path, job_id="vod-1", extra=manifest_instance())
+    try:
+        result, announced = await run_manifest_job(ctx, live.url, "vod-1")
+        assert len(announced) == 4
+        for i in range(4):
+            got = open(os.path.join(result["path"], f"seg{i:04d}.ts"),
+                       "rb").read()
+            assert got == live.segments[i]
+        assert live.playlist_requests == 1  # the VOD fast path
+        assert os.path.exists(os.path.join(result["path"], "live.m3u8"))
+        events = [e["kind"] for e in record.recorder.events()]
+        assert "manifest_open" in events
+        assert "manifest_end" in events
+    finally:
+        await live.stop()
+
+
+async def test_manifest_live_polls_until_endlist(tmp_path):
+    """A growing live playlist: segments land as they appear, the job
+    finishes only at ENDLIST, and every announced segment is durable
+    when announced."""
+    live = LiveOrigin(total=6, period=0.1)
+    await live.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="live-1", extra=manifest_instance())
+    try:
+        result, announced = await run_manifest_job(ctx, live.url,
+                                                   "live-1")
+        assert len(announced) == 6
+        assert live.playlist_requests > 1  # it genuinely polled
+        for i in range(6):
+            got = open(os.path.join(result["path"], f"seg{i:04d}.ts"),
+                       "rb").read()
+            assert got == live.segments[i]
+    finally:
+        await live.stop()
+
+
+async def test_manifest_live_window_joins_at_edge(tmp_path):
+    """origins.manifest.live_window bounds how far behind the live edge
+    a joining worker starts: earlier segments are skipped."""
+    live = LiveOrigin(total=6, period=0.08, initial=5)
+    await live.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="edge-1",
+        extra={"origins": {"manifest": {
+            "min_poll": 0.05, "stall_timeout": 10.0, "live_window": 2,
+        }}})
+    try:
+        result, announced = await run_manifest_job(ctx, live.url,
+                                                   "edge-1")
+        names = sorted(os.path.basename(e.path) for e in announced)
+        # joined at edge: seg0000..seg0002 skipped (5 visible - window 2)
+        assert names[0] == "seg0003.ts"
+        assert names[-1] == "seg0005.ts"
+        assert not os.path.exists(
+            os.path.join(result["path"], "seg0000.ts"))
+    finally:
+        await live.stop()
+
+
+async def test_manifest_stall_raises_dlstall(tmp_path):
+    """A live playlist that stops producing without ENDLIST raises the
+    stall code the orchestrator's drop policy owns (ERRDLSTALL)."""
+    live = LiveOrigin(total=10, period=3600.0, initial=2)
+    await live.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="stall-1",
+        extra={"origins": {"manifest": {"min_poll": 0.05,
+                                        "stall_timeout": 0.4}}})
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(live.url, "stall-1"),
+                  source_kind="MANIFEST")
+        with pytest.raises(ManifestStalled) as excinfo:
+            await download(job)
+        assert type(excinfo.value).code == "ERRDLSTALL"
+    finally:
+        await live.stop()
+
+
+async def test_manifest_segment_failover_to_mirror(tmp_path):
+    """A black-holed primary's segments hedge over to the mirror within
+    ONE origins.hedge_delay window (even with a multi-attempt retry
+    budget — the hedge is the fetcher's impatience, not the origin's
+    verdict), and the slow origin's breaker is NOT fed by it."""
+    primary = LiveOrigin(total=3, vod=True, hang_segments=True)
+    mirror = LiveOrigin(total=3, vod=True)
+    mirror.segments = primary.segments  # same content, healthy serving
+    await primary.start()
+    await mirror.start()
+    ctx, record, _metrics = make_ctx(
+        tmp_path, job_id="hedge-1",
+        extra={
+            "origins": {"hedge_delay": 0.2,
+                        "manifest": {"min_poll": 0.05,
+                                     "stall_timeout": 10.0}},
+        })
+    try:
+        started = time.monotonic()
+        result, announced = await run_manifest_job(
+            ctx, primary.url, "hedge-1", mirrors=(mirror.url,))
+        elapsed = time.monotonic() - started
+        assert len(announced) == 3
+        for i in range(3):
+            got = open(os.path.join(result["path"], f"seg{i:04d}.ts"),
+                       "rb").read()
+            assert got == primary.segments[i]
+        assert any(e["kind"] == "origin_failover"
+                   for e in record.recorder.events())
+        # one hedge window per hang, no attempts x backoff pile-up
+        # (3 segments + playlist; generous bound, still far below the
+        # attempts-retried worst case)
+        assert elapsed < 4.0, f"hedge failover too slow: {elapsed:.1f}s"
+        # REGRESSION (review round 3): hedge timeouts are the
+        # fetcher's impatience, never the origin's failures — its
+        # cross-job breaker must stay closed and unfed
+        breakers = ctx.resources["retrier"].breakers
+        hung_breaker = breakers.get(f"origin:{origin_label(primary.url)}")
+        assert hung_breaker.state == "closed"
+        assert hung_breaker.failures == 0
+    finally:
+        await primary.stop()
+        await mirror.stop()
+
+
+async def test_manifest_gzip_segment_decoded_before_staging(tmp_path):
+    """REGRESSION (review round 3): a misbehaving CDN sending
+    Content-Encoding: gzip segments must have them DECODED before the
+    announce — the whole-file HTTP path already refuses to stage
+    compressed bytes as media; the manifest path must match."""
+    live = LiveOrigin(total=2, vod=True, gzip_segments=True)
+    await live.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="gz-1", extra=manifest_instance())
+    try:
+        result, announced = await run_manifest_job(ctx, live.url, "gz-1")
+        assert len(announced) == 2
+        for i in range(2):
+            got = open(os.path.join(result["path"], f"seg{i:04d}.ts"),
+                       "rb").read()
+            assert got == live.segments[i]  # the DECODED bytes
+    finally:
+        await live.stop()
+
+
+async def test_manifest_sole_origin_mid_body_hang_is_bounded(tmp_path):
+    """REGRESSION (review round 3): a sole origin that black-holes
+    MID-BODY (no hedge candidate left, stall check blocked inside the
+    fetch) must fail within ~stall_timeout per attempt, not ride
+    aiohttp's 5-minute session default times the retry budget."""
+    live = LiveOrigin(total=2, vod=True, stall_mid_body=True)
+    await live.start()
+    ctx, _record, _metrics = make_ctx(
+        tmp_path, job_id="hang-1",
+        extra={
+            "origins": {"manifest": {"min_poll": 0.05,
+                                     "stall_timeout": 1.0}},
+            "retry": {"origin": {"attempts": 1, "base": 0.01,
+                                 "cap": 0.05}},
+        })
+    try:
+        download = await stage_factory(ctx)
+        job = Job(media=http_media(live.url, "hang-1"),
+                  source_kind="MANIFEST", file_stream=None)
+        started = time.monotonic()
+        with pytest.raises(Exception):
+            await download(job)
+        assert time.monotonic() - started < 8.0
+    finally:
+        await live.stop()
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: live-ingest overlap through the full orchestrator
+# ---------------------------------------------------------------------------
+
+async def test_live_ingest_overlap_acceptance(tmp_path):
+    """Full service vs memory broker + MiniS3: a live playlist's early
+    segments are staged (upload_done) BEFORE the last segment's
+    download completes (file_complete), the staged set is
+    byte-identical, and the done marker seals only the authoritative
+    walk — the PR 4 invariants, now driven by a live source."""
+    live = LiveOrigin(total=6, period=0.25, seg_bytes=96 << 10)
+    await live.start()
+    s3 = MiniS3()
+    await s3.start()
+    store = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA", "SECRET")
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "downloads")},
+            "origins": {"manifest": {"min_poll": 0.05,
+                                     "stall_timeout": 15.0}},
+        }),
+        mq=MemoryQueue(broker),
+        store=store,
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"liveingest{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    try:
+        msg = schemas.Download(media=schemas.Media(
+            id="live-acc", creator_id="card-1", name="Live Event",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=live.url,
+        ), source_kind=schemas.SourceKind.Value("MANIFEST"))
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        async with asyncio.timeout(60):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+
+        # staged set byte-identical + done marker + one convert publish
+        for i in range(live.total):
+            staged = await store.get_object(
+                STAGING_BUCKET,
+                object_name("live-acc", f"seg{i:04d}.ts"),
+            )
+            assert staged == live.segments[i]
+        assert await store.get_object(
+            STAGING_BUCKET, "live-acc/original/done") == b"true"
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 1
+
+        record = orchestrator.registry.get("live-acc")
+        assert record.state == "DONE"
+        events = record.recorder.events()
+        completes = [e for e in events if e["kind"] == "file_complete"]
+        dones = [e for e in events if e["kind"] == "upload_done"]
+        assert len(completes) == live.total
+        assert len(dones) >= live.total
+        # THE overlap claim: a segment was fully staged while later
+        # segments were still being produced/downloaded
+        assert min(e["t"] for e in dones) < max(e["t"] for e in completes)
+        # the playlist itself never staged (not media)
+        with pytest.raises(Exception):
+            await store.get_object(
+                STAGING_BUCKET, object_name("live-acc", "live.m3u8"))
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await store.close()
+        await s3.stop()
+        await live.stop()

@@ -1084,7 +1084,12 @@ async def test_choked_peer_receives_no_blocks(tmp_path):
         await second.send_message(w.MSG_INTERESTED)
         await second.send_request(0, 0, 1 << 14)
         got = []
-        with pytest.raises(TimeoutError):
+        # asyncio.TimeoutError, not the builtin: on 3.10 wait_for raises
+        # the asyncio alias, which is NOT builtins.TimeoutError (they
+        # were only unified in 3.11 — this test failed since the seed on
+        # 3.10 hosts).  On 3.11+ they are the same class, so this form
+        # is correct everywhere.
+        with pytest.raises(asyncio.TimeoutError):
             while True:
                 msg_id, _ = await asyncio.wait_for(second.recv_message(), 1)
                 if msg_id is not None:

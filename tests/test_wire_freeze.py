@@ -99,7 +99,12 @@ def test_field_numbers_frozen():
         # above — which predate the fields — still decode identically
         # and old producers are untouched)
         "Download": {"media": 1, "created_at": 2, "priority": 3,
-                     "tenant": 4, "ttl_seconds": 5},
+                     "tenant": 4, "ttl_seconds": 5, "mirrors": 6,
+                     "source_kind": 7},
+        # mirrors=6 + source_kind=7 added by the origin-plane PR
+        # (additive: absent = no mirrors / AUTO kind, so the golden
+        # bytes still decode identically and old producers — which
+        # never set them — stay byte-identical on the wire)
         # deadline_seconds=3 added by the crash-durability PR (additive:
         # absent/0 = no deadline, old consumers decode golden bytes
         # identically)
@@ -147,6 +152,28 @@ def test_tenant_field_wire_semantics():
     again = schemas.decode(schemas.Download, schemas.encode(msg))
     assert again.tenant == "vip"
     assert again.ttl_seconds == 12.5
+
+
+def test_origin_fields_wire_semantics():
+    """mirrors=6 / source_kind=7 are additive: golden (pre-field) bytes
+    decode with the implicit defaults (no mirrors, AUTO kind), unset
+    values add no bytes on encode, and set values round-trip."""
+    old = schemas.decode(schemas.Download, bytes.fromhex(GOLDEN_DOWNLOAD))
+    assert list(old.mirrors) == []
+    assert old.source_kind == schemas.SourceKind.Value("AUTO")
+    msg = schemas.Download(
+        media=_media(), created_at="2026-01-02T03:04:05.678Z",
+        source_kind=schemas.SourceKind.Value("AUTO"),
+    )
+    assert schemas.encode(msg).hex() == GOLDEN_DOWNLOAD
+    msg.mirrors.extend(["https://mirror-a/a.mkv", "https://mirror-b/a.mkv"])
+    msg.source_kind = schemas.SourceKind.Value("MANIFEST")
+    again = schemas.decode(schemas.Download, schemas.encode(msg))
+    assert list(again.mirrors) == ["https://mirror-a/a.mkv",
+                                   "https://mirror-b/a.mkv"]
+    assert again.source_kind == schemas.SourceKind.Value("MANIFEST")
+    assert {v.name: v.number for v in schemas.SourceKind.DESCRIPTOR.values} \
+        == {"AUTO": 0, "DIRECT": 1, "MANIFEST": 2}
 
 
 def test_observable_enum_constants():
