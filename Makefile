@@ -44,8 +44,13 @@ obs:
 origins:
 	python -m pytest tests/test_origins.py -v
 
+# graftlint (downloader_tpu/analysis, docs/ANALYSIS.md): the repo-
+# invariant static analyzer over the full tree (JSON for CI parsing),
+# then the tier-1 gate (zero unsuppressed findings + <10 s budget +
+# registry fixtures)
 lint:
-	python -m pytest tests/test_lint.py -q
+	python -m downloader_tpu.analysis --json
+	python -m pytest tests/test_lint.py tests/test_analysis.py -q
 
 bench:
 	python bench.py

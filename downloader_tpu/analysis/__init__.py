@@ -1,0 +1,55 @@
+"""graftlint: the repo-invariant static analyzer (ISSUE 11).
+
+Machine-checks the correctness rules PRs 5-10 learned in review
+rounds: ack-settle atomicity, bounded aiohttp timeouts, no blocking
+calls on the event loop, cancellation hygiene, knob/metric catalog
+drift, Retrier-seam fault coverage, and the additive-only wire
+schema — plus the generic eslint-parity rules folded in from the seed
+lint suite.  See docs/ANALYSIS.md for the rule catalog.
+
+Usage::
+
+    python -m downloader_tpu.analysis            # full tree, text
+    python -m downloader_tpu.analysis --json     # machine output
+    make lint                                     # CLI + tier-1 gate
+
+Importing the checker modules registers their rules; keep the imports
+even though nothing references them by name.
+"""
+
+from . import asynchrony, drift, generic, wire
+from .core import (
+    DEFAULT_TARGETS,
+    AnalysisResult,
+    Finding,
+    ModuleSource,
+    RepoContext,
+    all_rules,
+    analyze,
+    analyze_module,
+    analyze_repo,
+    apply_suppressions,
+    iter_source_files,
+    module_checker,
+    repo_checker,
+)
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "AnalysisResult",
+    "Finding",
+    "ModuleSource",
+    "RepoContext",
+    "all_rules",
+    "analyze",
+    "analyze_module",
+    "analyze_repo",
+    "apply_suppressions",
+    "iter_source_files",
+    "module_checker",
+    "repo_checker",
+    "asynchrony",
+    "drift",
+    "generic",
+    "wire",
+]

@@ -17,8 +17,8 @@ operators and the downstream converter steering:
   over the concurrency slots, with a starvation-proof aging bump.
 """
 
-from .cancel import CancelToken, JobCancelled  # noqa: F401
-from .registry import (  # noqa: F401
+from .cancel import CancelToken, JobCancelled
+from .registry import (
     ADMITTED,
     CANCELLED,
     DONE,
@@ -34,9 +34,17 @@ from .registry import (  # noqa: F401
     JobRecord,
     JobRegistry,
 )
-from .scheduler import (  # noqa: F401
+from .scheduler import (
     PRIORITY_RANK,
     PriorityScheduler,
     priority_name,
     priority_rank,
 )
+
+__all__ = [
+    "ADMITTED", "CANCELLED", "DONE", "DROPPED_POISON", "EXPIRED",
+    "FAILED", "PARKED", "PUBLISHING", "RECEIVED", "RUNNING",
+    "TERMINAL_STATES", "PRIORITY_RANK",
+    "CancelToken", "IllegalTransition", "JobCancelled", "JobRecord",
+    "JobRegistry", "PriorityScheduler", "priority_name", "priority_rank",
+]

@@ -17,7 +17,7 @@ Disabled by default (``fleet.enabled`` / env ``FLEET_ENABLED``); a lone
 worker pays nothing for it.
 """
 
-from .coord import (  # noqa: F401
+from .coord import (
     ABSENT,
     ANY,
     BucketCoordStore,
@@ -25,10 +25,16 @@ from .coord import (  # noqa: F401
     CoordStore,
     MemoryCoordStore,
 )
-from .plane import (  # noqa: F401
+from .plane import (
     LED,
     SHARED,
     UNCOORDINATED,
     FleetPlane,
     resolve_worker_id,
 )
+
+__all__ = [
+    "ABSENT", "ANY", "LED", "SHARED", "UNCOORDINATED",
+    "BucketCoordStore", "CoordError", "CoordStore", "FleetPlane",
+    "MemoryCoordStore", "resolve_worker_id",
+]

@@ -1099,6 +1099,13 @@ class Orchestrator:
                      reason=token.reason or "cancelled")
         self._journal_settle(job_id, "ack", "cancelled")
         await delivery.ack()
+        # terminal state BEFORE the telemetry await: observers woken by
+        # the ack (broker join, drain, /v1/jobs pollers) must already
+        # see CANCELLED, not a settled-but-ADMITTED limbo — the same
+        # PR 8 invariant the EXPIRED path honors (graftlint
+        # ack-settle-atomicity)
+        self.registry.transition(record, control.CANCELLED,
+                                 reason=token.reason or "cancelled")
         self._clear_failures(job_id)
         if self.metrics is not None:
             self.metrics.jobs_cancelled.inc()
@@ -1106,8 +1113,6 @@ class Orchestrator:
             await self.telemetry.emit_status(job_id, self._cancel_status)
         except Exception as err:
             logger.warn("cancel status emit failed", error=str(err))
-        self.registry.transition(record, control.CANCELLED,
-                                 reason=token.reason or "cancelled")
 
     async def _admit_job(self, logger: Logger,
                          record: Optional[JobRecord] = None) -> None:

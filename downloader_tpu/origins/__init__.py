@@ -23,5 +23,10 @@ The byte-moving mechanism stays in ``stages/download.py`` (the same
 package owns only the *policy*: which origin fetches which bytes next.
 """
 
-from .plan import Origin, OriginHealth, origin_label, resolve_mirrors  # noqa: F401
-from .racing import RangeScheduler, SegmentFetcher  # noqa: F401
+from .plan import Origin, OriginHealth, origin_label, resolve_mirrors
+from .racing import RangeScheduler, SegmentFetcher
+
+__all__ = [
+    "Origin", "OriginHealth", "RangeScheduler", "SegmentFetcher",
+    "origin_label", "resolve_mirrors",
+]

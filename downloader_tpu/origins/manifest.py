@@ -242,6 +242,7 @@ class ManifestIngest:
                     resp.raise_for_status()
                     decoder = self._decoder_for(resp)
                     hop_mark = time.monotonic()
+                    # graftlint: disable=blocking-call-in-async -- one open(2); the segment body loop below awaits per chunk
                     with open(tmp, "wb") as fh:
                         async for chunk in resp.content.iter_any():
                             if record is not None:
@@ -402,6 +403,7 @@ class ManifestIngest:
             urllib.parse.urlsplit(playlist_url).path
         ) or "playlist.m3u8"
         try:
+            # graftlint: disable=blocking-call-in-async -- playlist text is KBs, written once at ingest end
             with open(os.path.join(download_path, name), "w") as fh:
                 fh.write(final_text)
         except OSError:

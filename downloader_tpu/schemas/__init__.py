@@ -16,7 +16,7 @@ from typing import Type
 
 from google.protobuf.message import Message
 
-from .downloader_pb2 import (  # noqa: F401  (re-exported)
+from .downloader_pb2 import (
     Convert,
     Download,
     JobPriority,
@@ -28,6 +28,14 @@ from .downloader_pb2 import (  # noqa: F401  (re-exported)
     TelemetryStatus,
     TelemetryStatusEvent,
 )
+
+__all__ = [
+    "Convert", "Download", "JobPriority", "Media", "MediaType",
+    "SourceKind", "SourceType", "TelemetryProgressEvent",
+    "TelemetryStatus", "TelemetryStatusEvent",
+    "DOWNLOAD_QUEUE", "CONVERT_QUEUE", "CONVERT_EXCHANGE",
+    "encode", "decode",
+]
 
 # Queue names (reference lib/main.js:164,172).
 DOWNLOAD_QUEUE = "v1.download"

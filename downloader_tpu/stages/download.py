@@ -267,6 +267,34 @@ def job_download_dir(config, media_id: str) -> str:
     return os.path.join(prefix, configured, media_id)
 
 
+async def _join_offloaded(fn, *args):
+    """Run ``fn(*args)`` on the default executor, JOINING the worker
+    before propagating cancellation.  The cancel settle path removes
+    the job workdir the moment the delivery settles; a bare
+    ``asyncio.to_thread`` abandons its still-running thread on cancel,
+    and that thread's writes would race the rmtree (re-creating the
+    directories it just deleted — an orphan workdir until the next
+    boot's recovery sweep).  A SECOND cancellation during the join
+    abandons it, the same double-cancel posture as the torrent drive
+    loop's cleanup join."""
+    loop = asyncio.get_running_loop()
+    fut = loop.run_in_executor(None, functools.partial(fn, *args))
+    try:
+        return await asyncio.shield(fut)
+    except asyncio.CancelledError:
+        if not fut.done():
+            try:
+                await asyncio.wait({fut})
+            except asyncio.CancelledError:
+                pass
+        if fut.done() and not fut.cancelled():
+            # the cancel wins, but the worker's own failure (ENOSPC…)
+            # must be consumed or asyncio logs "exception was never
+            # retrieved" at GC on a routine cancel path
+            fut.exception()
+        raise
+
+
 def make_bucket_client(endpoint: str, access_key: str, secret_key: str,
                        ssl: bool = True):
     """Default factory for the ``bucket`` method's ad-hoc client
@@ -791,6 +819,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         os.write(abort_w, b"x")
                         try:
                             await fut
+                        # graftlint: disable=swallowed-cancellation -- join guard only: the outer handler re-raises the first CancelledError
                         except BaseException:
                             # a SECOND cancellation can interrupt the
                             # join itself; the deferred-cleanup path in
@@ -838,6 +867,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # O_APPEND files are invalid splice targets (EINVAL);
                 # resume instead via an explicit seek to the end
                 open_mode = "r+b" if os.path.exists(partial) else "wb"
+            # graftlint: disable=blocking-call-in-async -- one open(2); the body I/O below is awaited chunk/splice work
             with open(partial, open_mode, buffering=0) as fh:
                 if open_mode == "r+b":
                     fh.seek(0, os.SEEK_END)
@@ -1059,8 +1089,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # segments are [start, pos, end): pos = next absolute byte
             segments = None
             try:
+                # graftlint: disable=blocking-call-in-async -- sidecar checkpoint is a few hundred bytes
                 with open(seg_state_path) as fh:
-                    state = json.load(fh)
+                    state = json.load(fh)  # graftlint: disable=blocking-call-in-async -- same tiny sidecar
                 # the checkpoint is only as good as the data file it
                 # describes: wrong/missing size means the positions are
                 # lies (e.g. the big file was deleted to free disk)
@@ -1564,7 +1595,10 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         os.makedirs(download_path, exist_ok=True)
         import shutil
 
-        shutil.copyfile(qualified, output)
+        # off the loop: a file:// source is arbitrarily large media —
+        # a synchronous copy would stall every other job's transfer for
+        # the whole copy (graftlint blocking-call-in-async)
+        await _join_offloaded(shutil.copyfile, qualified, output)
         if ctx.metrics is not None:
             ctx.metrics.bytes_downloaded.labels(protocol="file").inc(
                 os.path.getsize(output)
@@ -1605,18 +1639,23 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if not parts:
                     continue
                 items.append((item, os.path.join(download_path, *parts)))
-            for _item, local in items:
-                os.makedirs(os.path.dirname(local), exist_ok=True)
-                # zero-byte placeholder: the media filter's
-                # sole-top-level rule counts root-level FILES in its
-                # directory listing too, so every local path — not just
-                # the directories — must exist before the first event or
-                # an incremental verdict could diverge from the
-                # authoritative walk's.  fget truncates on write, and
-                # events only fire for fully-fetched objects, so a
-                # placeholder is never read as content.
-                with open(local, "ab"):
-                    pass
+            def _touch_placeholders() -> None:
+                for _item, local in items:
+                    os.makedirs(os.path.dirname(local), exist_ok=True)
+                    # zero-byte placeholder: the media filter's
+                    # sole-top-level rule counts root-level FILES in its
+                    # directory listing too, so every local path — not
+                    # just the directories — must exist before the first
+                    # event or an incremental verdict could diverge from
+                    # the authoritative walk's.  fget truncates on
+                    # write, and events only fire for fully-fetched
+                    # objects, so a placeholder is never read as content.
+                    with open(local, "ab"):
+                        pass
+
+            # off the loop: a few syscalls per object is real stall time
+            # on a 200-object bucket (graftlint blocking-call-in-async)
+            await _join_offloaded(_touch_placeholders)
             # live per-chunk transfer counters (ObjectStore.fget_object
             # progress callback): a multi-GB object is then visibly
             # moving in GET /v1/jobs/{id}/events instead of flat until

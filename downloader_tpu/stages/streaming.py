@@ -41,7 +41,7 @@ from ..platform.config import cfg_get
 # combined RUNNING-stage attribution for the registry/profiler while the
 # pipelined dispatch runs (all three logical stages at once); defined in
 # platform/obs.py, which cannot import this package (cycle via control)
-from ..platform.obs import PIPELINE_STAGE  # noqa: F401  (re-exported)
+from ..platform.obs import PIPELINE_STAGE  # graftlint: disable=unused-import -- re-exported for stage consumers
 from .base import FileStream, Job, StageContext, get_stage_factory
 
 DEFAULT_UPLOAD_CONCURRENCY = 3

@@ -137,6 +137,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if header is None:
                     ext = os.path.splitext(path)[1].lower()
                     if opts["decode"] and ext in _DECODE_EXTS:
+                        # graftlint: disable=blocking-call-in-async -- which() is ~10 PATH stats, once per file
                         decoder = shutil.which(opts["decoder"])
                         if decoder is None:
                             logger.warn(
@@ -153,6 +154,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         continue
                 encoder = None
                 if opts["encode"]:
+                    # graftlint: disable=blocking-call-in-async -- which() is ~10 PATH stats, once per file
                     encoder = shutil.which(opts["encoder"])
                     if encoder is None:
                         # weaker fallback than decode's passthrough: the

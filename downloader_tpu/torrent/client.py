@@ -686,6 +686,7 @@ class TorrentClient:
             meta = parse_torrent_bytes(data)
         else:
             path = uri[len("file://"):] if uri.startswith("file://") else uri
+            # graftlint: disable=blocking-call-in-async -- .torrent metainfo is KBs (bounded by piece-hash list)
             with open(path, "rb") as fh:
                 meta = parse_torrent_bytes(fh.read())
 

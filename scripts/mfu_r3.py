@@ -115,7 +115,6 @@ def compare(variants, x0, lo=4, hi=12, reps=4):
 
 def model_variants():
     """Full-model variants sharing the body/head; stems differ."""
-    import flax.linen as nn
 
     def body_and_head(x, relu_residual=True):
         for i in range(3):

@@ -27,7 +27,6 @@ sys.path.insert(0, "/root/repo")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
-from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
 BM = 8    # output rows per block
 BN = 64   # output cols per block

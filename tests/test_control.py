@@ -449,6 +449,49 @@ async def test_cancel_inflight_download_via_api(tmp_path):
         await runner.cleanup()
 
 
+async def test_cancel_transition_precedes_telemetry_emit(tmp_path):
+    """Regression (graftlint ack-settle-atomicity, found by the PR 11
+    tree-wide sweep): the cancel settle path used to await the CANCELLED
+    telemetry emit BETWEEN delivery.ack() and registry.transition, so
+    anything woken by the ack (broker join, drain, /v1/jobs pollers)
+    could observe a settled-but-not-terminal record.  The terminal
+    transition must already be visible when the telemetry emit runs."""
+    runner, base, gets = await start_slow_server(chunks=2000, delay=0.02)
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore()
+    )
+    cancel_status = schemas.TelemetryStatus.Value("CANCELLED")
+    states_at_emit = []
+    real_emit = orchestrator.telemetry.emit_status
+
+    async def spying_emit(job_id, status):
+        if status == cancel_status:
+            record = orchestrator.registry.get(job_id)
+            states_at_emit.append(record.state if record else None)
+        return await real_emit(job_id, status)
+
+    orchestrator.telemetry.emit_status = spying_emit
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/media.mkv", "job-limbo"))
+        await wait_for(lambda: (r := orchestrator.registry.get("job-limbo"))
+                       is not None and r.state == RUNNING)
+        await wait_for(lambda: gets[0] >= 1)
+        assert orchestrator.registry.cancel("job-limbo", reason="limbo test")
+        async with asyncio.timeout(10):
+            await broker.join(schemas.DOWNLOAD_QUEUE)
+        await wait_for(
+            lambda: orchestrator.registry.get("job-limbo").state == CANCELLED
+        )
+        # the spy ran (telemetry did announce the cancel) and saw the
+        # record ALREADY terminal — never the settled-but-RUNNING limbo
+        assert states_at_emit == [CANCELLED]
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
 async def test_cancel_unknown_and_terminal_jobs(tmp_path):
     broker = InMemoryBroker()
     orchestrator = await make_orchestrator(

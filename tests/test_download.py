@@ -136,6 +136,64 @@ async def test_file_urls_gated_by_env(tmp_path, broker, monkeypatch):
         assert fh.read() == b"local-bytes"
 
 
+async def test_file_copy_runs_off_the_event_loop(tmp_path, broker,
+                                                 monkeypatch):
+    """Regression (graftlint blocking-call-in-async, PR 11 sweep): the
+    file:// handler used to shutil.copyfile synchronously on the event
+    loop — a multi-GB source stalled every other job's transfer for the
+    whole copy.  The copy must run on a worker thread."""
+    import shutil
+    import threading
+
+    src = tmp_path / "local.mkv"
+    src.write_bytes(b"local-bytes")
+    monkeypatch.setenv("ALLOW_FILE_URLS", "true")
+
+    copy_threads = []
+    real_copyfile = shutil.copyfile
+
+    def spying_copyfile(a, b, **kwargs):
+        copy_threads.append(threading.current_thread())
+        return real_copyfile(a, b, **kwargs)
+
+    monkeypatch.setattr(shutil, "copyfile", spying_copyfile)
+    stage = await make_stage(tmp_path, broker)
+    result = await stage(make_job("FILE", src.as_uri()))
+
+    out = os.path.join(result["path"], "local.mkv")
+    with open(out, "rb") as fh:
+        assert fh.read() == b"local-bytes"
+    assert copy_threads and all(
+        t is not threading.main_thread() for t in copy_threads
+    )
+
+
+async def test_join_offloaded_joins_worker_before_cancel_propagates():
+    """Regression (PR 11 review): a cancelled offloaded copy/touch must
+    be JOINED before CancelledError propagates — the cancel settle
+    removes the workdir immediately after, and an abandoned worker
+    thread would race that rmtree (re-creating deleted directories)."""
+    import threading
+    import time as _time
+
+    from downloader_tpu.stages.download import _join_offloaded
+
+    finished = threading.Event()
+
+    def slow_worker():
+        _time.sleep(0.3)
+        finished.set()
+
+    task = asyncio.create_task(_join_offloaded(slow_worker))
+    await asyncio.sleep(0.05)   # worker is mid-flight
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    # by the time the cancel unwound, the thread had fully finished —
+    # nothing can race the settle path's rmtree
+    assert finished.is_set()
+
+
 async def test_bucket_download_strips_subfolder(tmp_path, broker):
     remote = InMemoryObjectStore()
     await remote.make_bucket("media")

@@ -1,204 +1,112 @@
-"""Lint-as-test: static checks over the package, run as a test suite.
+"""Lint-as-test: the graftlint registry run over the real tree (tier-1).
 
-Capability-equivalent to the reference's mocha-eslint suite
-(/root/reference/test/eslint.js, SURVEY.md §2 component 7).  ruff/flake8
-are not in the image and installs are off-limits, so the checks are
-implemented with the stdlib ``ast`` module, covering the highest-value
-subset of the eslint-standard/ruff defect classes: parse errors, unused
-imports (F401), bare ``except:`` (E722), tabs, ``print()`` in library
-code, mutable default arguments (B006), f-strings without placeholders
-(F541), ``== None/True/False`` comparisons (E711/E712), ``is`` against
-literals (F632), ``raise NotImplemented`` (F901), same-scope function
-redefinition (F811), and fire-and-forget ``create_task`` calls whose
-task object is discarded (asyncio GC hazard, RUF006).
+The seed version of this file hand-rolled eslint-parity AST checks
+inline; those rules now live in ``downloader_tpu/analysis`` (graftlint,
+ISSUE 11) alongside the repo-semantic checkers — ack-settle atomicity,
+bounded aiohttp timeouts, no blocking calls on the worker's event loop,
+cancellation hygiene, knob/metric catalog drift, Retrier-seam fault
+coverage, and the additive-only wire schema.  This file stays the
+tier-1 entry point: it runs the FULL registry (same analysis ``make
+lint`` runs via the CLI) and holds the gate to its contract:
 
-Tests are linted too (parse/imports/except/tabs/defaults), matching the
-reference suite's ``test/**`` coverage.
+- zero unsuppressed findings tree-wide (a justified
+  ``# graftlint: disable=<rule> -- <why>`` is the only escape);
+- the full-tree analysis stays inside its 10 s wall-clock budget, so
+  the gate can never quietly come to dominate tier-1.
+
+Per-rule true-positive/negative fixtures live in tests/test_analysis.py.
 """
 
-import ast
 import os
 
 import pytest
 
+from downloader_tpu import analysis
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "downloader_tpu")
-TESTS = os.path.join(REPO, "tests")
+
+#: the wall-clock ceiling ``make lint`` is held to (ISSUE 11 acceptance)
+FULL_TREE_BUDGET_S = 10.0
+
+FILES = analysis.iter_source_files(REPO)
 
 
-def _module_files():
-    out = []
-    for dirpath, dirnames, filenames in os.walk(PACKAGE):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for filename in filenames:
-            if filename.endswith(".py") and not filename.endswith("_pb2.py"):
-                out.append(os.path.join(dirpath, filename))
-    for filename in sorted(os.listdir(TESTS)):
-        if filename.endswith(".py"):
-            out.append(os.path.join(TESTS, filename))
-    out.append(os.path.join(REPO, "bench.py"))
-    out.append(os.path.join(REPO, "__graft_entry__.py"))
-    return sorted(out)
+@pytest.fixture(scope="module")
+def modules():
+    return {rel: analysis.ModuleSource.load(REPO, rel) for rel in FILES}
 
 
-MODULES = _module_files()
-IDS = [os.path.relpath(p, REPO) for p in MODULES]
+def _unsuppressed(findings, path, modules):
+    module = modules.get(path)
+    if module is None:
+        return list(findings)
+    kept, _ = analysis.apply_suppressions(list(findings), path,
+                                          module.lines)
+    return kept
 
 
-class _ImportUsage(ast.NodeVisitor):
-    def __init__(self):
-        self.imported = {}  # name -> lineno
-        self.used = set()
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            name = (alias.asname or alias.name).split(".")[0]
-            self.imported[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            self.imported[alias.asname or alias.name] = node.lineno
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
+@pytest.mark.parametrize("rel", FILES, ids=FILES)
+def test_module_lints_clean(rel, modules):
+    """Every file, against every module-scope rule (per-file params so
+    a finding names its file in the test id, as the seed suite did)."""
+    kept = _unsuppressed(analysis.analyze_module(modules[rel]), rel,
+                         modules)
+    assert not kept, "\n".join(f.render() for f in kept) + (
+        "\n\nFix the defect, or — for a deliberate site — add "
+        "'# graftlint: disable=<rule> -- <why>' (docs/ANALYSIS.md)"
+    )
 
 
-@pytest.mark.parametrize("path", MODULES, ids=IDS)
-def test_module_lints_clean(path):
-    with open(path, "r", encoding="utf-8") as fh:
-        source = fh.read()
+def test_repo_invariants_clean(modules):
+    """The cross-file drift rules: knob/metric catalogs, seam fault
+    coverage, and the additive-only wire schema."""
+    ctx = analysis.RepoContext.from_root(REPO, list(modules.values()))
+    by_path = {}
+    for finding in analysis.analyze_repo(ctx):
+        by_path.setdefault(finding.path, []).append(finding)
+    kept = [f for path, findings in by_path.items()
+            for f in _unsuppressed(findings, path, modules)]
+    assert not kept, "\n".join(f.render() for f in kept)
 
-    assert "\t" not in source, f"{path}: tabs found"
 
-    tree = ast.parse(source, filename=path)  # SyntaxError -> test failure
+def test_full_tree_analysis_fits_wall_clock_budget():
+    """One end-to-end run of exactly what ``make lint`` executes: clean
+    tree AND inside the 10 s budget, so the gate can never quietly come
+    to dominate tier-1."""
+    result = analysis.analyze(REPO)
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+    assert result.duration_s < FULL_TREE_BUDGET_S, (
+        f"graftlint took {result.duration_s:.2f}s for {result.files} "
+        f"files (budget {FULL_TREE_BUDGET_S:.0f}s) — profile the slow "
+        "checker (checkers share ModuleSource.nodes for exactly this "
+        "reason)"
+    )
 
-    usage = _ImportUsage()
-    usage.visit(tree)
-    referenced = usage.used
-    explicit_exports = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name) and target.id == "__all__":
-                    for elt in node.value.elts:
-                        if isinstance(elt, ast.Constant):
-                            explicit_exports.add(elt.value)
-    unused = [
-        f"{name} (line {line})"
-        for name, line in usage.imported.items()
-        if name not in referenced
-        and name not in explicit_exports
-        and not name.startswith("_")
-        and "# noqa" not in source.splitlines()[line - 1]
+
+def test_walk_covers_the_expected_tree():
+    """The file walk must keep covering the package, tests, scripts,
+    and the entry points — an exclusion typo would silently shrink the
+    gate to a subset of the tree."""
+    files = set(FILES)
+    assert "downloader_tpu/orchestrator.py" in files
+    assert "downloader_tpu/analysis/core.py" in files  # lints itself
+    assert "tests/test_lint.py" in files
+    assert "scripts/gen_proto.py" in files
+    assert "bench.py" in files and "__graft_entry__.py" in files
+    # generated protobuf output is excluded BY DESIGN (regenerated via
+    # scripts/gen_proto.py; drift is guarded by tests/test_schemas.py)
+    assert "downloader_tpu/schemas/downloader_pb2.py" not in files
+
+
+def test_every_suppression_carries_a_justification(modules):
+    """Redundant with the zero-findings gate (an unjustified disable
+    surfaces as a suppression-syntax finding), but stated explicitly:
+    the suppression ledger below is the tree's complete escape list."""
+    unjustified = [
+        (rel, sup.line)
+        for rel, module in modules.items()
+        for sup in analysis.core.scan_suppressions(module.lines)
+        if sup.justification is None
     ]
-    assert not unused, f"{path}: unused imports: {unused}"
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            pytest.fail(f"{path}:{node.lineno}: bare 'except:'")
-
-    # library code logs, it doesn't print (bench/graft entry/cli are CLIs,
-    # tests may print)
-    in_tests = os.sep + "tests" + os.sep in path
-    if not in_tests and not path.endswith(
-        ("bench.py", "__graft_entry__.py", "/cli.py", "/codec.py")
-    ):
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-            ):
-                pytest.fail(f"{path}:{node.lineno}: print() in library code")
-
-    problems = []
-
-    def flag(node, message):
-        problems.append(f"{path}:{node.lineno}: {message}")
-
-    # format specs (f"{x:.2f}") are themselves JoinedStr nodes with no
-    # FormattedValue parts — not user-facing f-strings, don't F541 them
-    format_specs = {
-        id(node.format_spec)
-        for node in ast.walk(tree)
-        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
-    }
-
-    for node in ast.walk(tree):
-        # B006: mutable default arguments
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in [*node.args.defaults, *node.args.kw_defaults]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
-                    isinstance(default, ast.Call)
-                    and isinstance(default.func, ast.Name)
-                    and default.func.id in {"list", "dict", "set"}
-                ):
-                    flag(node, f"mutable default argument in {node.name}()")
-
-        # F541: f-string without placeholders
-        if (
-            isinstance(node, ast.JoinedStr)
-            and id(node) not in format_specs
-            and not any(
-                isinstance(part, ast.FormattedValue) for part in node.values
-            )
-        ):
-            flag(node, "f-string without placeholders")
-
-        # E711/E712: equality comparison against None/True/False
-        if isinstance(node, ast.Compare):
-            for op, comparator in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                    isinstance(comparator, ast.Constant)
-                    and (comparator.value is None
-                         or comparator.value is True
-                         or comparator.value is False)
-                ):
-                    flag(node, "use is/is not for None/True/False")
-                # F632: identity comparison against a str/number literal
-                if isinstance(op, (ast.Is, ast.IsNot)) and (
-                    isinstance(comparator, ast.Constant)
-                    and isinstance(comparator.value, (str, int, float, bytes))
-                    and not isinstance(comparator.value, bool)
-                ):
-                    flag(node, "'is' comparison against a literal")
-
-        # F901: raise NotImplemented (the constant, not the error)
-        if isinstance(node, ast.Raise):
-            exc = node.exc
-            if isinstance(exc, ast.Call):
-                exc = exc.func
-            if isinstance(exc, ast.Name) and exc.id == "NotImplemented":
-                flag(node, "raise NotImplementedError, not NotImplemented")
-
-        # RUF006: create_task result discarded -> task can be GC'd mid-run
-        if (
-            isinstance(node, ast.Expr)
-            and isinstance(node.value, ast.Call)
-            and isinstance(node.value.func, ast.Attribute)
-            and node.value.func.attr == "create_task"
-        ):
-            flag(node, "create_task() result discarded (task may be GC'd)")
-
-    # F811: function redefined in the same scope (decorated defs like
-    # @property setters / dispatch registrations are legitimate)
-    for scope in ast.walk(tree):
-        if not isinstance(scope, (ast.Module, ast.ClassDef,
-                                  ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        seen = {}
-        for stmt in getattr(scope, "body", []):
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if not stmt.decorator_list and stmt.name in seen:
-                    flag(stmt, f"redefinition of {stmt.name}() "
-                               f"(first at line {seen[stmt.name]})")
-                seen.setdefault(stmt.name, stmt.lineno)
-
-    assert not problems, "\n".join(problems)
+    assert unjustified == []
