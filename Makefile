@@ -1,12 +1,12 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing chaos crash fleet obs origins proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak chaos crash fleet obs origins soak soak-smoke proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
 test:
-	python -m pytest tests/ -x -q -m "not integration"
+	python -m pytest tests/ -x -q -m "not integration and not slow"
 
 # opt-in: real RabbitMQ + MinIO (docker compose up -d --wait first);
 # the tests auto-skip when the services are unreachable
@@ -43,6 +43,21 @@ obs:
 # overlap acceptance through the full orchestrator)
 origins:
 	python -m pytest tests/test_origins.py -v
+
+# sustained-load soak suite (downloader_tpu/soak, ISSUE 13): a real
+# multi-worker subprocess fleet under the full mixed workload (fan-in +
+# racing + manifest + BULK deadlines) with SIGKILL chaos, held to hard
+# SLO guards — p99 time-to-staged per class, bounded journal/coord/
+# cache/RSS growth, zero leaked leases or orphan workdirs at drain,
+# hop-ledger reconciliation.  `soak` runs the slow capacity profile
+# (300 jobs, 3 workers, 3 kills); `soak-smoke` is the <60 s tier-1
+# profile plus the harness's own unit tests.  Resize either with the
+# soak.* knobs (docs/OPERATIONS.md "Capacity & SLOs").
+soak:
+	python -m pytest tests/test_soak.py -v -m slow
+
+soak-smoke:
+	python -m pytest tests/test_soak.py -v -m "not slow"
 
 # graftlint (downloader_tpu/analysis, docs/ANALYSIS.md): the repo-
 # invariant static analyzer over the full tree (JSON for CI parsing),
@@ -89,6 +104,12 @@ bench-obs:
 # >= 1.5x AND stay within 10% of the fast origin alone)
 bench-racing:
 	python bench.py --racing
+
+# standalone sustained-load soak bench (one JSON line: soak_ok = every
+# SLO guard green over the mixed-workload + kill-chaos run; soak_p99_ms,
+# soak_rss_slope_mb_per_kjob, soak_journal_peak_bytes alongside)
+bench-soak:
+	python bench.py --soak
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

@@ -143,7 +143,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  within 5% (the ledger must account for the wall it claims to
 #  attribute).  ``python bench.py --obs`` runs standalone
 #  (`make bench-obs`).
-HARNESS_VERSION = 17
+# v18 (r17): sustained-load soak (``--soak`` / `make bench-soak`):
+#  the downloader_tpu/soak rig drives a REAL 2-worker subprocess fleet
+#  (real-wire MiniAmqp + MiniS3 + HTTP/range/manifest origins) through
+#  the mixed workload — cache-hot fan-in, racing, manifest ingest,
+#  BULK-with-deadline pressure — with ≥1 SIGKILL + restart mid-run,
+#  then a sequential quiescent attribution probe.  soak_ok = every SLO
+#  guard green (p99 per class, bounded journal/coord/shared-cache/RSS
+#  growth, zero leaked leases/orphan workdirs, byte identity, hop
+#  reconciliation ≤10% on the probe); soak_p99_ms = worst-class p99
+#  time-to-staged; soak_rss_slope_mb_per_kjob and
+#  soak_journal_peak_bytes ride the same guards the smoke test holds.
+HARNESS_VERSION = 18
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2229,6 +2240,63 @@ def _bench_racing_safe() -> dict:
         return {"racing_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_soak() -> dict:
+    """Sustained-load soak capacity metrics (harness v18).
+
+    Runs the smoke profile of the soak rig (downloader_tpu/soak): a
+    real 2-worker subprocess fleet under the full mixed workload with
+    kill chaos, then the quiescent attribution probe.  ``soak_ok`` is
+    the headline guard — every SLO the rig asserts, green; the metric
+    keys exist so the series catches *which* capacity axis regressed
+    (tail latency vs memory slope vs journal growth) before the guard
+    trips.
+    """
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.soak import SoakProfile
+
+    profile = SoakProfile.smoke()
+    with tempfile.TemporaryDirectory() as tmp:
+        world = await SoakTestWorld.create(tmp, profile)
+        try:
+            report = await world.rig.run(world.workload)
+        finally:
+            await world.close()
+    stats = report.stats
+    p99_worst = max(stats.get(f"p99_{cls}_s", 0.0)
+                    for cls in ("high", "normal", "bulk"))
+    out = {
+        "soak_ok": report.ok,
+        "soak_p99_ms": round(p99_worst * 1000.0, 1),
+        "soak_rss_slope_mb_per_kjob": stats.get(
+            "rss_slope_mb_per_kjob", 0.0),
+        "soak_journal_peak_bytes": int(
+            stats.get("journal_peak_bytes", 0)),
+        "soak_jobs": int(stats.get("jobs", 0)),
+        "soak_kills": int(stats.get("kills_delivered", 0)),
+        "soak_wall_s": stats.get("wall_s", 0.0),
+        "soak_hop_reconcile_ratio": stats.get(
+            "hop_reconcile_ratio", 0.0),
+    }
+    if not report.ok:
+        out["soak_failed_guards"] = [g.name for g in report.failures()]
+    return out
+
+
+def _bench_soak_safe() -> dict:
+    """A soak-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_soak())
+    except Exception as err:
+        return {"soak_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
 # Final-line headline keys, in keep-priority order (first = kept
 # longest under the size cap).  ~15 keys: the driver's 2,000-char tail
 # capture must always see the full final line (VERDICT r5 item 1);
@@ -2273,6 +2341,11 @@ HEADLINE_KEYS = [
     "racing_speedup",             # r15: racing vs the slow origin, >= 1.5
     "racing_vs_fast",             # r15 guard: <= 1.10 of fast-alone
     "racing_bench_error",         # present only on failure — visible
+    "soak_ok",                    # r17: every sustained-load SLO guard
+    "soak_p99_ms",                # r17: worst-class p99 time-to-staged
+    "soak_rss_slope_mb_per_kjob",  # r17 guard via soak_ok
+    "soak_journal_peak_bytes",    # r17 guard: compaction held the line
+    "soak_bench_error",           # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -2323,6 +2396,10 @@ def main() -> None:
         # standalone origin-plane racing run (`make bench-racing`)
         print(json.dumps(_bench_racing_safe()))
         return
+    if "--soak" in sys.argv:
+        # standalone sustained-load soak run (`make bench-soak`)
+        print(json.dumps(_bench_soak_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -2347,6 +2424,7 @@ def main() -> None:
         **_bench_crash_safe(),
         **_bench_obs_safe(),
         **_bench_racing_safe(),
+        **_bench_soak_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

@@ -320,6 +320,8 @@ BOUNDED_LABELS = frozenset({
     "kind", "mode",  # code literals
     "tenant",       # config-bounded tenant table
     "origin",       # bounded by origins.max_labels (overflow -> other)
+    "prefix",       # the three coordination-store key prefixes
+                    # (workers/leases/telemetry — fleet/plane.py literals)
 })
 
 _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram", "Summary"})

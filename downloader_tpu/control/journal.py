@@ -193,19 +193,31 @@ def _apply_line(jobs: Dict[str, RecoveredJob], entry: dict) -> None:
         job.failures = 0
 
 
-def replay(path: str) -> RecoveredState:
+def replay(path: str, limit_bytes: Optional[int] = None) -> RecoveredState:
     """Rebuild per-job state from a journal file (missing file = empty).
 
     A torn final line — the crash landed mid-``write`` — is counted and
     skipped, never fatal: everything before it already replayed.
+
+    ``limit_bytes`` replays only the first N bytes — the compaction's
+    snapshot basis: a compaction racing live appends must snapshot
+    exactly the prefix it captured, and nothing that landed after (the
+    post-``base`` tail is preserved verbatim instead; replaying those
+    lines here too would apply them twice).  ``base`` is always
+    line-aligned: appends are whole ``write()`` lines and the offset is
+    captured under the append lock after a flush.
     """
     state = RecoveredState()
     try:
         fh = open(path, "rb")
     except FileNotFoundError:
         return state
+    consumed = 0
     with fh:
         for raw in fh:
+            consumed += len(raw)
+            if limit_bytes is not None and consumed > limit_bytes:
+                break
             raw = raw.strip()
             if not raw:
                 continue
@@ -241,12 +253,34 @@ class JobJournal:
         self.max_bytes = max(int(max_bytes), 1 << 16)
         self.logger = logger
         self.appended = 0
+        # snapshot-rewrites performed over this handle's lifetime (the
+        # compaction-thrash regression guard reads it)
+        self.compactions = 0
         self._lock = threading.Lock()
         self._flusher: Optional[threading.Timer] = None
         self._compacting = False
+        # raised past ``max_bytes`` when a compaction could NOT shrink
+        # the file under the bound (the live set alone exceeds it):
+        # without this floor every terminal settle would re-trigger a
+        # full replay+rewrite that cannot help — O(jobs x file) disk
+        # churn at exactly the moment the worker is busiest.  Reset to 0
+        # the next time a compaction lands under ``max_bytes``.
+        self._compact_floor = 0
         self._closed = False
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
+        # line census for the ``journal_lines`` growth gauge: counted
+        # once at open (the file is compaction-bounded), then maintained
+        # incrementally by append/compact
+        self.lines = self._count_lines()
+
+    def _count_lines(self) -> int:
+        try:
+            with open(self.path, "rb") as fh:
+                return sum(chunk.count(b"\n")
+                           for chunk in iter(lambda: fh.read(1 << 16), b""))
+        except OSError:
+            return 0
 
     @classmethod
     def from_config(cls, config, download_root: str,
@@ -280,6 +314,7 @@ class JobJournal:
                 return
             self._fh.write(line)
             self.appended += 1
+            self.lines += 1
             self._arm_flusher()
 
     def _arm_flusher(self) -> None:
@@ -340,12 +375,17 @@ class JobJournal:
         its retry counter.  Write-temp + rename keeps a crash mid-compact
         from losing the old file.
 
-        Safe to run off-loop while appends continue: lines written after
-        the snapshot basis are preserved VERBATIM after the snapshot
-        line (replay applies the snapshot first, then the tail ops — the
-        same last-write-wins order they had), so a concurrent append is
-        never silently dropped.  ``state`` is an optional pre-computed
-        replay (tests); None replays the file here.
+        Safe to run off-loop while appends continue: the snapshot basis
+        is exactly the first ``base`` bytes captured under the lock, and
+        lines written after that offset are preserved VERBATIM after the
+        snapshot line (replay applies the snapshot first, then the tail
+        ops — the same last-write-wins order they had).  A concurrent
+        append is therefore never dropped AND never applied twice: the
+        prefix lands only in the snapshot, the tail only after it (the
+        soak flushed out the old behavior, which replayed the whole file
+        for the snapshot and so duplicated any line that landed between
+        the offset capture and the replay).  ``state`` is an optional
+        pre-computed replay (tests); None replays the captured prefix.
         """
         with self._lock:
             if self._closed:
@@ -356,7 +396,7 @@ class JobJournal:
             except OSError:
                 base = 0
         if state is None:
-            state = replay(self.path)
+            state = replay(self.path, limit_bytes=base)
         live = state.live()
         snapshot = {
             "op": OP_SNAPSHOT, "id": "", "t": _utcnow_iso(),
@@ -381,11 +421,26 @@ class JobJournal:
             self._fh.close()
             os.replace(tmp, self.path)
             self._fh = open(self.path, "a", encoding="utf-8")
+            self.lines = 1 + tail.count(b"\n")
+            self.compactions += 1
+            try:
+                post = os.path.getsize(self.path)
+            except OSError:
+                post = 0
+            # a compaction that could not get under max_bytes (live-set
+            # dominated) must not be re-triggered by the very next
+            # settle: require real growth past the post-compact size
+            # before trying again
+            self._compact_floor = post * 2 if post > self.max_bytes else 0
+
+    @property
+    def _compact_threshold(self) -> int:
+        return max(self.max_bytes, self._compact_floor)
 
     def maybe_compact(self) -> bool:
         """Compact when the file outgrew ``max_bytes`` (synchronous —
         boot/tests; the registry's settle path uses the async variant)."""
-        if self.size_bytes <= self.max_bytes:
+        if self.size_bytes <= self._compact_threshold:
             return False
         self.compact()
         return True
@@ -399,7 +454,7 @@ class JobJournal:
         controller is armed on.  Single-flight: a compaction already
         running absorbs the growth that triggered this call.
         """
-        if self.size_bytes <= self.max_bytes:
+        if self.size_bytes <= self._compact_threshold:
             return False
         with self._lock:
             if self._closed or self._compacting:

@@ -88,9 +88,13 @@ LEGAL_TRANSITIONS: Dict[str, frozenset] = {
     # PARKED -> RECEIVED: a crash-recovery placeholder (control/
     # journal.py) is adopted by its redelivery and re-enters the normal
     # intake path from the top — one record carries both incarnations.
+    # PARKED -> DONE: a recovery placeholder whose content a fleet PEER
+    # already staged (durable done marker observed) is retired without
+    # a local run — its redelivery went to the peer and will never
+    # arrive here (orchestrator._probe_recovered_staged).
     PARKED: frozenset(
-        {RECEIVED, ADMITTED, RUNNING, FAILED, CANCELLED, DROPPED_POISON,
-         EXPIRED}
+        {RECEIVED, ADMITTED, RUNNING, DONE, FAILED, CANCELLED,
+         DROPPED_POISON, EXPIRED}
     ),
     ADMITTED: frozenset(
         {RUNNING, PARKED, PUBLISHING, FAILED, CANCELLED, DROPPED_POISON,
@@ -461,6 +465,12 @@ class JobRegistry:
                 eventsDropped=record.recorder.dropped,
                 events=record.recorder.tail(DEBUG_BUNDLE_EVENTS),
             )
+        if record.recorder.dropped and self.metrics is not None:
+            # growth-pressure signal: how much per-job timeline the
+            # bounded event rings shed (counted once, at settle — the
+            # recorder's own drop counter is per-job and dies with it)
+            self.metrics.recorder_ring_evictions.inc(
+                record.recorder.dropped)
         self._active.pop(record.uid, None)
         self._ring.append(record)
         while len(self._ring) > self.terminal_ring:

@@ -1011,6 +1011,23 @@ class FleetPlane:
             raise
         except Exception as err:
             self._note_coord_error("gc_telemetry", err)
+        # coordination-store census (``fleet_coord_docs_total{prefix}``):
+        # sampled here — post-sweep, by the elected sweeper only — so the
+        # growth gauges cost list RTTs once per gc_interval, never per
+        # scrape.  A census failure degrades to stale gauges, not a
+        # failed sweep.
+        if self.metrics is not None:
+            for prefix in (WORKERS_PREFIX, LEASES_PREFIX,
+                           TELEMETRY_PREFIX):
+                try:
+                    docs = len(await self.coord.list_keys(prefix))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    self._note_coord_error("gc_census", err)
+                    break
+                self.metrics.coord_docs.labels(
+                    prefix=prefix.rstrip("/")).set(docs)
         sweep = getattr(self.coord, "sweep_tombstones", None)
         if sweep is not None:
             # a tombstone is compactable once every CAS that could have
@@ -1068,27 +1085,73 @@ class FleetPlane:
         """
         log = logger or self.logger
         deadline = time.monotonic() + self.max_wait
-        parked = False
-        waited = False
+        # coordination attribution (the soak's hop-ledger
+        # reconciliation flushed this out): lease acquire/release, the
+        # shared-entry probe, and shared-tier transfers are real
+        # wall-clock inside the download stage — unbilled, they made a
+        # coordinated job's ledger account for a fraction of its stage
+        # wall.  Three seconds-only hops, by what the time actually
+        # was: ``coord`` = the lease ceremony + probe misses (moves no
+        # payload bytes, like origin_wait), ``shared_fetch`` = a
+        # waiter materializing a peer's content from the shared tier,
+        # ``shared_spill`` = the leader publishing its entry there
+        # (byte counts for both ride fleet_shared_tier_bytes_total).
+        hop_seconds: Dict[str, float] = {}
+
+        def _bill(hop: str, seconds: float) -> None:
+            hop_seconds[hop] = hop_seconds.get(hop, 0.0) + seconds
+
+        async def _billed(coro, hop="coord"):
+            t0 = time.monotonic()
+            try:
+                return await coro
+            finally:
+                _bill(hop, time.monotonic() - t0)
+
         # the job's W3C trace context rides the lease doc and the
         # shared-tier manifest, so waiters (and later trace assembly)
         # can join this fetch to the trace that caused it
         trace = self._trace_context(record)
         try:
+            return await self._coordinate(
+                key, cache, origin_fill, cancel=cancel, record=record,
+                registry=registry, slot=slot, log=log,
+                deadline=deadline, trace=trace, billed=_billed,
+                bill=_bill)
+        finally:
+            if record is not None:
+                for hop, seconds in hop_seconds.items():
+                    if seconds > 0:
+                        record.note_hop(hop, 0, seconds)
+
+    async def _coordinate(self, key, cache, origin_fill, *, cancel,
+                          record, registry, slot, log, deadline, trace,
+                          billed, bill):
+        parked = False
+        waited = False
+        try:
             while True:
                 try:
                     # 1) a finished leader's bytes beat any lease dance
-                    if await self.fetch_entry(key, cache, record=record):
+                    # (a HIT transfers the peer's content — billed as
+                    # shared_fetch, not coordination ceremony; the
+                    # cheap miss probe stays on the coord hop)
+                    probe_started = time.monotonic()
+                    hit = await self.fetch_entry(key, cache,
+                                                 record=record)
+                    bill("shared_fetch" if hit else "coord",
+                         time.monotonic() - probe_started)
+                    if hit:
                         if record is not None:
                             record.event("fleet", outcome="shared",
                                          key=key[:16])
                         return SHARED
                     # 2) contend for the content lease
-                    lease = await self._coord_op(
+                    lease = await billed(self._coord_op(
                         "coord.lease",
                         lambda: self.try_acquire_lease(key, trace),
                         cancel=cancel,
-                    )
+                    ))
                 except (JobCancelled, asyncio.CancelledError):
                     raise  # cancellation settles the job, not the fleet
                 except Exception as err:
@@ -1184,9 +1247,10 @@ class FleetPlane:
                          fence=lease.fence)
         try:
             await origin_fill()
-            await self.publish_entry(key, cache, trace=trace)
+            await billed(self.publish_entry(key, cache, trace=trace),
+                         "shared_spill")
         finally:
-            await self.release_lease(key)
+            await billed(self.release_lease(key))
         return LED
 
 

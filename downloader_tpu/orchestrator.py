@@ -206,6 +206,19 @@ class Orchestrator:
         # whose redelivery has not arrived yet (the replay window)
         self._recovered: Dict[str, dict] = {}
         self._recovery_watchers: List[asyncio.Task] = []
+        # fleet-settled placeholder reconciliation (the soak harness
+        # flushed this out): in a multi-worker fleet, a killed worker's
+        # unacked delivery is redelivered to a PEER — the restarted
+        # worker's recovery placeholder then waits for a redelivery
+        # that will NEVER arrive (the peer acked it), parking a ghost
+        # record and keeping its "resumable" workdir until
+        # journal.tombstone_ttl (a day).  This loop probes the durable
+        # done marker for waiting placeholders every
+        # ``journal.staged_probe_interval`` seconds (0 = off) and
+        # retires the already-staged ones DONE, sweeping their workdirs.
+        self._staged_probe_interval = float(cfg_get(
+            config, "journal.staged_probe_interval", 30.0))
+        self._staged_probe_task: Optional[asyncio.Task] = None
         # detached per-job trace-digest publishes (fleet/plane.py
         # publish_telemetry): fired after settle so a coordination-store
         # round trip never delays an ack; drained at shutdown
@@ -351,6 +364,11 @@ class Orchestrator:
             # (observability only, no enforcement yet)
             metrics.bind_tenant_staging(self.tenants.names(),
                                         self.tenant_staging_bytes)
+            if self.journal is not None:
+                # journal growth gauges (journal_bytes/journal_lines):
+                # the bounded-growth signal the soak harness guards —
+                # compaction must hold the file O(live jobs)
+                metrics.bind_journal(self.journal)
         self._staging_memo = {"at": 0.0, "snap": None, "busy": False}
         # the dependencies whose open breaker pauses intake: everything a
         # job needs to SETTLE (staging writes + convert publish) — origin
@@ -408,6 +426,10 @@ class Orchestrator:
         self.consuming = True
         self.loop_monitor.start()
         self.profiler.start()
+        if (self.journal is not None
+                and self._staged_probe_interval > 0):
+            self._staged_probe_task = asyncio.get_running_loop() \
+                .create_task(self._staged_probe_loop())
         if self.overload is not None:
             self.overload.start()
         if self.fleet is not None:
@@ -724,6 +746,75 @@ class Orchestrator:
             self.metrics.jobs_cancelled.inc()
             self.metrics.jobs_recovered.labels(outcome="cancelled").inc()
 
+    async def _staged_probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._staged_probe_interval)
+            if not self._recovered:
+                continue
+            try:
+                await self._probe_recovered_staged()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                # store trouble: the placeholders keep waiting, the next
+                # pass probes again — degradation, never a crash
+                self.logger.warn("recovered-placeholder probe failed",
+                                 error=str(err))
+
+    async def _probe_recovered_staged(self) -> int:
+        """Retire PARKED recovery placeholders whose content the fleet
+        already staged (done marker present).
+
+        The placeholder's redelivery went to a peer worker (our unacked
+        delivery requeued when the previous incarnation died, and
+        another consumer won it) — the peer ran the job and acked it,
+        so no redelivery is owed to US.  Without this probe the
+        placeholder parks until ``journal.tombstone_ttl`` and its
+        workdir leaks for just as long.  If a redelivery *does* still
+        arrive after retirement (a second requeue), the normal intake
+        path's idempotency probe acks it as already staged — retiring
+        here is safe either way.
+        """
+        retired = 0
+        for record in self.registry.jobs(control.PARKED):
+            if not (record.recovered
+                    and (record.reason or "").startswith("recovered")):
+                continue
+            try:
+                await self.store.get_object(
+                    STAGING_BUCKET, done_marker_name(record.job_id))
+            except ObjectNotFound:
+                continue
+            except Exception:
+                continue  # store trouble: decide nothing this pass
+            if not (record.state == control.PARKED and record.recovered
+                    and (record.reason or "").startswith("recovered")):
+                # the probe's await yielded the loop: a redelivery
+                # adopted the placeholder (or a cancel settled it)
+                # while we were reading the marker — the normal intake
+                # path owns the record now, and its idempotency probe
+                # will make the same already-staged call
+                continue
+            entry = self._recovered.pop(record.job_id, None)
+            if entry is not None and entry.get("watcher") is not None:
+                entry["watcher"].cancel()
+            self._clear_failures(record.job_id)
+            record.event("settle", mode="none", why="staged_elsewhere")
+            self._journal_settle(record.job_id, "ack",
+                                 "staged_elsewhere")
+            self.registry.transition(
+                record, control.DONE,
+                reason="recovered: staged by a fleet peer")
+            await self._remove_workdir(record.job_id, self.logger)
+            if self.metrics is not None:
+                self.metrics.jobs_recovered.labels(
+                    outcome="staged_elsewhere").inc()
+            self.logger.info(
+                "recovered placeholder already staged by a peer",
+                jobId=record.job_id)
+            retired += 1
+        return retired
+
     # -- control plane: intake steering --------------------------------
     async def pause_intake(self) -> None:
         """Stop pulling deliveries; in-flight jobs keep running.
@@ -800,6 +891,13 @@ class Orchestrator:
             # leave the fleet before the backends close: deregistration
             # and lease release still have a live store to write to
             await self.fleet.stop()
+        if self._staged_probe_task is not None:
+            self._staged_probe_task.cancel()
+            try:
+                await self._staged_probe_task
+            except asyncio.CancelledError:
+                pass
+            self._staged_probe_task = None
         for watcher in self._recovery_watchers:
             watcher.cancel()
         if self._recovery_watchers:

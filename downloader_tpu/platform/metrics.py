@@ -394,8 +394,44 @@ class Metrics:
             "over its placeholder, cancelled = placeholder cancelled "
             "during the replay window, expired = placeholder or cancel "
             "tombstone retired past journal.tombstone_ttl — its "
-            "redelivery never came)",
+            "redelivery never came, staged_elsewhere = placeholder "
+            "retired DONE because a fleet peer's done marker proves the "
+            "content already staged)",
             ["outcome"],
+            registry=self.registry,
+        )
+        # -- bounded-growth gauges (the soak harness's SLO inputs) -----
+        self.journal_bytes = Gauge(
+            f"{ns}_journal_bytes",
+            "Size of the job journal file on disk — compaction "
+            "(journal.max_bytes) must hold this bounded by live-job "
+            "count, not process age; a sustained climb means "
+            "compaction is stalled or the live set itself is growing",
+            registry=self.registry,
+        )
+        self.journal_lines = Gauge(
+            f"{ns}_journal_lines",
+            "Lines in the job journal file (one per lifecycle event "
+            "since the last compaction snapshot) — the replay cost a "
+            "restart would pay right now",
+            registry=self.registry,
+        )
+        self.coord_docs = Gauge(
+            f"{ns}_fleet_coord_docs_total",
+            "Documents in the fleet coordination store per key prefix "
+            "(workers / leases / telemetry), censused by the elected "
+            "GC sweeper each fleet.gc_interval — growth here is a GC "
+            "stall: telemetry digests and tombstones otherwise accrete "
+            "one per job forever",
+            ["prefix"],
+            registry=self.registry,
+        )
+        self.recorder_ring_evictions = Counter(
+            f"{ns}_recorder_ring_evictions_total",
+            "Flight-recorder events evicted from per-job rings "
+            "(obs.recorder_events), counted when each job settles — a "
+            "high rate means long/chatty jobs are losing their early "
+            "timeline and debug bundles show only the tail",
             registry=self.registry,
         )
         self.manifest_mismatches = Counter(
@@ -475,6 +511,21 @@ class Metrics:
             lambda: float(exporter.errors))
         self.otlp_queue_depth.set_function(
             lambda: float(exporter._queue.qsize()))
+
+    def bind_journal(self, journal) -> None:
+        """Wire the journal growth gauges to a live
+        :class:`~..control.journal.JobJournal`.
+
+        ``journal_bytes`` stats the file at scrape time (one syscall);
+        ``journal_lines`` reads the in-memory census the journal
+        maintains across appends and compactions.  Together they are
+        the bounded-growth signal the soak harness guards on: the file
+        must stay O(live jobs) no matter how many jobs have settled.
+        """
+        self.journal_bytes.set_function(
+            lambda: float(journal.size_bytes))
+        self.journal_lines.set_function(
+            lambda: float(journal.lines))
 
     def bind_autoscale(self, signals_fn) -> None:
         """Wire the autoscale trio to a live snapshot callable.

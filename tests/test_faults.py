@@ -168,8 +168,15 @@ async def test_racing_origin_killed_and_hung_mirror_chaos(tmp_path):
     from helpers import RangeOrigin
 
     payload = os.urandom(12 << 20)
-    healthy = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
-    killed = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
+    # paced origins: on a fast host an unthrottled healthy origin can
+    # drain every pending range before the killed mirror pulls its
+    # SECOND one — the fault then never fires twice and the open-
+    # breaker assert below flakes (the work-stealing scheduler is
+    # allowed to finish that fast; the chaos needs a real race window)
+    healthy = RangeOrigin(payload, etag='"e1"', path="/media.mkv",
+                          rate=24_000_000.0)
+    killed = RangeOrigin(payload, etag='"e1"', path="/media.mkv",
+                         rate=24_000_000.0)
     hung = RangeOrigin(payload, etag='"e1"', path="/media.mkv")
     for origin in (healthy, killed, hung):
         await origin.start()

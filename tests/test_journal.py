@@ -489,3 +489,254 @@ async def test_registry_transitions_feed_journal(tmp_path):
     finally:
         await orchestrator.shutdown(grace_seconds=2)
         await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Compaction under concurrent appends (ISSUE 13 satellite + soak fixes)
+# ---------------------------------------------------------------------------
+
+def test_compact_racing_append_lands_exactly_once(tmp_path, monkeypatch):
+    """A line appended between the compaction's offset capture and its
+    snapshot build must appear EXACTLY once after the rewrite: in the
+    preserved tail, never folded into the snapshot too (the old code
+    replayed the whole file for the snapshot basis, so a racing append
+    was applied twice — snapshot + verbatim tail)."""
+    import threading
+
+    from downloader_tpu.control import journal as journal_mod
+
+    journal = make_journal(tmp_path)
+    journal.append("open", "old-1", fileId="c")
+    journal.append("retry", "old-1", failures=2)
+
+    in_replay = threading.Event()
+    release = threading.Event()
+    real_replay = journal_mod.replay
+
+    def gated_replay(path, limit_bytes=None):
+        in_replay.set()
+        assert release.wait(5)
+        return real_replay(path, limit_bytes=limit_bytes)
+
+    monkeypatch.setattr(journal_mod, "replay", gated_replay)
+    worker = threading.Thread(target=journal.compact)
+    worker.start()
+    assert in_replay.wait(5)
+    # the race: these land after the offset capture, during the rewrite
+    journal.append("open", "racer", fileId="c")
+    journal.append("retry", "racer", failures=1)
+    release.set()
+    worker.join(5)
+    assert not worker.is_alive()
+    journal.close()
+
+    with open(journal.path, "r", encoding="utf-8") as fh:
+        lines = [json.loads(line) for line in fh if line.strip()]
+    snapshot = lines[0]
+    assert snapshot["op"] == "snapshot"
+    assert all(job["id"] != "racer" for job in snapshot["jobs"])
+    tail_opens = [line for line in lines[1:]
+                  if line.get("op") == "open" and line["id"] == "racer"]
+    assert len(tail_opens) == 1
+    state = replay(journal.path)
+    assert state.jobs["racer"].failures == 1
+    assert state.jobs["old-1"].failures == 2
+
+
+def test_compact_stress_concurrent_appends_lose_nothing(tmp_path):
+    """Thread-stress: writers append retry counters while the main
+    thread compacts repeatedly — replay must see every job with its
+    exact final counter, zero torn lines (the soak's terminal-
+    retirement compactions run against live appends all day)."""
+    import threading
+
+    journal = make_journal(tmp_path)
+
+    def writer(n):
+        for i in range(120):
+            journal.append("open", f"w{n}-{i}", fileId="c")
+            journal.append("retry", f"w{n}-{i}", failures=7)
+
+    threads = [threading.Thread(target=writer, args=(n,))
+               for n in range(3)]
+    for thread in threads:
+        thread.start()
+    for _ in range(6):
+        journal.compact()
+    for thread in threads:
+        thread.join()
+    journal.compact()
+    journal.close()
+
+    state = replay(journal.path)
+    assert state.torn_lines == 0
+    assert len(state.jobs) == 360
+    assert all(job.failures == 7 for job in state.jobs.values())
+
+
+def test_compaction_backs_off_when_live_set_exceeds_max_bytes(tmp_path):
+    """The soak's terminal-retirement stall: when the live set alone
+    outgrows ``journal.max_bytes``, a compaction cannot shrink the file
+    — and every subsequent settle used to re-trigger a full replay +
+    rewrite that could not help.  The floor requires real growth past
+    the post-compact size before compacting again, and resets once the
+    live set fits."""
+    journal = make_journal(tmp_path, max_bytes=1 << 16)
+    for i in range(1500):
+        journal.append("open", f"live-{i:05d}", fileId="f" * 40)
+    assert journal.maybe_compact() is True
+    assert journal.compactions == 1
+    assert journal.size_bytes > journal.max_bytes  # could not shrink
+
+    # the next settles must NOT thrash full rewrites
+    for i in range(20):
+        journal.append("state", f"live-{i:05d}", state="DONE")
+        journal.append("settle", f"live-{i:05d}", mode="ack")
+        assert journal.maybe_compact() is False
+    assert journal.compactions == 1
+
+    # settle everything; once growth crosses the floor, compaction runs
+    # again, fits under max_bytes, and the floor resets
+    for i in range(20, 1500):
+        journal.append("state", f"live-{i:05d}", state="DONE")
+        journal.append("settle", f"live-{i:05d}", mode="ack")
+    while journal.size_bytes <= journal._compact_threshold:
+        journal.append("state", "live-00000", state="DONE")
+    assert journal.maybe_compact() is True
+    assert journal.compactions == 2
+    assert journal.size_bytes < journal.max_bytes
+    assert journal._compact_floor == 0
+    journal.close()
+
+
+def test_journal_line_census_tracks_appends_and_compaction(tmp_path):
+    """``journal.lines`` (the journal_lines gauge's source) counts the
+    file exactly: at open, per append, and across a compaction."""
+    journal = make_journal(tmp_path)
+    assert journal.lines == 0
+    journal.append("open", "j1", fileId="c")
+    journal.append("state", "j1", state="DONE")
+    journal.append("settle", "j1", mode="ack", why="done")
+    journal.append("open", "j2", fileId="c")
+    assert journal.lines == 4
+    journal.compact()
+    # one snapshot line (j1 was ack-settled and dropped)
+    assert journal.lines == 1
+    journal.append("state", "j2", state="RUNNING", stage="download")
+    assert journal.lines == 2
+    journal.close()
+
+    # a fresh handle over the same file counts what is on disk
+    reopened = JobJournal(journal.path, fsync_interval=0)
+    assert reopened.lines == 2
+    reopened.close()
+
+
+async def test_recovered_placeholder_staged_elsewhere_is_retired(tmp_path):
+    """The soak's multi-worker orphan: worker A dies mid-job, the
+    broker hands the redelivery to peer B, B stages and acks it — A's
+    restart then parks a placeholder for a redelivery that will NEVER
+    arrive, keeping its workdir "resumable" until tombstone_ttl.  The
+    staged-elsewhere probe sees B's durable done marker, retires the
+    placeholder DONE, and sweeps the workdir."""
+    from downloader_tpu.stages.upload import done_marker_name
+
+    downloads = seed_journal(tmp_path, "re-peer", failures=1)
+    (downloads / "re-peer").mkdir(parents=True)
+    (downloads / "re-peer" / "show.mkv.partial").write_bytes(b"half")
+
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    # peer B already staged and sealed the content
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(STAGING_BUCKET, object_name("re-peer", "show.mkv"),
+                           b"V" * 4096)
+    await store.put_object(STAGING_BUCKET, done_marker_name("re-peer"),
+                           b"true")
+
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store,
+        extra={"journal": {"staged_probe_interval": 0.1}})
+    try:
+        record = orchestrator.registry.get("re-peer")
+        assert record.state == "PARKED" and record.recovered
+        async with asyncio.timeout(5):
+            while record.state != "DONE":
+                await asyncio.sleep(0.02)
+        assert record.reason == "recovered: staged by a fleet peer"
+        # the workdir sweep runs just AFTER the terminal transition
+        # (transition-first is the ack-settle ordering): poll it
+        async with asyncio.timeout(5):
+            while (downloads / "re-peer").exists():
+                await asyncio.sleep(0.02)
+        assert "re-peer" not in orchestrator._failure_counts
+        # journaled as ack-settled: the NEXT boot owes it nothing
+        orchestrator.journal.flush()
+        state = orchestrator.journal.replay()
+        assert state.live() == {}
+        # the probe loop keeps running without placeholders (no crash)
+        await asyncio.sleep(0.25)
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_staged_probe_leaves_unstaged_placeholders_alone(tmp_path):
+    """A placeholder whose content is NOT staged anywhere keeps
+    waiting for its redelivery — the probe must never guess."""
+    seed_journal(tmp_path, "re-wait", failures=1)
+    broker = InMemoryBroker()
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, InMemoryObjectStore(),
+        extra={"journal": {"staged_probe_interval": 0.05}})
+    try:
+        await asyncio.sleep(0.3)  # several probe passes
+        record = orchestrator.registry.get("re-wait")
+        assert record.state == "PARKED"
+        assert orchestrator._failure_counts["re-wait"] == 1
+    finally:
+        await orchestrator.shutdown(grace_seconds=2)
+
+
+async def test_staged_probe_yields_to_adoption_mid_await(tmp_path):
+    """Review r17: the probe's marker read awaits the loop — a
+    redelivery can adopt the placeholder DURING that await.  The probe
+    must re-check and stand down: no counter wipe, no false
+    ``staged_elsewhere`` settle line, no illegal transition on the
+    now-RECEIVED record (the intake path's own idempotency probe owns
+    the already-staged answer from here)."""
+    from downloader_tpu.stages.upload import done_marker_name
+
+    seed_journal(tmp_path, "re-race", failures=2)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    await store.put_object(STAGING_BUCKET, done_marker_name("re-race"),
+                           b"true")
+    orchestrator = await make_orchestrator(tmp_path, broker, store)
+    try:
+        registry = orchestrator.registry
+        assert registry.get("re-race").state == "PARKED"
+
+        real_get = store.get_object
+
+        async def adopting_get(bucket, name):
+            out = await real_get(bucket, name)
+            # the adoption lands while the probe is suspended in this
+            # exact await (single loop: this IS the interleaving)
+            if name == done_marker_name("re-race"):
+                registry.adopt_recovered("re-race", "card-1")
+            return out
+
+        store.get_object = adopting_get
+        retired = await orchestrator._probe_recovered_staged()
+        assert retired == 0
+
+        record = registry.get("re-race")
+        assert record.state == "RECEIVED"  # the adoption won
+        assert orchestrator._failure_counts["re-race"] == 2  # intact
+        orchestrator.journal.flush()
+        with open(orchestrator.journal.path, "r", encoding="utf-8") as fh:
+            assert "staged_elsewhere" not in fh.read()
+    finally:
+        store.get_object = real_get
+        await orchestrator.shutdown(grace_seconds=2)

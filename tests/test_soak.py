@@ -1,0 +1,609 @@
+"""Sustained-load soak harness (downloader_tpu/soak/; ISSUE 13).
+
+Two layers:
+
+- fast unit tests over the pure SLO math (percentile, slope fit,
+  hop-ledger reconciliation, guard evaluation) and the deterministic
+  workload builder;
+- ``test_soak_smoke`` — the tier-1 capacity gate (``make soak-smoke``):
+  a REAL 2-worker fleet (subprocess workers over real-wire MiniAmqp +
+  MiniS3 + HTTP/range/manifest origins) under the full mixed workload
+  with ≥ 1 SIGKILL + restart mid-run, asserting every SLO guard green:
+  p99 time-to-staged per priority class, bounded journal /
+  coordination-store / shared-cache growth after GC + compaction, zero
+  leaked leases or orphan workdirs at drain, zero poison-budget burn,
+  staged byte-identity, and hop-ledger totals reconciling with stage
+  wall clock.
+
+``test_soak_full`` is the slow-marked capacity run (``make soak``);
+``bench.py --soak`` reuses :class:`SoakTestWorld` for the v18
+``soak_p99_ms`` / ``soak_rss_slope_mb_per_kjob`` /
+``soak_journal_peak_bytes`` metrics.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu.soak import (SoakEndpoints, SoakProfile, SoakRig,
+                                 SoakWorkload, WorkloadOrigin, fit_slope,
+                                 parse_prometheus, percentile)
+from downloader_tpu.soak.rig import JobOutcome, SoakWorld
+from downloader_tpu.soak.sampler import Sample
+from downloader_tpu.soak.slo import evaluate, hop_reconciliation
+from downloader_tpu.soak.workload import JobSpec
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.store.s3 import S3ObjectStore
+
+from helpers import RangeOrigin, start_http_server
+from miniamqp import MiniAmqpServer
+from minis3 import MiniS3
+
+pytestmark = pytest.mark.anyio
+
+STAGING = "triton-staging"
+
+
+# ---------------------------------------------------------------------------
+# SLO math units
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank():
+    values = list(range(1, 101))
+    assert percentile(values, 50) == 50
+    assert percentile(values, 99) == 99
+    assert percentile(values, 100) == 100
+    assert percentile([7.0], 99) == 7.0
+    assert percentile([], 99) == 0.0
+
+
+def test_fit_slope_recovers_line_and_degenerates_to_zero():
+    xs = [0.0, 1.0, 2.0, 3.0]
+    assert abs(fit_slope(xs, [2.0 + 3.0 * x for x in xs]) - 3.0) < 1e-9
+    assert fit_slope([1.0, 1.0, 1.0], [1.0, 2.0, 3.0]) == 0.0
+    assert fit_slope([1.0], [1.0]) == 0.0
+
+
+def test_parse_prometheus_keeps_only_wanted_families():
+    text = "\n".join([
+        "# HELP x_journal_bytes size",
+        "# TYPE x_journal_bytes gauge",
+        "x_journal_bytes 12345.0",
+        'x_fleet_coord_docs_total{prefix="telemetry"} 7.0',
+        "x_jobs_active 3.0",
+        "not a metric line",
+    ])
+    parsed = parse_prometheus(text)
+    assert parsed["x_journal_bytes"] == 12345.0
+    assert parsed['x_fleet_coord_docs_total{prefix="telemetry"}'] == 7.0
+    assert all("jobs_active" not in key for key in parsed)
+
+
+def _origin(uri="http://o/x.bin", files=(("x.bin", b"x"),)):
+    return WorkloadOrigin(uri=uri, files=tuple(files))
+
+
+def test_workload_mix_is_deterministic_and_interleaved():
+    profile = SoakProfile(jobs=40)
+    endpoints = SoakEndpoints(
+        hot=(_origin("http://o/hot.bin"),),
+        plain=tuple(_origin(f"http://o/p{i}.bin") for i in range(4)),
+        racing=(WorkloadOrigin(uri="http://o/r.bin",
+                               files=(("r.bin", b"r"),),
+                               mirrors=("http://m/r.bin",)),),
+        manifest=(WorkloadOrigin(uri="http://o/v.m3u8",
+                                 files=(("s0.ts", b"s"),),
+                                 source_kind="MANIFEST"),),
+    )
+    one = SoakWorkload(profile, endpoints)
+    two = SoakWorkload(profile, endpoints)
+    assert [s.job_id for s in one.specs] == [s.job_id for s in two.specs]
+    assert len(one.specs) == 40
+    kinds_first_ten = {spec.kind for spec in one.specs[:10]}
+    # round-robin interleave: every lane is represented early, so the
+    # chaos window always lands on mixed traffic
+    assert {"hot", "racing", "manifest", "bulk", "plain"} <= \
+        kinds_first_ten
+    bulk = one.by_kind("bulk")
+    assert bulk and all(s.priority == "BULK" and s.tenant == "batch"
+                        and s.ttl_seconds > 0 for s in bulk)
+    hot = one.by_kind("hot")
+    assert {s.priority for s in hot} == {"HIGH", "NORMAL"}
+    assert len({s.origin.uri for s in hot}) == 1  # one shared key
+
+
+def test_profile_from_config_reads_soak_knobs():
+    config = ConfigNode({"soak": {"jobs": 7, "workers": 5,
+                                  "kill_interval": 0.5}})
+    profile = SoakProfile.from_config(config)
+    assert (profile.jobs, profile.workers, profile.kill_interval) == \
+        (7, 5, 0.5)
+    # unset knobs keep the base profile's values
+    base = SoakProfile.full()
+    resized = SoakProfile.from_config(ConfigNode({}), base=base)
+    assert resized.jobs == base.jobs and resized.workers == base.workers
+
+
+def test_hop_reconciliation_excludes_idle_jobs():
+    fetcher = {
+        "state": "DONE", "bytes": {"downloaded": 1 << 20},
+        "hopLedger": {"socket_read": {"seconds": 0.6},
+                      "upload": {"seconds": 0.35}},
+        "stageSeconds": {"pipeline": 1.0},
+    }
+    cache_hit = {   # no downloaded bytes: excluded by design
+        "state": "DONE", "bytes": {},
+        "hopLedger": {"hash": {"seconds": 0.01}},
+        "stageSeconds": {"pipeline": 3.0},
+    }
+    failed = {"state": "FAILED", "bytes": {"downloaded": 5},
+              "hopLedger": {"socket_read": {"seconds": 9.0}},
+              "stageSeconds": {"download": 0.1}}
+    ratio, eligible = hop_reconciliation([fetcher, cache_hit, failed])
+    assert eligible == 1
+    assert abs(ratio - 0.95) < 1e-9
+
+
+def _outcome(spec, staged_after=0.5, state="DONE"):
+    outcome = JobOutcome(spec, published_mono=100.0)
+    outcome.resolved_mono = 100.0 + staged_after
+    outcome.terminal_state = state
+    if state == "DONE":
+        outcome.staged_mono = outcome.resolved_mono
+    return outcome
+
+
+def _record(job_id):
+    return {"id": job_id, "state": "DONE",
+            "bytes": {"downloaded": 1 << 20},
+            "hopLedger": {"socket_read": {"seconds": 0.5}},
+            "stageSeconds": {"pipeline": 0.5}}
+
+
+def _sample(t, done, telemetry=2, journal=1024):
+    return Sample(t_mono=t, done_jobs=done,
+                  journal_bytes={0: journal},
+                  rss_bytes={(0, 1): 50 << 20},
+                  coord_docs={"workers": 2, "leases": 0,
+                              "telemetry": telemetry},
+                  shared_cache_bytes=1 << 20)
+
+
+def _clean_world(records):
+    return SoakWorld(records=records,
+                     coord_live={"workers": 2, "leases": 0,
+                                 "telemetry": 1},
+                     orphan_workdirs={0: [], 1: []},
+                     journal_final_bytes={0: 2048})
+
+
+def test_evaluate_green_run_and_guard_flips():
+    profile = SoakProfile(jobs=6)
+    specs = [JobSpec(f"j{i}", "plain", _origin()) for i in range(4)]
+    specs.append(JobSpec("jb", "bulk", _origin(), priority="BULK",
+                         tenant="batch", ttl_seconds=30.0))
+    specs.append(JobSpec("jh", "hot", _origin(), priority="HIGH"))
+    specs.append(JobSpec("jp", "probe", _origin()))
+    outcomes = [_outcome(spec) for spec in specs]
+    samples = [_sample(0.0, 0), _sample(1.0, 2), _sample(2.0, 4),
+               _sample(3.0, 6)]
+    records = [_record(spec.job_id) for spec in specs]
+    report = evaluate(profile, outcomes, samples, _clean_world(records))
+    assert report.ok, report.summary()
+    assert report.stats["p99_normal_s"] == 0.5
+
+    # a leaked lease flips exactly that guard
+    leaky = _clean_world(records)
+    leaky.leaked_leases = [".fleet/leases/abc"]
+    report = evaluate(profile, outcomes, samples, leaky)
+    assert not report.ok
+    assert [g.name for g in report.failures()] == \
+        ["leaked_leases_at_drain"]
+
+    # a DROPPED_POISON outcome flips the poison guard
+    poisoned = outcomes[:-1] + [_outcome(specs[-1], state="DROPPED_POISON")]
+    report = evaluate(profile, poisoned, samples, _clean_world(records))
+    assert any(g.name == "failed_or_poisoned_jobs"
+               for g in report.failures())
+
+    # journal growth past the bound flips the compaction guard
+    fat = samples + [_sample(4.0, 6,
+                             journal=profile.journal_peak_limit + 1)]
+    report = evaluate(profile, outcomes, fat, _clean_world(records))
+    assert any(g.name == "journal_peak_bytes"
+               for g in report.failures())
+
+    # an unresolved job can never pass
+    hung = outcomes + [JobOutcome(JobSpec("jz", "plain", _origin()),
+                                  published_mono=100.0)]
+    report = evaluate(profile, hung, samples, _clean_world(records))
+    assert any(g.name == "unresolved_jobs" for g in report.failures())
+
+
+# ---------------------------------------------------------------------------
+# The real-fleet world (shared with bench.py --soak)
+# ---------------------------------------------------------------------------
+
+class HotOrigin:
+    """One cacheable payload with an ETag — the shared fan-in key."""
+
+    def __init__(self, size=384 << 10, name="hot.mkv"):
+        self.payload = os.urandom(size)
+        self.name = name
+        self.requests = 0
+        self._runner = None
+        self.url = None
+
+    async def _serve(self, request):
+        headers = {"ETag": '"soak-hot-1"',
+                   "Content-Length": str(len(self.payload)),
+                   "Accept-Ranges": "bytes"}
+        if request.method == "HEAD":
+            return web.Response(headers=headers)
+        self.requests += 1
+        return web.Response(body=self.payload,
+                            headers={"ETag": '"soak-hot-1"'})
+
+    async def start(self):
+        self._runner, base = await start_http_server(
+            self._serve, path=f"/{self.name}")
+        self.url = f"{base}/{self.name}"
+        return self.url
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+class FileSetOrigin:
+    """Distinct cacheable payloads at ``/files/<name>``."""
+
+    def __init__(self, count=6, size=160 << 10, prefix="p"):
+        self.files = {f"{prefix}{i}.mkv": os.urandom(size)
+                      for i in range(count)}
+        self._runner = None
+        self.base = None
+
+    async def _serve(self, request):
+        name = request.match_info["name"]
+        payload = self.files.get(name)
+        if payload is None:
+            return web.Response(status=404)
+        return web.Response(body=payload,
+                            headers={"ETag": f'"soak-{name}"'})
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/files/{name}", self._serve)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self._runner = runner
+        self.base = f"http://127.0.0.1:{port}"
+        return self.base
+
+    def origin(self, name) -> WorkloadOrigin:
+        return WorkloadOrigin(uri=f"{self.base}/files/{name}",
+                              files=((name, self.files[name]),))
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+class VodOrigin:
+    """An ended HLS-style playlist: the manifest ingest's VOD path."""
+
+    def __init__(self, index=0, segments=4, seg_bytes=48 << 10):
+        self.prefix = f"v{index}"
+        self.segments = [os.urandom(seg_bytes) for _ in range(segments)]
+        self._runner = None
+        self.url = None
+
+    async def _playlist(self, _request):
+        lines = ["#EXTM3U", "#EXT-X-TARGETDURATION:1",
+                 "#EXT-X-MEDIA-SEQUENCE:0"]
+        for i in range(len(self.segments)):
+            lines.append("#EXTINF:0.5,")
+            lines.append(f"{self.prefix}seg{i:04d}.ts")
+        lines.append("#EXT-X-ENDLIST")
+        return web.Response(text="\n".join(lines))
+
+    async def _segment(self, request):
+        return web.Response(
+            body=self.segments[int(request.match_info["i"])])
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get(f"/{self.prefix}.m3u8", self._playlist)
+        app.router.add_get(
+            r"/%sseg{i:\d+}.ts" % self.prefix, self._segment)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self._runner = runner
+        self.url = f"http://127.0.0.1:{port}/{self.prefix}.m3u8"
+        return self.url
+
+    def origin(self) -> WorkloadOrigin:
+        return WorkloadOrigin(
+            uri=self.url, source_kind="MANIFEST",
+            files=tuple((f"{self.prefix}seg{i:04d}.ts", payload)
+                        for i, payload in enumerate(self.segments)))
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+class SoakTestWorld:
+    """Backends + origins + rig for one soak run (tests and bench)."""
+
+    def __init__(self):
+        self.amqp = None
+        self.s3 = None
+        self.store = None
+        self.origins = []
+        self.rig = None
+        self.workload = None
+
+    @classmethod
+    async def create(cls, root: str, profile: SoakProfile
+                     ) -> "SoakTestWorld":
+        world = cls()
+        world.amqp = MiniAmqpServer()
+        await world.amqp.start()
+        world.s3 = MiniS3()
+        s3_url = await world.s3.start()
+        world.store = S3ObjectStore(s3_url, "AKIA", "SECRET")
+        await world.store.make_bucket(STAGING)
+
+        hot = HotOrigin()
+        await hot.start()
+        plain = FileSetOrigin()
+        await plain.start()
+        racing_pairs = []
+        for i in range(2):
+            payload = os.urandom(512 << 10)
+            primary = RangeOrigin(payload, rate=600_000.0,
+                                  etag=f'"race-{i}"',
+                                  path=f"/race{i}.mkv")
+            mirror = RangeOrigin(payload, etag=f'"race-{i}"',
+                                 path=f"/race{i}.mkv")
+            await primary.start()
+            await mirror.start()
+            racing_pairs.append(WorkloadOrigin(
+                uri=primary.url, mirrors=(mirror.url,),
+                files=((f"race{i}.mkv", payload),)))
+            world.origins.extend([primary, mirror])
+        vods = [VodOrigin(index=i) for i in range(2)]
+        for vod in vods:
+            await vod.start()
+        # attribution probe: fresh content, rate-limited so the splice
+        # dominates the coordination ceremony (the reconciliation
+        # guard's transfer-attributable regime)
+        probes = []
+        for i in range(profile.probe_jobs):
+            payload = os.urandom(2 << 20)
+            origin = RangeOrigin(payload, rate=3_000_000.0,
+                                 etag=f'"probe-{i}"',
+                                 path=f"/probe{i}.mkv")
+            await origin.start()
+            probes.append(WorkloadOrigin(
+                uri=origin.url,
+                files=((f"probe{i}.mkv", payload),)))
+            world.origins.append(origin)
+        world.origins.extend([hot, plain] + vods)
+
+        endpoints = SoakEndpoints(
+            hot=(WorkloadOrigin(uri=hot.url,
+                                files=((hot.name, hot.payload),)),),
+            plain=tuple(plain.origin(name)
+                        for name in sorted(plain.files)),
+            racing=tuple(racing_pairs),
+            manifest=tuple(vod.origin() for vod in vods),
+            probe=tuple(probes),
+        )
+        world.workload = SoakWorkload(profile, endpoints)
+        world.rig = SoakRig(
+            profile,
+            amqp_url=world.amqp.url,
+            store=world.store,
+            s3_endpoint=f"http://127.0.0.1:{world.s3.port}",
+            root=root,
+        )
+        return world
+
+    async def close(self):
+        if self.rig is not None:
+            await self.rig.stop_workers()
+        for origin in self.origins:
+            await origin.stop()
+        if self.store is not None:
+            await self.store.close()
+        if self.s3 is not None:
+            await self.s3.stop()
+        if self.amqp is not None:
+            await self.amqp.stop()
+
+
+async def _run_soak(tmp_path, profile):
+    world = await SoakTestWorld.create(str(tmp_path), profile)
+    try:
+        async with asyncio.timeout(profile.max_wall + 90):
+            report = await world.rig.run(world.workload)
+    finally:
+        await world.close()
+    return world, report
+
+
+def _explain(report):
+    return report.summary() + "\n" + json.dumps(report.to_dict(),
+                                                indent=2)
+
+
+async def test_soak_smoke(tmp_path):
+    """The tier-1 capacity gate: mixed workload + ≥1 SIGKILL, every
+    SLO guard green (``make soak-smoke``)."""
+    profile = SoakProfile.smoke()
+    world, report = await _run_soak(tmp_path, profile)
+
+    assert report.ok, _explain(report)
+    # the chaos actually happened: at least one true SIGKILL + restart
+    assert report.stats["kills_delivered"] >= 1
+    # every workload kind resolved (the mix was really exercised)
+    for kind in ("hot", "racing", "manifest", "bulk", "plain"):
+        kind_outcomes = [o for o in world.rig.outcomes.values()
+                         if o.spec.kind == kind]
+        assert kind_outcomes, f"no {kind} jobs in the mix"
+        assert all(o.resolved_mono is not None for o in kind_outcomes)
+    # the growth gauges the guards ride were live on /metrics: some
+    # sample scraped a journal_bytes value off a real worker
+    assert any(
+        sample.metric(slot.index, "journal_bytes") is not None
+        for sample in world.rig.samples
+        for slot in world.rig.slots
+    ), "journal_bytes gauge never appeared on /metrics"
+    assert report.stats["journal_peak_bytes"] > 0
+
+
+@pytest.mark.slow
+async def test_soak_full(tmp_path):
+    """The slow capacity profile (``make soak``): more jobs, more
+    workers, more kills — same hard guards."""
+    profile = SoakProfile.full()
+    _world, report = await _run_soak(tmp_path, profile)
+    assert report.ok, _explain(report)
+    assert report.stats["kills_delivered"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Growth gauges (ISSUE 13 satellite): the signals the guards ride
+# ---------------------------------------------------------------------------
+
+def test_bind_journal_gauges_follow_the_file(tmp_path):
+    from downloader_tpu.control.journal import JobJournal
+    from downloader_tpu.platform import metrics as prom
+
+    metrics = prom.new(f"soakg{os.urandom(3).hex()}")
+    journal = JobJournal(str(tmp_path / "journal.jsonl"),
+                         fsync_interval=0)
+    journal.append("open", "j1", fileId="c")
+    journal.append("state", "j1", state="DONE")
+    metrics.bind_journal(journal)
+    parsed = parse_prometheus(metrics.render().decode())
+    by_suffix = {name.split("_", 1)[1]: value
+                 for name, value in parsed.items()}
+    assert by_suffix["journal_bytes"] == float(journal.size_bytes) > 0
+    assert by_suffix["journal_lines"] == 2.0
+    journal.close()
+
+
+async def test_gc_census_sets_coord_doc_gauges():
+    from downloader_tpu.fleet.plane import FleetPlane, MemoryCoordStore
+    from downloader_tpu.platform import metrics as prom
+
+    metrics = prom.new(f"soakc{os.urandom(3).hex()}")
+    coord = MemoryCoordStore()
+    await coord.put("workers/w1", {"workerId": "w1"})
+    await coord.put("workers/w2", {"workerId": "w2"})
+    await coord.put("leases/k1", {"owner": "w1"})
+    await coord.put("telemetry/t1/w1/j1", {"settledAt": 0})
+    plane = FleetPlane(coord, "w1", metrics=metrics)
+    await plane.gc_once()
+    text = metrics.render().decode()
+    parsed = parse_prometheus(text)
+
+    def census(prefix):
+        for name, value in parsed.items():
+            if name.endswith(f'fleet_coord_docs_total{{prefix="{prefix}"}}'):
+                return value
+        return None
+
+    assert census("workers") == 2.0
+    assert census("leases") == 1.0
+    # the sweep itself may age the telemetry doc out (settledAt 0 is
+    # ancient): the census runs post-sweep, so 0 or 1 are both honest —
+    # it must exist either way
+    assert census("telemetry") in (0.0, 1.0)
+
+
+def test_recorder_ring_evictions_counted_at_retire():
+    from downloader_tpu.control.registry import (ADMITTED, DONE,
+                                                 PUBLISHING, RUNNING,
+                                                 JobRegistry)
+    from downloader_tpu.platform import metrics as prom
+
+    metrics = prom.new(f"soakr{os.urandom(3).hex()}")
+    registry = JobRegistry(metrics=metrics, recorder_events=4)
+    record = registry.register("ring-1", "card")
+    for i in range(10):
+        record.event("spam", i=i)
+    registry.transition(record, ADMITTED)
+    registry.transition(record, RUNNING, stage="download")
+    registry.transition(record, PUBLISHING)
+    registry.transition(record, DONE)
+    assert record.recorder.dropped > 0
+    value = metrics.recorder_ring_evictions._value.get()
+    assert value == float(record.recorder.dropped)
+
+
+async def test_coordinate_bills_coord_hop(tmp_path):
+    """The fleet-lease ceremony lands on the job's hop ledger as the
+    seconds-only ``coord`` hop (the soak's reconciliation found the
+    ceremony unbilled — a coordinated job's ledger could not account
+    for its own stage wall)."""
+    from downloader_tpu.control.registry import JobRegistry
+    from downloader_tpu.fleet.plane import (LED, FleetPlane,
+                                            MemoryCoordStore)
+    from downloader_tpu.store import InMemoryObjectStore
+    from downloader_tpu.store.cache import ContentCache
+
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING)
+    plane = FleetPlane(MemoryCoordStore(), "w1", store=store)
+    cache = ContentCache(str(tmp_path / "cache"))
+    registry = JobRegistry()
+    record = registry.register("coord-1", "card")
+
+    async def origin_fill():
+        await asyncio.sleep(0)
+
+    outcome = await plane.coordinate(
+        "contentkey1", cache, origin_fill,
+        record=record, registry=registry)
+    assert outcome == LED
+    ledger = record.hops.summary()
+    # leader path: probe miss + lease acquire/release on the coord
+    # hop, the shared-tier publish on its own shared_spill hop — a
+    # peer's content materialization would land on shared_fetch, never
+    # disguised as coordination ceremony
+    assert "coord" in ledger
+    assert ledger["coord"]["bytes"] == 0
+    assert ledger["coord"]["seconds"] >= 0
+    assert "shared_spill" in ledger
+    assert "shared_fetch" not in ledger  # nothing was materialized
+
+
+def test_evaluate_without_probe_jobs_skips_reconcile_guard():
+    """probe_jobs=0 is a supported configuration: the reconciliation
+    guard is out of scope then — neither vacuously green nor a
+    hard-coded red (review r17)."""
+    profile = SoakProfile(jobs=2, probe_jobs=0)
+    specs = [JobSpec(f"np{i}", "plain", _origin()) for i in range(2)]
+    outcomes = [_outcome(spec) for spec in specs]
+    samples = [_sample(0.0, 0), _sample(1.0, 2)]
+    records = [_record(spec.job_id) for spec in specs]
+    report = evaluate(profile, outcomes, samples, _clean_world(records))
+    assert report.ok, report.summary()
+    assert all(g.name != "hop_reconcile_error" for g in report.guards)
