@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak chaos crash fleet obs origins soak soak-smoke proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded chaos crash degraded fleet obs origins soak soak-smoke proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -24,6 +24,17 @@ chaos:
 # no orphan workdirs/leases, retry counters monotone across the kill
 crash:
 	python -m pytest tests/test_crash.py tests/test_journal.py -v
+
+# degraded-world chaos suite (ISSUE 14): windowed brownout/partition/
+# flap fault kinds, the slow-call breaker policy (a latency-only store
+# brownout must open the breaker with reason "slow" and shed via
+# park-then-nack), asymmetric-partition degradation + GC stand-down,
+# split-brain fencing at every cross-worker write (a stalled leader
+# resumed mid-takeover must lose), the fleet.max_wait aging fix, and
+# the degraded soak scenario (SIGSTOP stall past the lease TTL against
+# a real 2-worker subprocess fleet)
+degraded:
+	python -m pytest tests/test_degraded.py -v
 
 # multi-worker fleet suite: coordination-store semantics, N-orchestrator
 # coalescing over MiniS3, lease takeover, coord-store chaos
@@ -110,6 +121,13 @@ bench-racing:
 # soak_rss_slope_mb_per_kjob, soak_journal_peak_bytes alongside)
 bench-soak:
 	python bench.py --soak
+
+# standalone degraded-world soak bench (one JSON line: degraded_ok =
+# every SLO guard green under the stall + brownout scenario;
+# brownout_shed_ms = brownout onset -> slow-opened breaker;
+# split_brain_stale_writes must stay 0)
+bench-degraded:
+	python bench.py --degraded
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

@@ -154,7 +154,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  reconciliation ≤10% on the probe); soak_p99_ms = worst-class p99
 #  time-to-staged; soak_rss_slope_mb_per_kjob and
 #  soak_journal_peak_bytes ride the same guards the smoke test holds.
-HARNESS_VERSION = 18
+# v19 (r18): degraded-world soak (``--degraded`` / `make
+#  bench-degraded`): the same subprocess rig under the DEGRADED
+#  profile — no SIGKILLs; instead a SIGSTOP/SIGCONT stall that
+#  overruns the (shortened) lease TTL on one worker (split-brain
+#  rehearsal for the fencing layer) plus a windowed latency-only store
+#  brownout on the other, with the slow-call breaker policy armed.
+#  degraded_ok = every SLO guard green AND the breaker opened via the
+#  slow policy inside the brownout window; brownout_shed_ms = brownout
+#  onset -> first open-breaker sample (guard <= 8000 ms);
+#  split_brain_stale_writes = staged-byte divergence count (guard 0 —
+#  a resumed stale leader must not land a byte anywhere the fleet
+#  trusts); degraded_fenced_writes rides along unguarded (nonzero only
+#  when the stall actually caught a lease holder).
+HARNESS_VERSION = 19
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2297,6 +2310,74 @@ def _bench_soak_safe() -> dict:
         return {"soak_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_degraded() -> dict:
+    """Degraded-world soak metrics (harness v19).
+
+    Runs the degraded profile of the soak rig: a SIGSTOP/SIGCONT
+    worker stall past the lease TTL plus a windowed latency-only store
+    brownout with the slow-call breaker policy armed.  The two
+    headline guards are exactly the ISSUE 14 acceptance pair:
+    ``brownout_shed_ms`` (brownout onset -> the breaker opens via the
+    SLOW policy, not the failure counter) and
+    ``split_brain_stale_writes == 0`` (staged-byte divergence — a
+    stalled-then-resumed leader must not land a stale byte).
+    """
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.soak import (SoakProfile, brownout_shed_seconds,
+                                     fenced_writes_total,
+                                     slow_opens_total)
+
+    profile = SoakProfile.degraded()
+    with tempfile.TemporaryDirectory() as tmp:
+        world = await SoakTestWorld.create(tmp, profile)
+        try:
+            report = await world.rig.run(world.workload)
+            samples = world.rig.samples
+            anchor = (world.rig.slots[0].ready_mono
+                      + profile.brownout_start_s)
+            stalls = world.rig.stalls_delivered
+            stale = len(world.rig.world.byte_mismatches
+                        if world.rig.world else [])
+        finally:
+            await world.close()
+    shed = brownout_shed_seconds(samples, anchor, "store")
+    slow_opens = slow_opens_total(samples, "store")
+    shed_ms = round(shed * 1000.0, 1) if shed is not None else None
+    out = {
+        "degraded_ok": bool(report.ok and slow_opens >= 1
+                            and shed is not None and shed <= 8.0
+                            and stale == 0),
+        "brownout_shed_ms": shed_ms,
+        "split_brain_stale_writes": stale,
+        "degraded_slow_opens": slow_opens,
+        "degraded_fenced_writes": fenced_writes_total(samples),
+        "degraded_stalls": stalls,
+        "degraded_jobs": int(report.stats.get("jobs", 0)),
+        "degraded_wall_s": report.stats.get("wall_s", 0.0),
+    }
+    if not report.ok:
+        out["degraded_failed_guards"] = [g.name
+                                         for g in report.failures()]
+    return out
+
+
+def _bench_degraded_safe() -> dict:
+    """A degraded-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_degraded())
+    except Exception as err:
+        return {
+            "degraded_bench_error": f"{type(err).__name__}: {err}"[:200]
+        }
+
+
 # Final-line headline keys, in keep-priority order (first = kept
 # longest under the size cap).  ~15 keys: the driver's 2,000-char tail
 # capture must always see the full final line (VERDICT r5 item 1);
@@ -2346,6 +2427,11 @@ HEADLINE_KEYS = [
     "soak_rss_slope_mb_per_kjob",  # r17 guard via soak_ok
     "soak_journal_peak_bytes",    # r17 guard: compaction held the line
     "soak_bench_error",           # present only on failure — visible
+    "degraded_ok",                # r18: stall+brownout SLOs + slow shed
+    "brownout_shed_ms",           # r18 guard: <= 8000 (slow-open inside
+                                  # the brownout window)
+    "split_brain_stale_writes",   # r18 guard: == 0 (fencing held)
+    "degraded_bench_error",       # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -2400,6 +2486,10 @@ def main() -> None:
         # standalone sustained-load soak run (`make bench-soak`)
         print(json.dumps(_bench_soak_safe()))
         return
+    if "--degraded" in sys.argv:
+        # standalone degraded-world soak run (`make bench-degraded`)
+        print(json.dumps(_bench_degraded_safe()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -2425,6 +2515,7 @@ def main() -> None:
         **_bench_obs_safe(),
         **_bench_racing_safe(),
         **_bench_soak_safe(),
+        **_bench_degraded_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

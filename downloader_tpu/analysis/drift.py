@@ -417,6 +417,18 @@ KNOWN_DEPENDENCIES = frozenset({
     "settle",
 })
 
+#: families exempt from the WINDOWED-drillability requirement (every
+#: other family must carry at least one async ``faults.fire`` hook so
+#: the windowed kinds — brownout latency, blackhole partitions — can
+#: inject; ``fire_sync`` cannot sleep without stalling the event
+#: loop).  Each entry names why the exemption is sound, so a new
+#: sync-only family is a finding, not a silent gap.
+WINDOWED_EXEMPT: Dict[str, str] = {
+    "disk": "synchronous preflight seam (utils/disk.py) — a blocking "
+            "brownout sleep would stall the event loop; local-disk "
+            "latency drills ride the async store family instead",
+}
+
 
 def _seam_dependency(seam: str) -> str:
     dependency = seam.split(".", 1)[0]
@@ -465,16 +477,44 @@ def _collect_seams(modules, attr_names: frozenset,
     "(drift.KNOWN_DEPENDENCIES — the retry.* config families), the "
     "family must be named in the OPERATIONS failure-model/runbook "
     "docs, and a faults.fire()/fire_sync() hook must exist for the "
-    "family so the chaos suite can actually drill the seam.")
+    "family so the chaos suite can actually drill the seam.  Families "
+    "must also be drillable by the WINDOWED kinds (brownout/partition/"
+    "flap): at least one async faults.fire() hook — a seam you cannot "
+    "brownout is a seam you cannot rehearse.  Sync-only families need "
+    "a justified entry in drift.WINDOWED_EXEMPT.")
 def check_seam_coverage(ctx: RepoContext) -> List[Finding]:
     out: List[Finding] = []
     modules = ctx.package_modules()
     retrier_seams = _collect_seams(modules, frozenset({"run"}),
                                    require_retrier=True)
-    fault_seams = _collect_seams(modules,
-                                 frozenset({"fire", "fire_sync"}),
-                                 require_retrier=False)
+    async_fault_seams = _collect_seams(modules, frozenset({"fire"}),
+                                       require_retrier=False)
+    sync_fault_seams = _collect_seams(modules, frozenset({"fire_sync"}),
+                                      require_retrier=False)
+    fault_seams = async_fault_seams + sync_fault_seams
     fault_families = {_seam_dependency(seam) for seam, _, _ in fault_seams}
+    async_families = {_seam_dependency(seam)
+                      for seam, _, _ in async_fault_seams}
+
+    # windowed drillability: a family whose only hooks are fire_sync
+    # cannot take brownout latency or a blackhole partition — `make
+    # degraded` would silently skip it.  Anchored at the family's first
+    # sync hook (the place an async hook belongs next to).
+    flagged_windowed: Set[str] = set()
+    for seam, path, line in sync_fault_seams:
+        family = _seam_dependency(seam)
+        if (family in KNOWN_DEPENDENCIES
+                and family not in async_families
+                and family not in WINDOWED_EXEMPT
+                and family not in flagged_windowed):
+            flagged_windowed.add(family)
+            out.append(Finding(
+                "seam-coverage", path, line,
+                f'dependency family "{family}" is only drillable '
+                "synchronously (fire_sync) — the windowed fault kinds "
+                "(brownout/partition/flap) cannot inject latency here; "
+                "add an async faults.fire() hook or a justified "
+                "drift.WINDOWED_EXEMPT entry"))
 
     for seam, path, line in fault_seams:
         family = _seam_dependency(seam)

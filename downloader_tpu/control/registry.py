@@ -133,7 +133,8 @@ class JobRecord:
         "stage_seconds", "_entered_mono", "_created_mono",
         "recorder", "trace_id", "span_id", "transferred", "retry",
         "worker_id", "tenant", "ttl_seconds", "deadline_mono",
-        "recovered", "hops",
+        "recovered", "hops", "fleet_fence", "fleet_fence_key",
+        "fleet_waited_s",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
@@ -210,6 +211,18 @@ class JobRecord:
         # by the stages' transfer loops; None (``obs.hop_ledger: false``)
         # makes note_hop a no-op — the bench's disabled/enabled A-B leg
         self.hops: Optional[HopLedger] = HopLedger() if hop_ledger else None
+        # fencing context (fleet/plane.py): the content-lease fence this
+        # job's origin authority derives from — stamped when the job
+        # wins a fleet lease, carried into every cross-worker write
+        # (shared-tier manifest, done marker, telemetry digest) so a
+        # resumed stale leader's writes are rejectable
+        self.fleet_fence: Optional[int] = None
+        self.fleet_fence_key: Optional[str] = None
+        # cumulative seconds this job has parked on fleet lease waits,
+        # carried ACROSS redeliveries/coordination errors so the
+        # fleet.max_wait livelock bound holds under a flapping coord
+        # store (each re-park used to reset the clock)
+        self.fleet_waited_s = 0.0
 
     @property
     def terminal(self) -> bool:
@@ -281,6 +294,7 @@ class JobRecord:
             },
             "hopLedger": (self.hops.summary()
                           if self.hops is not None and self.hops else None),
+            "fleetFence": self.fleet_fence,
         }
 
 
@@ -337,6 +351,14 @@ class JobRegistry:
                            worker_id=self.worker_id,
                            tenant=tenant, ttl_seconds=ttl_seconds,
                            hop_ledger=self.hop_ledger)
+        # a redelivery (park-then-nack leaves a FAILED terminal record
+        # behind) inherits the job's cumulative fleet lease wait, so
+        # fleet.max_wait bounds TOTAL parked time under a flapping
+        # coordination store instead of resetting on every re-park.  A
+        # DONE/CANCELLED prior is a genuine resubmission: fresh budget.
+        prior = self.get(job_id)
+        if prior is not None and prior.state in (FAILED, PARKED):
+            record.fleet_waited_s = prior.fleet_waited_s
         self._active[record.uid] = record
         self._gauge(RECEIVED, +1)
         record.event("received", priority=priority)

@@ -37,6 +37,19 @@ Failure posture (the PR 5 contract): the coordination store is a
 and every unrecoverable :class:`~.coord.CoordError` degrades the worker
 to plain uncoordinated fetching (counted on
 ``fleet_coord_errors_total``), never failing or stalling a job.
+
+**Fencing discipline** (Gray–Cheriton leases; the GC-pause split-brain):
+a leader stalled past its lease TTL (SIGSTOP, GC pause, VM migration)
+wakes believing it still leads while a peer has taken over with
+``fence + 1``.  The fence number is therefore *enforced at every
+cross-worker write*, not just allocated at takeover: the shared-tier
+manifest, the done-marker seal, and telemetry digests all carry the
+writer's fence, and a write is rejected — counted on
+``fleet_fenced_writes_total{op}`` — when a higher fence has been
+observed (lease-doc read + post-write read-back, the same best-effort
+CAS posture as the bucket store's nonce verification; damage in the
+sub-RTT window is bounded exactly like a conditional-put race).  A
+resumed stale leader must lose.
 """
 
 from __future__ import annotations
@@ -99,6 +112,10 @@ LED = "led"                     # this worker held the lease and fetched
 SHARED = "shared"               # served from the fleet shared tier
 UNCOORDINATED = "uncoordinated"  # coordination unavailable: fetch alone
 
+# bound on the per-key observed-fence memo (insertion-order eviction;
+# a key's fence re-learns from the lease doc / manifest on next touch)
+_FENCE_SEEN_MAX = 1024
+
 
 def resolve_worker_id(config) -> str:
     """Stable-for-the-process worker identity: env ``WORKER_ID``, config
@@ -110,6 +127,12 @@ def resolve_worker_id(config) -> str:
     if configured:
         return str(configured)
     return f"{socket.gethostname()}-{os.getpid()}-{os.urandom(3).hex()}"
+
+
+class _GcLeaseViewUnavailable(Exception):
+    """The GC sweep could not read the lease view (asymmetric
+    partition): the shared-tier eviction pass must stand down rather
+    than evict keys that may be under a live peer's lease."""
 
 
 class _Lease:
@@ -200,6 +223,12 @@ class FleetPlane:
         # manifest "created" stamps memoized across sweeps (immutable
         # once published; pruned to the current listing each sweep)
         self._gc_created: Dict[str, float] = {}
+        # highest lease fence OBSERVED per content key (from lease
+        # reads, takeovers, and manifest read-backs) — the local half
+        # of fencing enforcement: a write whose fence is below this is
+        # stale even when the lease doc is already gone.  Bounded
+        # (insertion-order eviction past _FENCE_SEEN_MAX).
+        self._fence_seen: Dict[str, int] = {}
         # local stats, also carried in every heartbeat payload
         self.stats: Dict[str, int] = {
             "leasesLed": 0, "leaseWaits": 0, "leaseTakeovers": 0,
@@ -209,6 +238,7 @@ class FleetPlane:
             "gcSharedEvicted": 0, "gcTombstonesCompacted": 0,
             "gcBytesReclaimed": 0,
             "telemetryPublished": 0, "gcTelemetryEvicted": 0,
+            "fencedWrites": 0,
         }
 
     # -- config ---------------------------------------------------------
@@ -300,6 +330,74 @@ class FleetPlane:
             return await factory()
         return await self.retrier.run(seam, factory, cancel=cancel,
                                       logger=self.logger)
+
+    # -- fencing --------------------------------------------------------
+    def _observe_fence(self, key: str, fence) -> None:
+        """Max-merge one observed lease fence for ``key`` (bounded memo)."""
+        try:
+            fence = int(fence)
+        except (TypeError, ValueError):
+            return
+        if fence <= 0:
+            return
+        if fence > self._fence_seen.get(key, 0):
+            self._fence_seen.pop(key, None)
+            self._fence_seen[key] = fence
+            while len(self._fence_seen) > _FENCE_SEEN_MAX:
+                self._fence_seen.pop(next(iter(self._fence_seen)))
+
+    def observed_fence(self, key: str) -> int:
+        """Highest fence this worker has seen for ``key`` (0 = none)."""
+        return self._fence_seen.get(key, 0)
+
+    def _note_fenced_write(self, op: str, key: str, fence: int,
+                           newer: int) -> None:
+        """Count one rejected stale write — the split-brain save."""
+        self.stats["fencedWrites"] += 1
+        if self.metrics is not None:
+            self.metrics.fleet_fenced_writes.labels(op=op).inc()
+        if self.logger is not None:
+            self.logger.warn("fleet: fenced off stale write",
+                             op=op, key=key[:16], fence=fence,
+                             newer=newer)
+
+    async def fence_holds(self, key: str, fence) -> bool:
+        """Is ``fence`` still the write authority for ``key``?
+
+        False once a higher fence has been observed — locally, or by a
+        fresh read of the lease doc (the cross-worker observation: a
+        resumed stale leader learns of its takeover here).  Best-effort
+        like every coordination read: a store failure degrades to the
+        local memo (fencing is defense-in-depth on top of content-hash
+        resume + manifest-last publish, not the sole correctness line).
+        """
+        try:
+            fence = int(fence)
+        except (TypeError, ValueError):
+            return True  # no fence context: nothing to enforce
+        if fence <= 0:
+            return True
+        if self.observed_fence(key) > fence:
+            return False
+        try:
+            entry = await self.coord.get(LEASES_PREFIX + key)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("fence_check", err)
+            return True  # degrade to the local memo's verdict above
+        if entry is not None:
+            doc = entry[0]
+            self._observe_fence(key, doc.get("fence"))
+            doc_fence = doc.get("fence")
+            if isinstance(doc_fence, int) and doc_fence > fence:
+                return False
+        # strictly-greater only: our own claimed fence always came from
+        # a lease we held (the leader path is the only place it is
+        # stamped), so an EQUAL number elsewhere is cross-epoch reuse
+        # after a full release — fencing the healthy later writer there
+        # drops real work to save nothing
+        return self.observed_fence(key) <= fence
 
     # -- worker registry ------------------------------------------------
     def _worker_doc(self) -> dict:
@@ -491,12 +589,22 @@ class FleetPlane:
         lease_key = LEASES_PREFIX + key
         entry = await self.coord.get(lease_key)
         if entry is None:
+            # seed ABOVE any fence this worker has ever observed for
+            # the key: release_lease deletes the doc, so a naive fresh
+            # acquire would restart at 1 and the writer would fence
+            # ITSELF off against its own memo of the previous epoch.
+            # (Cross-worker number reuse after both the doc and the
+            # manifest are gone remains possible — the same bounded
+            # best-effort window as the bucket store's conditional put;
+            # a stale writer's horizon is one job lifetime.)
+            fence = self.observed_fence(key) + 1
             token = await self.coord.put(
-                lease_key, self._lease_doc(1, trace), expect=ABSENT
+                lease_key, self._lease_doc(fence, trace), expect=ABSENT
             )
-            fence, takeover = 1, False
+            takeover = False
         else:
             doc, old_token = entry
+            self._observe_fence(key, doc.get("fence"))
             # a lease owned by OUR id that we do not hold is orphaned by
             # definition (its renewer died with the previous process —
             # stable worker_ids survive restarts): reclaim immediately
@@ -507,13 +615,18 @@ class FleetPlane:
             if not own_orphan and (
                     float(doc.get("expiresAt", 0)) + grace >= time.time()):
                 return None  # live (or skew-ambiguous) leader
-            fence = int(doc.get("fence", 0)) + 1
+            # max against the local memo too: the doc's fence is the
+            # floor, but this worker may have observed a newer epoch
+            # (e.g. a manifest read-back) the doc never carried
+            fence = max(int(doc.get("fence", 0)),
+                        self.observed_fence(key)) + 1
             token = await self.coord.put(
                 lease_key, self._lease_doc(fence, trace), expect=old_token
             )
             takeover = True
         if token is None:
             return None  # lost the race: someone else just took it
+        self._observe_fence(key, fence)
         lease = _Lease(key, token, fence, trace=trace)
         self._held[key] = lease
         lease.renewer = asyncio.create_task(
@@ -623,7 +736,8 @@ class FleetPlane:
         return posixpath.join(self.shared_prefix + key, MANIFEST_NAME)
 
     async def publish_entry(self, key: str, cache,
-                            trace: Optional[dict] = None) -> bool:
+                            trace: Optional[dict] = None,
+                            fence: Optional[int] = None) -> bool:
         """Spill the local cache entry for ``key`` to the shared tier.
 
         Payload objects first, ``manifest.json`` LAST — the manifest is
@@ -631,17 +745,34 @@ class FleetPlane:
         an existing manifest means a peer (or an earlier attempt)
         already published this content.  Best-effort: failures are
         logged and counted, never raised into the job.
+
+        ``fence`` is the writer's lease fence.  The spill is FENCED:
+        rejected before a single payload byte moves when a higher fence
+        has been observed (a peer took over this lease while we were
+        stalled — our entry is presumptively stale), stamped into the
+        manifest, and read-back-verified after the publish so a
+        concurrent newer writer's manifest is never mistaken for ours.
         """
         if self.store is None:
             return False
         try:
-            await self.store.get_object(
+            raw = await self.store.get_object(
                 self.shared_bucket, self._shared_name(key))
+            try:
+                self._observe_fence(key, _json_load(raw).get("fence"))
+            except (ValueError, KeyError, TypeError):
+                pass
             return True  # already published
         except ObjectNotFound:
             pass
         except Exception as err:
             self._note_coord_error("shared_probe", err)
+            return False
+        if fence is not None and not await self.fence_holds(key, fence):
+            # a stale leader must lose BEFORE staging bytes: zero
+            # payload objects land, not just a suppressed manifest
+            self._note_fenced_write("shared_manifest", key, int(fence),
+                                    self.observed_fence(key))
             return False
         try:
             async with cache.pinned(key):
@@ -663,6 +794,11 @@ class FleetPlane:
                     "worker": self.worker_id,
                     "created": round(time.time(), 3),
                 }
+                if fence is not None:
+                    # the writer's authority, carried on the document
+                    # so any reader (and the read-back below) can
+                    # order competing publishes
+                    manifest["fence"] = int(fence)
                 if trace:
                     # the filling job's traceparent: peers materializing
                     # this entry can name the exact origin fetch (trace
@@ -672,6 +808,25 @@ class FleetPlane:
                     self.shared_bucket, self._shared_name(key),
                     _json_bytes(manifest),
                 )
+                if fence is not None:
+                    # CAS-style read-verify (the nonce read-back
+                    # posture): if a NEWER-fenced manifest shows on the
+                    # read-back, our publish lost the race — count the
+                    # save and report failure so nobody trusts our spill
+                    raw = await self.store.get_object(
+                        self.shared_bucket, self._shared_name(key))
+                    try:
+                        back = _json_load(raw)
+                    except ValueError:
+                        back = {}
+                    back_fence = back.get("fence")
+                    self._observe_fence(key, back_fence)
+                    if (isinstance(back_fence, int)
+                            and back_fence > int(fence)):
+                        self._note_fenced_write(
+                            "shared_manifest", key, int(fence),
+                            back_fence)
+                        return False
         except Exception as err:
             self._note_coord_error("shared_publish", err)
             return False
@@ -713,6 +868,9 @@ class FleetPlane:
                 self.logger.warn("fleet: corrupt shared-tier manifest",
                                  key=key[:16])
             return False
+        # remember the publisher's fence: a later stale write attempt
+        # for this key is rejectable from the local memo alone
+        self._observe_fence(key, manifest.get("fence"))
         if await cache.lookup(key) is not None:
             return True  # already local (a concurrent fill won)
         staging = os.path.join(
@@ -768,7 +926,7 @@ class FleetPlane:
         :data:`DIGEST_EVENT_LIMIT` (events are already small, truncated
         dicts), so a digest stays a few KB."""
         hops = getattr(record, "hops", None)
-        return {
+        digest = {
             "traceId": record.trace_id,
             "spanId": record.span_id,
             "jobId": record.job_id,
@@ -782,6 +940,13 @@ class FleetPlane:
             "events": record.recorder.tail(DIGEST_EVENT_LIMIT),
             "settledAt": round(time.time(), 3),
         }
+        fence = getattr(record, "fleet_fence", None)
+        if fence:
+            # the lease fence this job's authority derived from: a
+            # stale leader's late digest must not clobber the digest
+            # the real (higher-fenced) settle already published
+            digest["fence"] = int(fence)
+        return digest
 
     async def publish_telemetry(self, record) -> bool:
         """Publish a settled job's timeline digest to the coordination
@@ -800,9 +965,20 @@ class FleetPlane:
             return False
         key = (f"{TELEMETRY_PREFIX}{trace_id}/{self.worker_id}/"
                f"{record.job_id}")
+        fence = getattr(record, "fleet_fence", None)
+        content_key = getattr(record, "fleet_fence_key", None)
+        if fence and content_key and not await self.fence_holds(
+                content_key, fence):
+            # a stale leader's settle: its timeline describes work a
+            # higher-fenced peer superseded — reject rather than
+            # present split-brain observability as truth
+            self._note_fenced_write("telemetry", content_key,
+                                    int(fence),
+                                    self.observed_fence(content_key))
+            return False
         try:
-            # unconditional: this worker owns its own digest slot, and a
-            # redelivered job's later settle should win
+            # unconditional otherwise: this worker owns its own digest
+            # slot, and a redelivered job's later settle should win
             await self.coord.put(key, self._digest(record), expect=ANY)
         except asyncio.CancelledError:
             raise
@@ -906,14 +1082,21 @@ class FleetPlane:
                 # re-published by some worker right now: never reclaim
                 # them mid-flight (the torn-spill heuristic especially —
                 # a peer's slow multi-GB spill is manifest-less for its
-                # whole upload).  Lease trouble degrades to "skip none":
-                # the age/size bounds still apply next sweep.
-                leased: set = set()
+                # whole upload).  Lease trouble — e.g. an asymmetric
+                # partition where shared-tier reads work but the
+                # coordination prefix does not — means we CANNOT know
+                # what peers hold: skip this sweep's eviction pass
+                # entirely rather than treat every key as unleased and
+                # evict a live peer's in-flight spill.  Garbage waits
+                # one interval; destroyed peer work does not come back.
                 try:
                     leased = {doc.get("key") for doc in await self.leases()
                               if not doc.get("expired")}
-                except Exception:
-                    pass
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    self._note_coord_error("gc_lease_view", err)
+                    raise _GcLeaseViewUnavailable from err
                 now = time.time()
                 # manifest "created" stamps are immutable once published:
                 # remember them across sweeps so a steady-state sweep is
@@ -983,6 +1166,8 @@ class FleetPlane:
                                          key=key[:16], bytes=reclaimed)
             except asyncio.CancelledError:
                 raise
+            except _GcLeaseViewUnavailable:
+                pass  # noted as gc_lease_view; eviction waits a sweep
             except Exception as err:
                 self._note_coord_error("gc_shared", err)
         # per-job trace digests: every settled job writes one, so without
@@ -1084,7 +1269,15 @@ class FleetPlane:
         fan-in deployments size ``max_concurrent_jobs``/backlog for it.
         """
         log = logger or self.logger
-        deadline = time.monotonic() + self.max_wait
+        # the livelock bound is a per-JOB budget, not per-attempt: a
+        # flapping coordination store used to re-park every redelivery
+        # with a fresh max_wait, so the bound never bound.  The record
+        # carries the cumulative parked time across coordination errors
+        # and redeliveries; an exhausted budget skips parking entirely.
+        already_waited = float(getattr(record, "fleet_waited_s", 0.0)
+                               or 0.0)
+        deadline = time.monotonic() + max(
+            self.max_wait - already_waited, 0.0)
         # coordination attribution (the soak's hop-ledger
         # reconciliation flushed this out): lease acquire/release, the
         # shared-entry probe, and shared-tier transfers are real
@@ -1129,6 +1322,7 @@ class FleetPlane:
                           billed, bill):
         parked = False
         waited = False
+        wait_started = None  # first poll-sleep: the aging clock starts
         try:
             while True:
                 try:
@@ -1185,6 +1379,7 @@ class FleetPlane:
                             entry = None  # wait event still emits bare
                         if entry is not None:
                             doc = entry[0]
+                            self._observe_fence(key, doc.get("fence"))
                             leader_fields["leaderWorker"] = doc.get(
                                 "owner")
                             remote = parse_traceparent(
@@ -1219,11 +1414,21 @@ class FleetPlane:
                         record.event("fleet", outcome="wait_timeout",
                                      key=key[:16])
                     return UNCOORDINATED
+                if wait_started is None:
+                    wait_started = time.monotonic()
                 if cancel is not None:
                     await cancel.guard(asyncio.sleep(self.poll_interval))
                 else:
                     await asyncio.sleep(self.poll_interval)
         finally:
+            if record is not None and wait_started is not None:
+                # age the per-job wait budget on EVERY exit — lease won,
+                # degraded to uncoordinated, timed out, cancelled — so
+                # the next attempt (after a flap or redelivery) resumes
+                # the countdown instead of restarting it
+                record.fleet_waited_s = (
+                    float(getattr(record, "fleet_waited_s", 0.0) or 0.0)
+                    + (time.monotonic() - wait_started))
             if parked:
                 try:
                     if slot is not None:
@@ -1243,11 +1448,17 @@ class FleetPlane:
                                         stage=record.stage)
         # -- leader path --------------------------------------------------
         if record is not None:
+            # the fence this job's write authority derives from: rides
+            # the record into the shared-tier spill, the done-marker
+            # seal (stages/upload.py), and the telemetry digest
+            record.fleet_fence = lease.fence
+            record.fleet_fence_key = key
             record.event("fleet", outcome="lead", key=key[:16],
                          fence=lease.fence)
         try:
             await origin_fill()
-            await billed(self.publish_entry(key, cache, trace=trace),
+            await billed(self.publish_entry(key, cache, trace=trace,
+                                            fence=lease.fence),
                          "shared_spill")
         finally:
             await billed(self.release_lease(key))

@@ -83,6 +83,11 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
         # observable here as well as on /metrics.
         breakers = getattr(orchestrator, "breakers", None)
         states = breakers.states() if breakers is not None else {}
+        # open-reason attribution (failure vs slow): a slow-opened
+        # breaker means the dependency is up but browned out — wait it
+        # out and shed; a failure-opened one means check it is up at all
+        reasons = (breakers.open_reasons()
+                   if breakers is not None else {})
         # readiness keys on the ADMISSION dependencies only (store +
         # publish): an open per-job breaker someone opted into must not
         # pull the whole replica out of rotation
@@ -90,15 +95,17 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
             getattr(orchestrator, "admission_dependencies", None))
             if breakers is not None else [])
         if blocked:
-            return web.json_response(
-                {"status": "breaker_open", "breakers": states,
-                 "blocked": blocked,
-                 "active": len(orchestrator.active_jobs)},
-                status=503,
-            )
+            body = {"status": "breaker_open", "breakers": states,
+                    "blocked": blocked,
+                    "active": len(orchestrator.active_jobs)}
+            if reasons:
+                body["breakerReasons"] = reasons
+            return web.json_response(body, status=503)
         payload = {"status": "ready",
                    "active": len(orchestrator.active_jobs),
                    "breakers": states}
+        if reasons:
+            payload["breakerReasons"] = reasons
         # overload controller (control/overload.py): a saturated worker
         # is still READY — HIGH/NORMAL flow, only BULK is shed — but the
         # posture is surfaced so routing layers can prefer idle peers

@@ -749,10 +749,10 @@ class Orchestrator:
     async def _staged_probe_loop(self) -> None:
         while True:
             await asyncio.sleep(self._staged_probe_interval)
-            if not self._recovered:
-                continue
             try:
-                await self._probe_recovered_staged()
+                if self._recovered:
+                    await self._probe_recovered_staged()
+                await self._sweep_peer_staged_workdirs()
             except asyncio.CancelledError:
                 raise
             except Exception as err:
@@ -814,6 +814,50 @@ class Orchestrator:
                 jobId=record.job_id)
             retired += 1
         return retired
+
+    async def _sweep_peer_staged_workdirs(self) -> int:
+        """Remove resumable workdirs nobody is coming back for: the
+        job's delivery was park-then-NACKED away (transient failure,
+        open breaker, overload shed) and a PEER worker completed it.
+
+        A nacked job's workdir is deliberately kept so a redelivery to
+        US can resume its ``.partial``/piece state — but when the
+        redelivery lands on a peer (the broker owes it to *a* consumer,
+        not to this one) and that peer seals the done marker, no
+        resume is ever owed here: any late redelivery acks at the
+        idempotency probe without touching these bytes.  Flushed out
+        by the degraded soak: a breaker-shed job migrating to the
+        healthy worker left its partial workdir behind forever.
+        """
+        swept = 0
+        for record in self.registry.jobs(control.FAILED):
+            latest = self.registry.get(record.job_id)
+            if latest is not record or not latest.terminal:
+                continue  # a live redelivery owns this job id right now
+            workdir = job_download_dir(self.config, record.job_id)
+            if not os.path.isdir(workdir):
+                continue
+            try:
+                await self.store.get_object(
+                    STAGING_BUCKET, done_marker_name(record.job_id))
+            except ObjectNotFound:
+                continue  # not staged anywhere yet: keep the resume state
+            except Exception:
+                continue  # store trouble: decide nothing this pass
+            # re-check after the await: a redelivery may have arrived
+            # and re-registered the job while the marker read yielded
+            if self.registry.get(record.job_id) is not record:
+                continue
+            await self._remove_workdir(record.job_id, self.logger)
+            record.event("workdir_swept", why="staged_elsewhere")
+            if self.metrics is not None:
+                self.metrics.jobs_recovered.labels(
+                    outcome="staged_elsewhere").inc()
+            self.logger.info(
+                "swept workdir of a job a peer already staged",
+                jobId=record.job_id)
+            swept += 1
+        return swept
 
     # -- control plane: intake steering --------------------------------
     async def pause_intake(self) -> None:

@@ -34,6 +34,21 @@ shared vocabulary and machinery to ride them out:
   without operator action.  State rides ``breaker_state{dependency}``
   (0=closed, 1=open, 2=half-open) and
   ``breaker_transitions_total{dependency,to_state}``.
+- **Slow-call policy** ("slow is the new down") — a browned-out
+  dependency that answers every call successfully but slowly never
+  trips a failure-count breaker, and by the time timeouts fire the
+  whole pipeline is wedged behind it.  With
+  ``breakers.<dep>.slow_threshold_ms`` set, every *answered* attempt
+  (success or transient failure) is classified fast/slow into a
+  bounded ring of the last ``slow_window`` calls; once at least
+  ``slow_min_calls`` are in the ring and the slow fraction reaches
+  ``slow_ratio``, the breaker opens with ``open_reason = "slow"`` —
+  the same park-not-fail shedding as a failure-opened breaker, before
+  the timeout cascade.  A half-open probe that answers slowly re-opens
+  (the dependency is back, but not usable).  Slow calls count on
+  ``dependency_slow_total{dependency}``; every open is attributed on
+  ``breaker_opened_total{dependency,reason=failure|slow}`` and the
+  reason rides ``/readyz``.
 
 Seams are dotted names (``store.put``, ``http.fetch``,
 ``tracker.announce``); the dependency — the retry-policy and breaker
@@ -46,6 +61,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
@@ -228,6 +244,14 @@ _STATE_GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
 
 DEFAULT_BREAKER_THRESHOLD = 5
 DEFAULT_BREAKER_RESET = 30.0
+# slow-call policy defaults (slow_threshold 0 = policy off)
+DEFAULT_SLOW_RATIO = 0.5
+DEFAULT_SLOW_WINDOW = 16
+DEFAULT_SLOW_MIN_CALLS = 8
+
+# breaker open reasons (``breaker_opened_total{reason}`` / readyz)
+OPEN_FAILURE = "failure"
+OPEN_SLOW = "slow"
 
 
 class CircuitBreaker:
@@ -238,15 +262,28 @@ class CircuitBreaker:
     admits exactly one half-open probe.  Probe success closes the
     breaker; probe failure re-opens it (fresh reset window).  Only
     transient failures should be recorded — a 404 is not an outage.
+
+    With ``slow_threshold`` > 0 the breaker also watches latency: each
+    answered attempt lands fast/slow in a bounded ring, and a sustained
+    slow fraction (>= ``slow_ratio`` over >= ``slow_min_calls`` of the
+    last ``slow_window`` answers) opens the breaker with
+    ``open_reason = "slow"`` even though every call succeeded — the
+    brownout shape failure counting is blind to.
     """
 
     __slots__ = ("dependency", "threshold", "reset", "metrics", "logger",
                  "state", "failures", "_opened_mono", "_probe_inflight",
-                 "transitions")
+                 "transitions", "slow_threshold", "slow_ratio",
+                 "slow_window", "slow_min_calls", "_slow_ring",
+                 "open_reason")
 
     def __init__(self, dependency: str,
                  threshold: int = DEFAULT_BREAKER_THRESHOLD,
                  reset: float = DEFAULT_BREAKER_RESET,
+                 slow_threshold: float = 0.0,
+                 slow_ratio: float = DEFAULT_SLOW_RATIO,
+                 slow_window: int = DEFAULT_SLOW_WINDOW,
+                 slow_min_calls: int = DEFAULT_SLOW_MIN_CALLS,
                  metrics=None, logger=None):
         if threshold < 1:
             raise ValueError(
@@ -257,9 +294,27 @@ class CircuitBreaker:
             raise ValueError(
                 f"breakers.{dependency}.reset must be > 0, got {reset}"
             )
+        if slow_threshold < 0:
+            raise ValueError(
+                f"breakers.{dependency}.slow_threshold_ms must be >= 0"
+            )
+        if not 0.0 < slow_ratio <= 1.0:
+            raise ValueError(
+                f"breakers.{dependency}.slow_ratio must be in (0, 1], "
+                f"got {slow_ratio}"
+            )
+        if slow_window < 1 or slow_min_calls < 1:
+            raise ValueError(
+                f"breakers.{dependency}.slow_window/slow_min_calls "
+                "must be >= 1"
+            )
         self.dependency = dependency
         self.threshold = threshold
         self.reset = reset
+        self.slow_threshold = float(slow_threshold)
+        self.slow_ratio = float(slow_ratio)
+        self.slow_window = int(slow_window)
+        self.slow_min_calls = min(int(slow_min_calls), int(slow_window))
         self.metrics = metrics
         self.logger = logger
         self.state = CLOSED
@@ -267,6 +322,11 @@ class CircuitBreaker:
         self._opened_mono = 0.0
         self._probe_inflight = False
         self.transitions = 0
+        # fast/slow verdicts of the last slow_window ANSWERED attempts
+        self._slow_ring: "deque[bool]" = deque(maxlen=self.slow_window)
+        # why the breaker last opened ("failure" | "slow"); None while
+        # it has never opened or has closed again
+        self.open_reason: Optional[str] = None
         if metrics is not None:
             metrics.breaker_state.labels(dependency=dependency).set(0)
 
@@ -285,7 +345,38 @@ class CircuitBreaker:
         if self.logger is not None:
             self.logger.warn("circuit breaker transition",
                              dependency=self.dependency, state=state,
-                             failures=self.failures)
+                             failures=self.failures,
+                             reason=self.open_reason)
+
+    def _open(self, reason: str) -> None:
+        """Open with attribution: the triage path for a slow-opened
+        breaker (shed + wait out the brownout) differs from a
+        failure-opened one (check the dependency is up at all)."""
+        self.open_reason = reason
+        self._opened_mono = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.breaker_opened.labels(
+                dependency=self.dependency, reason=reason
+            ).inc()
+        self._move(OPEN)
+
+    def note_latency(self, elapsed: Optional[float]) -> bool:
+        """Land one answered attempt's latency in the slow ring;
+        returns whether it was slow.  No-op when the policy is off."""
+        if self.slow_threshold <= 0 or elapsed is None:
+            return False
+        slow = elapsed >= self.slow_threshold
+        self._slow_ring.append(slow)
+        if slow and self.metrics is not None:
+            self.metrics.dependency_slow.labels(
+                dependency=self.dependency
+            ).inc()
+        return slow
+
+    def _slow_trip_due(self) -> bool:
+        ring = self._slow_ring
+        return (len(ring) >= self.slow_min_calls
+                and sum(ring) / len(ring) >= self.slow_ratio)
 
     def retry_after(self) -> float:
         """Seconds until the next half-open probe window (0 = now)."""
@@ -320,23 +411,51 @@ class CircuitBreaker:
         caller can probe — otherwise the breaker wedges half-open."""
         self._probe_inflight = False
 
-    def record_success(self) -> None:
-        self.failures = 0
+    def record_success(self, elapsed: Optional[float] = None) -> None:
+        slow = self.note_latency(elapsed)
         self._probe_inflight = False
+        if slow and self.state != CLOSED:
+            # a slow answer while not closed: the half-open probe came
+            # back without the dependency being usable (re-open, fresh
+            # reset window), or an in-flight slow success landed after
+            # the open — either way it must not close the breaker
+            self._slow_ring.clear()
+            if self.state == HALF_OPEN:
+                self._open(OPEN_SLOW)
+            return
+        self.failures = 0
         if self.state != CLOSED:
+            self.open_reason = None
+            self._slow_ring.clear()
             self._move(CLOSED)
+            return
+        if self._slow_trip_due():
+            # every call "succeeds" and the failure counter never moves,
+            # yet the dependency is browned out: open on the slow ratio
+            # (ring cleared so the post-reset probe is judged fresh)
+            self._slow_ring.clear()
+            self._open(OPEN_SLOW)
 
-    def record_failure(self) -> None:
+    def record_failure(self, elapsed: Optional[float] = None) -> None:
+        self.note_latency(elapsed)
         self._probe_inflight = False
         if self.state == HALF_OPEN:
-            # failed probe: back to open, fresh reset window
-            self._opened_mono = time.monotonic()
-            self._move(OPEN)
+            # failed probe: back to open, fresh reset window — and
+            # RE-attributed: a probe that ERRORED means the dependency
+            # is down now, even if the original open was slow-call (a
+            # brownout hardening into an outage must steer operators to
+            # the failure runbook, not "wait it out")
+            self._open(OPEN_FAILURE)
             return
         self.failures += 1
-        if self.state == CLOSED and self.failures >= self.threshold:
-            self._opened_mono = time.monotonic()
-            self._move(OPEN)
+        if self.state == CLOSED:
+            if self.failures >= self.threshold:
+                self._open(OPEN_FAILURE)
+            elif self._slow_trip_due():
+                # slow transient failures count toward the brownout
+                # verdict too (a timing-out store answers *eventually*)
+                self._slow_ring.clear()
+                self._open(OPEN_SLOW)
 
 
 # dependencies that are per-JOB concerns, not shared infrastructure: a
@@ -394,6 +513,14 @@ class BreakerBoard:
                 threshold=int(knob("threshold",
                                    DEFAULT_BREAKER_THRESHOLD)),
                 reset=float(knob("reset", DEFAULT_BREAKER_RESET)),
+                # slow-call policy (ms in config, seconds inside): 0
+                # keeps the exact failure-count-only behavior
+                slow_threshold=float(
+                    knob("slow_threshold_ms", 0.0)) / 1000.0,
+                slow_ratio=float(knob("slow_ratio", DEFAULT_SLOW_RATIO)),
+                slow_window=int(knob("slow_window", DEFAULT_SLOW_WINDOW)),
+                slow_min_calls=int(knob("slow_min_calls",
+                                        DEFAULT_SLOW_MIN_CALLS)),
                 metrics=self.metrics, logger=self.logger,
             )
             self._breakers[dependency] = breaker
@@ -402,6 +529,14 @@ class BreakerBoard:
     def states(self) -> Dict[str, str]:
         """dependency -> state, for ``/readyz`` and the admin API."""
         return {dep: b.state for dep, b in sorted(self._breakers.items())}
+
+    def open_reasons(self) -> Dict[str, str]:
+        """dependency -> why its breaker last opened (``failure`` |
+        ``slow``), for every breaker not currently closed — the triage
+        attribution ``/readyz`` carries beside the states."""
+        return {dep: b.open_reason
+                for dep, b in sorted(self._breakers.items())
+                if b.state != CLOSED and b.open_reason}
 
     def blocking_dependencies(
         self, dependencies: Optional[Iterable[str]] = None
@@ -543,7 +678,7 @@ class Retrier:
                         breaker.release_probe()
                     raise tag_fault(err, fault, seam)
                 if breaker is not None:
-                    breaker.record_failure()
+                    breaker.record_failure(elapsed)
                 if attempt >= policy.attempts:
                     raise tag_fault(err, TRANSIENT, seam)
                 delay = min(policy.cap,
@@ -574,10 +709,13 @@ class Retrier:
                 else:
                     await asyncio.sleep(delay)
             else:
-                self._observe(dependency, seam, "ok",
-                              time.monotonic() - attempt_started)
+                elapsed = time.monotonic() - attempt_started
+                self._observe(dependency, seam, "ok", elapsed)
                 if breaker is not None:
-                    breaker.record_success()
+                    # elapsed feeds the slow-call ring: a browned-out
+                    # dependency's all-successes-but-slow train opens
+                    # the breaker with reason "slow"
+                    breaker.record_success(elapsed)
                 if record is not None:
                     record.retry = None
                 return result

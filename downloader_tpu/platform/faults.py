@@ -37,11 +37,40 @@ announce, disk preflight — carry injection hooks driven by a declarative
   fresh rule counters, so the same plan does not re-kill unless its
   ``after`` is reached again
 
-Everything is deterministic — activation is by *call count* per rule,
-no randomness — so a chaos test (tests/test_faults.py, ``make chaos``)
-asserts exact retry/breaker sequences.  When no plan is installed the
-seams pay one module-level ``None`` check (:func:`enabled`), nothing
-else.
+**Windowed network-degradation kinds** (the degraded-world chaos plane,
+``make degraded``).  Per-call counts cannot express "the store is slow
+for ten seconds" or "the coordination store flaps" — the failure modes
+that defeat count-based breakers in production — so three kinds are
+scoped by *wall-clock window* instead: active while ``start_s <=
+(now - install time) < start_s + window_s`` (``window_s: 0`` = open-
+ended).  ``after``/``count`` still apply to matching calls inside the
+window:
+
+- ``brownout``  — add latency to every matching call, then let it
+  through: ``latency_ms`` base plus a deterministic ``jitter_ms``
+  spread (a fixed sample sequence, no RNG — reruns see identical
+  latency trains).  The call SUCCEEDS slowly: exactly the
+  "slow is the new down" shape failure-count breakers never see and
+  the slow-call policy (platform/errors.py) exists for.
+- ``partition`` — refuse the whole seam family for the window: raise
+  an :class:`InjectedFault` per call, or black-hole it
+  (``blackhole: true`` — block until cancelled).  ``mode``
+  (``all`` | ``writes`` | ``reads``) makes it asymmetric: the classic
+  degraded coord store that answers reads while conditional puts
+  time out is ``mode: writes``.
+- ``flap``      — a periodic partition: partitioned for the first
+  ``duty`` fraction of every ``period_s`` cycle, healthy for the
+  rest.  Same ``mode``/``blackhole`` knobs.  The waiter-livelock
+  regression (fleet.max_wait aging) drills with exactly this kind.
+
+Count-scoped kinds stay fully deterministic — activation is by *call
+count* per rule, no randomness — so a chaos test
+(tests/test_faults.py, ``make chaos``) asserts exact retry/breaker
+sequences; windowed kinds are deterministic *given the clock* (phase
+helpers :meth:`FaultRule.window_active` / :meth:`FaultRule.flap_on`
+are pure functions of elapsed time, unit-testable without sleeping).
+When no plan is installed the seams pay one module-level ``None``
+check (:func:`enabled`), nothing else.
 
 The injector is process-global (:func:`install` / :func:`uninstall`):
 the seams live in stages, stores, and the tracker, and threading a
@@ -66,7 +95,31 @@ from .errors import FAULT_CLASSES, TRANSIENT
 
 _ENV_PLAN = "FAULT_PLAN"
 
-KINDS = ("error", "delay", "partial", "hang", "crash")
+KINDS = ("error", "delay", "partial", "hang", "crash",
+         "brownout", "partition", "flap")
+#: kinds scoped by wall-clock window (anchored at injector install)
+WINDOWED_KINDS = frozenset({"brownout", "partition", "flap"})
+#: partition/flap asymmetry: which side of the dependency is degraded
+MODES = ("all", "writes", "reads")
+
+#: seam ops (the last dotted component) that mutate shared state —
+#: what an asymmetric ``mode: writes`` partition refuses while reads
+#: pass.  ``bucket`` creates, ``announce`` mutates tracker state.
+_WRITE_OPS = frozenset({"put", "delete", "remove", "bucket", "write",
+                        "publish", "spill", "announce", "ack", "nack"})
+
+#: brownout jitter: a fixed sample sequence standing in for a latency
+#: distribution — deterministic across reruns (indexed by per-rule
+#: fire count), spread roughly uniform over [0, 1)
+_JITTER_SEQ = (0.00, 0.63, 0.21, 0.87, 0.44, 0.95, 0.10, 0.71,
+               0.33, 0.52, 0.79, 0.05)
+
+
+def seam_is_write(seam: str) -> bool:
+    """``coord.put`` -> True, ``coord.get`` -> False: the asymmetric-
+    partition classification (reads-ok/writes-failing is the classic
+    degraded object store)."""
+    return seam.rsplit(".", 1)[-1] in _WRITE_OPS
 
 
 def _crash_now(seam: str) -> None:
@@ -109,6 +162,15 @@ class FaultRule:
     after: int = 0
     fault: str = TRANSIENT
     delay_s: float = 0.05
+    # -- windowed kinds (brownout | partition | flap) only --------------
+    start_s: float = 0.0      # window opens this long after install
+    window_s: float = 0.0     # window length (0 = open-ended)
+    latency_ms: float = 250.0  # brownout base added latency
+    jitter_ms: float = 0.0     # brownout deterministic latency spread
+    mode: str = "all"          # partition/flap asymmetry (all|writes|reads)
+    blackhole: bool = False    # partition/flap: hang instead of raising
+    period_s: float = 2.0      # flap cycle length
+    duty: float = 0.5          # flap: partitioned fraction of each cycle
     # runtime counters (not config)
     calls: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
@@ -125,23 +187,78 @@ class FaultRule:
             )
         if self.after < 0 or (self.count is not None and self.count < 0):
             raise ValueError("fault rule after/count must be >= 0")
+        if self.mode not in MODES:
+            raise ValueError(
+                f"fault rule mode must be one of {MODES}, got {self.mode!r}"
+            )
+        if self.start_s < 0 or self.window_s < 0:
+            raise ValueError("fault rule start_s/window_s must be >= 0")
+        if self.latency_ms < 0 or self.jitter_ms < 0:
+            raise ValueError(
+                "fault rule latency_ms/jitter_ms must be >= 0")
+        if self.kind == "flap" and (
+                self.period_s <= 0 or not 0.0 < self.duty <= 1.0):
+            raise ValueError(
+                "flap rule needs period_s > 0 and 0 < duty <= 1")
 
     @classmethod
     def from_dict(cls, raw: dict) -> "FaultRule":
         unknown = set(raw) - {"seam", "kind", "match", "count", "after",
-                              "fault", "delay_s"}
+                              "fault", "delay_s", "start_s", "window_s",
+                              "latency_ms", "jitter_ms", "mode",
+                              "blackhole", "period_s", "duty"}
         if unknown:
             raise ValueError(f"unknown fault rule keys: {sorted(unknown)}")
         if "seam" not in raw:
             raise ValueError("fault rule needs a 'seam'")
         return cls(**raw)
 
-    def applies(self, seam: str, key: str) -> bool:
-        """Match + count bookkeeping; True when this call is affected."""
+    # -- windowed phase helpers (pure functions of elapsed time) --------
+    def window_active(self, elapsed: float) -> bool:
+        """Is the wall-clock window open ``elapsed`` seconds after
+        install?  (``window_s: 0`` = open-ended once ``start_s`` passes.)"""
+        if elapsed < self.start_s:
+            return False
+        if self.window_s <= 0:
+            return True
+        return elapsed < self.start_s + self.window_s
+
+    def flap_on(self, elapsed: float) -> bool:
+        """Is a ``flap`` rule in its partitioned phase at ``elapsed``?
+        Each ``period_s`` cycle starts partitioned for ``duty`` of it."""
+        phase = (elapsed - self.start_s) % self.period_s
+        return phase < self.period_s * self.duty
+
+    def mode_covers(self, seam: str) -> bool:
+        """Does this rule's asymmetry (``mode``) include ``seam``?"""
+        if self.mode == "all":
+            return True
+        is_write = seam_is_write(seam)
+        return is_write if self.mode == "writes" else not is_write
+
+    def brownout_delay_s(self) -> float:
+        """The next deterministic brownout latency sample (seconds):
+        ``latency_ms`` plus the fire-count-indexed jitter sample."""
+        jitter = self.jitter_ms * _JITTER_SEQ[self.fired % len(_JITTER_SEQ)]
+        return (self.latency_ms + jitter) / 1000.0
+
+    def applies(self, seam: str, key: str,
+                elapsed: Optional[float] = None) -> bool:
+        """Match + window + count bookkeeping; True when this call is
+        affected.  ``elapsed`` (seconds since injector install) gates
+        the windowed kinds; calls outside the window are not counted
+        against ``after``/``count``."""
         if not fnmatch.fnmatch(seam, self.seam):
             return False
         if self.match and self.match not in key:
             return False
+        if self.kind in WINDOWED_KINDS:
+            if not self.mode_covers(seam):
+                return False
+            if elapsed is None or not self.window_active(elapsed):
+                return False
+            if self.kind == "flap" and not self.flap_on(elapsed):
+                return False
         n = self.calls
         self.calls += 1
         if n < self.after:
@@ -162,6 +279,10 @@ class FaultInjector:
         # bench measures "dependency healthy -> first completed job" from
         # this moment
         self.last_fired_mono: Optional[float] = None
+        # the windowed kinds' wall-clock anchor; install() re-stamps it
+        # so a plan built early and installed late still means "window
+        # opens start_s after the drill began"
+        self.installed_mono = time.monotonic()
 
     @classmethod
     def from_config(cls, config, logger=None) -> "Optional[FaultInjector]":
@@ -192,12 +313,21 @@ class FaultInjector:
 
     async def fire(self, seam: str, key: str = "") -> None:
         """Apply the plan to one seam call (raise / delay / hang)."""
+        elapsed = time.monotonic() - self.installed_mono
         for rule in self.rules:
-            if not rule.applies(seam, key):
+            if not rule.applies(seam, key, elapsed):
                 continue
             self._note_fired(rule)
             if rule.kind == "crash":
                 _crash_now(seam)
+            if rule.kind == "brownout":
+                # the call SUCCEEDS, slowly: sample the deterministic
+                # latency train, sleep, let it through (later rules —
+                # e.g. a stacked error — still apply)
+                await asyncio.sleep(rule.brownout_delay_s())
+                continue
+            if rule.kind in ("partition", "flap") and rule.blackhole:
+                await asyncio.Event().wait()  # until cancelled
             if rule.kind == "delay":
                 await asyncio.sleep(rule.delay_s)
                 continue  # delayed, not failed: later rules still apply
@@ -210,14 +340,22 @@ class FaultInjector:
             raise InjectedFault(seam, rule.kind, rule.fault)
 
     def fire_sync(self, seam: str, key: str = "") -> None:
-        """Synchronous seams (disk preflight) support ``error`` and
-        ``crash`` only — a blocking sleep would stall the event loop."""
+        """Synchronous seams (disk preflight) support ``error``,
+        ``crash``, and the refusing (non-blackhole) side of
+        ``partition``/``flap`` — a blocking sleep would stall the event
+        loop, so ``brownout`` latency never injects here (the drift
+        rule's windowed-coverage exemption list names such families)."""
+        elapsed = time.monotonic() - self.installed_mono
         for rule in self.rules:
-            if not rule.applies(seam, key):
+            if not rule.applies(seam, key, elapsed):
                 continue
             if rule.kind == "crash":
                 self._note_fired(rule)
                 _crash_now(seam)
+            if rule.kind in ("partition", "flap") and not rule.blackhole:
+                self._note_fired(rule)
+                self.last_fired_mono = time.monotonic()
+                raise InjectedFault(seam, rule.kind, rule.fault)
             if rule.kind != "error":
                 continue
             self._note_fired(rule)
@@ -232,6 +370,9 @@ _ACTIVE: Optional[FaultInjector] = None
 
 def install(injector: FaultInjector) -> FaultInjector:
     global _ACTIVE
+    # anchor the windowed kinds at install time: "start_s after the
+    # drill began", not after the plan object happened to be built
+    injector.installed_mono = time.monotonic()
     _ACTIVE = injector
     return injector
 

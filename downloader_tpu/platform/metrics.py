@@ -118,6 +118,24 @@ class Metrics:
             ["dependency", "to_state"],
             registry=self.registry,
         )
+        self.breaker_opened = Counter(
+            f"{ns}_breaker_opened_total",
+            "Circuit-breaker opens with attribution: reason=failure "
+            "(consecutive transient failures hit the threshold) vs "
+            "reason=slow (the slow-call policy tripped on a sustained "
+            "latency brownout — triage differently: the dependency is "
+            "up, just unusable)",
+            ["dependency", "reason"],
+            registry=self.registry,
+        )
+        self.dependency_slow = Counter(
+            f"{ns}_dependency_slow_total",
+            "Answered dependency attempts that exceeded the breaker's "
+            "slow_threshold_ms — the brownout signal behind a "
+            "reason=slow breaker open",
+            ["dependency"],
+            registry=self.registry,
+        )
         self.stage_seconds = Histogram(
             f"{ns}_stage_seconds",
             "Wall-clock seconds per pipeline stage",
@@ -332,6 +350,17 @@ class Metrics:
             f"{ns}_fleet_gc_reclaimed_bytes_total",
             "Bytes reclaimed from the fleet shared cache tier by the GC "
             "sweep",
+            registry=self.registry,
+        )
+        self.fleet_fenced_writes = Counter(
+            f"{ns}_fleet_fenced_writes_total",
+            "Cross-worker writes REJECTED by fencing-token enforcement, "
+            "by op (shared_manifest = a stale leader's shared-tier "
+            "publish, done_marker = a stale seal of the staging set, "
+            "telemetry = a stale trace digest).  Each count is a "
+            "split-brain write that did NOT land — nonzero during a "
+            "partition/stall incident is the fence doing its job",
+            ["op"],
             registry=self.registry,
         )
         # -- multi-tenant overload control (control/tenancy+overload) --

@@ -28,11 +28,17 @@ is that missing rig:
 
 Profiles: :meth:`SoakProfile.smoke` is the tier-1-safe ≤60 s run
 (``make soak-smoke``); :meth:`SoakProfile.full` is the slow-marked
-capacity run (``make soak``); ``bench.py --soak`` emits
-``soak_p99_ms`` / ``soak_rss_slope_mb_per_kjob`` /
+capacity run (``make soak``); :meth:`SoakProfile.degraded` swaps the
+SIGKILL chaos for *degraded-world* chaos — a SIGSTOP/SIGCONT worker
+stall that overruns the lease TTL (split-brain rehearsal for the
+fencing layer) plus a windowed store brownout that must open the
+breaker via the slow-call policy (``bench.py --degraded`` emits
+``brownout_shed_ms`` / ``split_brain_stale_writes``).  ``bench.py
+--soak`` emits ``soak_p99_ms`` / ``soak_rss_slope_mb_per_kjob`` /
 ``soak_journal_peak_bytes`` from the same rig.  Knobs ``soak.jobs`` /
-``soak.workers`` / ``soak.kill_interval`` override any profile (see
-docs/OPERATIONS.md "Capacity & SLOs").
+``soak.workers`` / ``soak.kill_interval`` / ``soak.stalls`` /
+``soak.stall_interval`` / ``soak.stall_duration`` override any profile
+(see docs/OPERATIONS.md "Capacity & SLOs").
 
 The backends (broker, store, origins) are injected: tests and the
 bench own the MiniAmqp/MiniS3/origin servers, the package owns the
@@ -41,7 +47,9 @@ workload, the chaos, the sampling, and the verdicts.
 
 from .rig import SoakRig, SoakWorld
 from .sampler import GrowthSampler, Sample, parse_prometheus
-from .slo import Guard, SoakReport, evaluate, fit_slope, percentile
+from .slo import (Guard, SoakReport, brownout_shed_seconds, evaluate,
+                  fenced_writes_total, fit_slope, percentile,
+                  slow_opens_total)
 from .workload import (JobSpec, SoakEndpoints, SoakProfile, SoakWorkload,
                        WorkloadOrigin, download_msg)
 
@@ -56,6 +64,9 @@ __all__ = [
     "evaluate",
     "fit_slope",
     "percentile",
+    "brownout_shed_seconds",
+    "slow_opens_total",
+    "fenced_writes_total",
     "JobSpec",
     "SoakEndpoints",
     "SoakProfile",

@@ -75,8 +75,13 @@ class WorkerSlot:
     generation: int = 0
     #: set once /readyz answered for the CURRENT generation — the
     #: sampler must not scrape (and tally failures against) a process
-    #: still booting after a chaos respawn
+    #: still booting after a chaos respawn (cleared again while the
+    #: stall chaos holds the process under SIGSTOP)
     ready: bool = False
+    #: monotonic time the current generation's /readyz first answered —
+    #: the anchor the degraded profile's brownout-window measurements
+    #: (``brownout_shed_ms``) are taken from
+    ready_mono: float = 0.0
 
     @property
     def pid(self) -> int:
@@ -120,6 +125,9 @@ class SoakWorld:
     byte_mismatches: List[str] = field(default_factory=list)
     scrape_failures: int = 0
     kills_delivered: int = 0
+    #: SIGSTOP/SIGCONT stalls delivered (degraded profile) — like a
+    #: kill, a stall can fail at most one in-flight scrape
+    stalls_delivered: int = 0
 
 
 class SoakRig:
@@ -140,6 +148,7 @@ class SoakRig:
         self.logger = logger
         self.outcomes: Dict[str, JobOutcome] = {}
         self.kills_delivered = 0
+        self.stalls_delivered = 0
         self.world: Optional[SoakWorld] = None
         #: the growth sampler's series, kept after run() for callers
         #: that inspect the raw timelines (tests, the bench)
@@ -217,8 +226,10 @@ class SoakRig:
                 # short lease TTL: a killed lease-holder must not park
                 # fan-in waiters for tens of seconds — takeover at
                 # ttl*1.25 bounds the worst hot-key stall the p99
-                # guards can see
-                "lease_ttl": 8.0, "heartbeat_interval": 1.0,
+                # guards can see (the degraded profile shrinks it so a
+                # SIGSTOP stall reliably overruns it)
+                "lease_ttl": profile.lease_ttl,
+                "heartbeat_interval": 1.0,
                 "liveness_ttl": 4.0, "poll_interval": 0.2,
                 "max_wait": 30.0,
                 "gc_interval": profile.gc_interval,
@@ -235,6 +246,9 @@ class SoakRig:
             "origins": {"manifest": {"min_poll": 0.1, "max_poll": 0.5,
                                      "stall_timeout": 15.0}},
         }
+        if profile.breakers:
+            # the degraded profile arms the slow-call policy here
+            cfg["breakers"] = dict(profile.breakers)
         os.makedirs(slot.config_dir, exist_ok=True)
         with open(os.path.join(slot.config_dir, "converter.yaml"), "w",
                   encoding="utf-8") as fh:
@@ -279,6 +293,7 @@ class SoakRig:
                             self._url(slot, "/readyz")) as resp:
                         if resp.status == 200:
                             slot.ready = True
+                            slot.ready_mono = time.monotonic()
                             return
                 except aiohttp.ClientError:
                     pass
@@ -293,6 +308,45 @@ class SoakRig:
         slot.proc.send_signal(signal.SIGKILL)
         await slot.proc.wait()
         self.kills_delivered += 1
+
+    async def stall_worker(self, slot: WorkerSlot,
+                           duration: float) -> None:
+        """SIGSTOP the worker for ``duration`` seconds, then SIGCONT.
+
+        A stalled worker is NOT a killed worker: its leases expire and
+        peers take over (fence + 1) while its process state — in-flight
+        transfers, held "leases", unacked deliveries — survives intact
+        and resumes mid-takeover.  Exactly the GC-pause split-brain the
+        fencing enforcement exists for.  ``ready`` is cleared for the
+        stall window so the sampler doesn't tally the frozen process's
+        unanswered scrapes as failures."""
+        slot.ready = False
+        slot.proc.send_signal(signal.SIGSTOP)
+        self.stalls_delivered += 1
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            slot.proc.send_signal(signal.SIGCONT)
+            if slot.proc.returncode is None:
+                slot.ready = True
+
+    async def _stall_loop(self) -> None:
+        profile = self.profile
+        if profile.stalls <= 0 or profile.stall_duration <= 0:
+            return
+        stalls = 0
+        while stalls < profile.stalls:
+            await asyncio.sleep(profile.stall_interval)
+            # stall workers from the TOP index down, away from worker 0
+            # (the fault-plan host): the brownout and the stall must
+            # degrade different workers or the scenario collapses into
+            # one sick process
+            slot = self.slots[len(self.slots) - 1
+                              - (stalls % len(self.slots))]
+            if not slot.alive:
+                continue
+            await self.stall_worker(slot, profile.stall_duration)
+            stalls += 1
 
     async def stop_workers(self) -> None:
         """Clean TERM (deregister + journal close); KILL stragglers."""
@@ -530,7 +584,8 @@ class SoakRig:
 
     async def collect_world(self, scrape_failures: int) -> SoakWorld:
         world = SoakWorld(scrape_failures=scrape_failures,
-                          kills_delivered=self.kills_delivered)
+                          kills_delivered=self.kills_delivered,
+                          stalls_delivered=self.stalls_delivered)
         world.leaked_leases = await self.live_leases()
         world.coord_live = await self.live_coord_census()
         world.records = await self.collect_records()
@@ -580,16 +635,27 @@ class SoakRig:
                                      rate=profile.publish_rate))
                 chaos_task = asyncio.get_running_loop().create_task(
                     self._chaos_loop(expected))
+                stall_task = asyncio.get_running_loop().create_task(
+                    self._stall_loop())
                 deadline = time.monotonic() + profile.max_wall
                 try:
                     await self._completion_loop(deadline, expected)
                 finally:
-                    for task in (chaos_task, publisher):
+                    for task in (chaos_task, stall_task, publisher):
                         task.cancel()
                         try:
                             await task
                         except asyncio.CancelledError:
                             pass
+                    # a stall window interrupted mid-cancel must not
+                    # leave a worker frozen into the census
+                    if profile.stalls > 0:
+                        for slot in self.slots:
+                            if (slot.proc is not None
+                                    and slot.proc.returncode is None
+                                    and not slot.ready):
+                                slot.proc.send_signal(signal.SIGCONT)
+                                slot.ready = True
                 # quiescent-fleet attribution probe (the hop-ledger
                 # reconciliation guard's measurement set)
                 await self._attribution_probe(workload.probe_specs)

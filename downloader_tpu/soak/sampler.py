@@ -29,6 +29,14 @@ SCRAPE_SUFFIXES = (
     "recorder_ring_evictions_total",
     "jobs_shed_total",
     "overload_saturated",
+    # degraded-profile observables: when did the slow-call policy open
+    # the breaker (brownout_shed_ms), with what attribution, and how
+    # many stale cross-worker writes did the fence reject
+    "breaker_state",
+    "breaker_opened_total",
+    "dependency_slow_total",
+    "fleet_fenced_writes_total",
+    "jobs_parked_total",
 )
 
 _PAGE_SIZE = resource.getpagesize()

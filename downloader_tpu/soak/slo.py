@@ -223,12 +223,20 @@ def evaluate(profile, outcomes, samples, world) -> SoakReport:
         "unsettled_journal_jobs_at_drain",
         len(world.unsettled_journal_jobs),
         ", ".join(world.unsettled_journal_jobs[:6])))
+    # a kill OR a stall can each strand at most one in-flight scrape
+    # (the sampler skips not-ready workers, but a freeze can land mid-
+    # request) — both count toward the allowance
+    chaos_events = (world.kills_delivered
+                    + getattr(world, "stalls_delivered", 0))
     guards.append(_exact_zero(
         "sampler_scrape_failures_beyond_kills",
-        max(world.scrape_failures - world.kills_delivered, 0),
+        max(world.scrape_failures - chaos_events, 0),
         f"{world.scrape_failures} failures, "
-        f"{world.kills_delivered} kills"))
+        f"{world.kills_delivered} kills, "
+        f"{getattr(world, 'stalls_delivered', 0)} stalls"))
     stats["kills_delivered"] = float(world.kills_delivered)
+    stats["stalls_delivered"] = float(
+        getattr(world, "stalls_delivered", 0))
 
     # -- hop-ledger vs wall-clock reconciliation -----------------------
     # judged over the QUIESCENT attribution-probe jobs: sequential,
@@ -295,6 +303,56 @@ def rss_slope_mb_per_kjob(samples) -> float:
             continue
         worst = max(worst, fit_slope(xs, ys))
     return worst
+
+
+def brownout_shed_seconds(samples, start_mono: float,
+                          dependency: str = "store"
+                          ) -> Optional[float]:
+    """Seconds from the brownout window opening to the FIRST sample
+    showing ``dependency``'s breaker away from closed on any worker —
+    the shed latency the degraded profile guards (``brownout_shed_ms``).
+    None when no sample ever saw the breaker leave closed."""
+    selector = f'dependency="{dependency}"'
+    for sample in samples:
+        if sample.t_mono < start_mono:
+            continue
+        for index in sample.scraped:
+            value = sample.metric(index, "breaker_state", selector)
+            if value is not None and value >= 1.0:
+                return sample.t_mono - start_mono
+    return None
+
+
+def slow_opens_total(samples, dependency: str = "store") -> float:
+    """Total ``breaker_opened_total{reason="slow"}`` opens for
+    ``dependency`` across workers, from each worker's LAST scrape —
+    proves the brownout tripped the slow-call policy, not the failure
+    counter."""
+    latest: Dict[int, float] = {}
+    selector = (f'dependency="{dependency}"', 'reason="slow"')
+    for sample in samples:
+        for index, scraped in sample.scraped.items():
+            for name, value in scraped.items():
+                family = name.split("{", 1)[0]
+                if not family.endswith("breaker_opened_total"):
+                    continue
+                if all(part in name for part in selector):
+                    latest[index] = value
+    return sum(latest.values())
+
+
+def fenced_writes_total(samples) -> float:
+    """Total ``fleet_fenced_writes_total`` across workers and ops, from
+    each worker's last scrape — the split-brain writes the fence
+    rejected over the run."""
+    latest: Dict[tuple, float] = {}
+    for sample in samples:
+        for index, scraped in sample.scraped.items():
+            for name, value in scraped.items():
+                family = name.split("{", 1)[0]
+                if family.endswith("fleet_fenced_writes_total"):
+                    latest[(index, name)] = value
+    return sum(latest.values())
 
 
 def hop_reconciliation(records: List[dict],

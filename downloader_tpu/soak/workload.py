@@ -52,6 +52,24 @@ class SoakProfile:
     kill_interval: float = 2.5
     #: SIGKILLs to deliver over the run
     kills: int = 1
+    #: SIGSTOP/SIGCONT stall chaos (the degraded profile's regime): a
+    #: worker frozen past the lease TTL is a *stalled* leader — alive,
+    #: lease expired, resumed mid-takeover — the split-brain shape the
+    #: fencing layer exists for.  0 stalls = off.
+    stalls: int = 0
+    stall_interval: float = 3.0
+    stall_duration: float = 0.0
+    #: fleet content-lease TTL written into worker configs (the
+    #: degraded profile shrinks it so a stall overruns it quickly)
+    lease_ttl: float = 8.0
+    #: extra ``breakers`` config section for the workers (the degraded
+    #: profile arms the store slow-call policy here)
+    breakers: Dict[str, dict] = field(default_factory=dict)
+    #: wall-clock offset (seconds after worker 0 installs its fault
+    #: plan) at which the profile's brownout window opens — kept in
+    #: sync with ``fault_plan`` so the rig can anchor the
+    #: ``brownout_shed_ms`` measurement
+    brownout_start_s: float = 0.0
     #: sampler cadence
     sample_interval: float = 0.5
     #: hard wall for the workload phase (publish -> all jobs resolved)
@@ -127,6 +145,54 @@ class SoakProfile:
         return cls(**params)
 
     @classmethod
+    def degraded(cls, **overrides) -> "SoakProfile":
+        """The degraded-world profile (``make degraded`` / bench v19
+        ``--degraded``): no SIGKILLs — instead a SIGSTOP/SIGCONT stall
+        that overruns the (shortened) lease TTL, a store brownout
+        window on worker 0, and the slow-call breaker policy armed.
+        Guards the brownout sheds (breaker opens on ``slow``) and that
+        split-brain staged no stale byte."""
+        params = dict(
+            jobs=18, workers=2, kill_interval=0.0, kills=0,
+            stalls=1, stall_interval=2.0, stall_duration=4.0,
+            lease_ttl=2.0,
+            max_wall=110.0, publish_rate=2.5,
+            # hot fan-in dominates so the stall lands on a lease
+            # holder; racing/manifest lanes sit this profile out
+            hot_fraction=0.5, racing_fraction=0.0, manifest_jobs=0,
+            bulk_fraction=0.25,
+            # the reconciliation probe measures a quiescent fleet —
+            # out of scope for a deliberately-degraded one
+            probe_jobs=0,
+            # worker 0: latency-only store brownout (zero errors) —
+            # the slow-call policy, not the failure counter, must trip.
+            # The window opens almost immediately and spans the first
+            # workload wave, so worker 0's store calls are reliably
+            # inside it; it CLOSES so the post-window half-open probe
+            # restores full-speed drain
+            fault_plan=(
+                '[{"seam": "store.*", "kind": "brownout",'
+                ' "start_s": 1.0, "window_s": 6.0,'
+                ' "latency_ms": 250, "jitter_ms": 100}]'
+            ),
+            brownout_start_s=1.0,
+            breakers={"store": {"slow_threshold_ms": 120,
+                                "slow_ratio": 0.5, "slow_window": 8,
+                                "slow_min_calls": 4, "reset": 1.5}},
+            # stall + brownout both inflate the tail legitimately
+            p99_ceiling={"HIGH": 35.0, "NORMAL": 45.0, "BULK": 80.0},
+            # breaker-shed jobs legitimately settle on BOTH workers
+            # (park-then-nack on the browned-out one, completion on the
+            # peer — digests key per worker+job), and the stall defers
+            # the elected GC sweeper, so the final telemetry census
+            # runs up to ~2x jobs before aging out; the bound still
+            # caps growth, just sized for this profile's chaos
+            telemetry_final_fraction=2.5,
+        )
+        params.update(overrides)
+        return cls(**params)
+
+    @classmethod
     def from_config(cls, config, base: "Optional[SoakProfile]" = None,
                     **overrides) -> "SoakProfile":
         """Resize ``base`` (default: smoke) from the ``soak.*`` knobs."""
@@ -136,6 +202,11 @@ class SoakProfile:
             workers=int(cfg_get(config, "soak.workers", base.workers)),
             kill_interval=float(cfg_get(
                 config, "soak.kill_interval", base.kill_interval)),
+            stalls=int(cfg_get(config, "soak.stalls", base.stalls)),
+            stall_interval=float(cfg_get(
+                config, "soak.stall_interval", base.stall_interval)),
+            stall_duration=float(cfg_get(
+                config, "soak.stall_duration", base.stall_duration)),
         )
         params.update(overrides)
         from dataclasses import replace

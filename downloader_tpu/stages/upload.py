@@ -11,7 +11,9 @@ Behavioral parity with /root/reference/lib/upload.js:
 - per-file existence check; missing file is an error (lib/upload.js:38-41)
 - progress telemetry mapped to 50-100% (lib/upload.js:47-51)
 - writes ``<media.id>/original/done`` = ``"true"`` — the idempotency marker
-  the orchestrator probes (lib/upload.js:55, lib/main.js:120)
+  the orchestrator probes (lib/upload.js:55, lib/main.js:120); fleet-
+  coordinated jobs seal with a fenced JSON document instead (see
+  :func:`done_marker_body` — existence is still the probe contract)
 - best-effort removal of the download directory (lib/upload.js:60-64)
 
 The per-file machinery lives in :class:`Uploader` so the streaming
@@ -27,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import inspect
+import json
 import os
 import posixpath
 import shutil
@@ -51,6 +54,34 @@ def object_name(media_id: str, file_path: str) -> str:
 def done_marker_name(media_id: str) -> str:
     """``<id>/original/done`` (reference lib/upload.js:55)."""
     return posixpath.join(media_id, "original", DONE_MARKER)
+
+
+def done_marker_body(fence=None, worker=None) -> bytes:
+    """The marker document.  Without a fence context it is the
+    reference-parity literal ``b"true"``; a fleet-coordinated job seals
+    with a fenced JSON document instead, so a resumed stale leader's
+    re-seal is rejectable (every consumer treats marker EXISTENCE as
+    "staged" — both shapes satisfy the probe)."""
+    if not fence:
+        return b"true"
+    doc = {"done": True, "fence": int(fence)}
+    if worker:
+        doc["worker"] = worker
+    return json.dumps(doc, sort_keys=True).encode("utf-8")
+
+
+def parse_done_marker(raw: bytes) -> dict:
+    """``{"done": bool, "fence": int}`` from either marker shape
+    (legacy ``b"true"`` parses as fence 0 — any fenced writer beats
+    it).  Unrecognizable bodies read as not-done, fence 0."""
+    if raw == b"true":
+        return {"done": True, "fence": 0}
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        return {"done": bool(doc.get("done")),
+                "fence": int(doc.get("fence") or 0)}
+    except (ValueError, UnicodeDecodeError, AttributeError, TypeError):
+        return {"done": False, "fence": 0}
 
 
 async def _already_staged(store, name: str, file_path: str, record=None,
@@ -392,19 +423,73 @@ class Uploader:
             self.ctx.record.event("manifest_verified", files=verified,
                                   unverifiable=unverifiable)
 
+    def _note_fenced_marker(self, media_id: str, fence: int,
+                            newer: int) -> None:
+        if self.ctx.metrics is not None:
+            self.ctx.metrics.fleet_fenced_writes.labels(
+                op="done_marker").inc()
+        if self.ctx.record is not None:
+            self.ctx.record.event("fenced_write", op="done_marker",
+                                  fence=fence, newer=newer)
+        self.logger.warn("done marker already sealed by a newer fence; "
+                         "stale seal fenced off", mediaId=media_id,
+                         fence=fence, newer=newer)
+
     async def write_done_marker(self, media_id: str) -> None:
         """Seal the staging set: the idempotency marker the orchestrator
-        probes — written only once EVERY file is staged."""
+        probes — written only once EVERY file is staged.
+
+        Fenced (fleet-coordinated jobs only): the marker carries the
+        job's lease fence, an existing higher-fenced marker suppresses
+        the write entirely (a stale resumed leader must not re-seal a
+        set a newer authority already published — the seal it finds IS
+        the completion it wanted, so the job still settles DONE), and a
+        read-back after the write detects losing to a concurrent newer
+        seal.  Jobs without a fence context write the reference-parity
+        ``b"true"`` byte-for-byte.
+        """
         name = done_marker_name(media_id)
+        record = self.ctx.record
+        fence = int(getattr(record, "fleet_fence", 0) or 0) \
+            if record is not None else 0
+        worker = getattr(record, "worker_id", None) \
+            if record is not None else None
+
+        if fence:
+            # pre-write fence check (best-effort: any read trouble just
+            # proceeds to the write — the read-back still verifies)
+            try:
+                existing = parse_done_marker(await self.store.get_object(
+                    STAGING_BUCKET, name))
+            except Exception:
+                existing = None
+            if (existing is not None and existing["done"]
+                    and existing["fence"] > fence):
+                self._note_fenced_marker(media_id, fence,
+                                         existing["fence"])
+                return
 
         async def _seal():
             if faults.enabled():
                 await faults.fire("store.put", key=name)
-            await self.store.put_object(STAGING_BUCKET, name, b"true")
+            await self.store.put_object(
+                STAGING_BUCKET, name, done_marker_body(fence, worker))
 
         seal_mark = time.monotonic()
         await self.retrier.run("store.put", _seal, cancel=self.ctx.cancel,
                                record=self.ctx.record, logger=self.logger)
+        if fence:
+            # CAS-style read-verify, same posture as the coordination
+            # store's nonce read-back: a concurrent newer-fenced seal
+            # landing over ours is a lost race we must attribute (the
+            # set IS sealed either way — by the newer authority)
+            try:
+                back = parse_done_marker(await self.store.get_object(
+                    STAGING_BUCKET, name))
+            except Exception:
+                back = None
+            if back is not None and back["fence"] > fence:
+                self._note_fenced_marker(media_id, fence, back["fence"])
         if self.ctx.record is not None:
             self.ctx.record.note_hop("upload", 0,
                                      time.monotonic() - seal_mark)

@@ -445,6 +445,53 @@ def test_seam_coverage_sees_renamed_retrier_receivers():
     assert any("zorp" in f.message for f in found)
 
 
+def test_seam_coverage_flags_sync_only_family_for_windowed_kinds():
+    # a family drillable only via fire_sync cannot take brownout
+    # latency or a blackhole partition — `make degraded` blind spot
+    mod = """
+        from ..platform import faults
+
+        async def put(self, fn):
+            faults.fire_sync("store.put", key="k")
+            return await self.retrier.run("store.put", fn)
+    """
+    found = run_repo_rule("seam-coverage", sources={LIB: mod},
+                          operations=SEAM_DOCS)
+    assert any("windowed" in f.message and "store" in f.message
+               for f in found)
+
+
+def test_seam_coverage_async_hook_satisfies_windowed_drillability():
+    # one async fire hook in the family covers the windowed kinds even
+    # when a sync hook also exists
+    mod = """
+        from ..platform import faults
+
+        async def put(self, fn):
+            faults.fire_sync("store.preflight", key="k")
+            if faults.enabled():
+                await faults.fire("store.put", key="k")
+            return await self.retrier.run("store.put", fn)
+    """
+    assert run_repo_rule("seam-coverage", sources={LIB: mod},
+                         operations=SEAM_DOCS) == []
+
+
+def test_seam_coverage_windowed_exemption_is_honored():
+    # `disk` is sync-only by design, with the justification on record
+    # in drift.WINDOWED_EXEMPT — no finding
+    mod = """
+        from ..platform import faults
+
+        def preflight(self):
+            faults.fire_sync("disk.preflight", key="/tmp")
+    """
+    docs = SEAM_DOCS + "\nretry.disk covers the preflight\n"
+    found = run_repo_rule("seam-coverage", sources={LIB: mod},
+                          operations=docs)
+    assert not any("windowed" in f.message for f in found)
+
+
 def test_seam_coverage_resolves_fstring_origin_seams():
     mod = """
         from ..platform import faults

@@ -219,6 +219,19 @@ class CrashRig:
         self.proc.send_signal(signal.SIGKILL)
         await self.proc.wait()
 
+    async def stall(self, duration: float) -> None:
+        """SIGSTOP the worker for ``duration`` seconds, then SIGCONT.
+
+        A stalled worker is alive-but-frozen — the GC-pause shape: its
+        leases/heartbeats expire while its process state (in-flight
+        transfers, unacked deliveries) survives and resumes.  The
+        single-worker mirror of the soak rig's stall chaos."""
+        self.proc.send_signal(signal.SIGSTOP)
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            self.proc.send_signal(signal.SIGCONT)
+
     async def wait_job_state(self, job_id: str, state: str,
                              timeout: float = 30.0) -> dict:
         async with asyncio.timeout(timeout):
@@ -264,9 +277,15 @@ class CrashRig:
             STAGING, _object_name(job_id, "show.mkv"))
 
     async def assert_staged_ok(self, job_id: str) -> None:
+        from downloader_tpu.stages.upload import parse_done_marker
+
         assert await self.staged_bytes(job_id) == PAYLOAD
-        assert await self.store.get_object(
-            STAGING, f"{job_id}/original/done") == b"true"
+        # uncoordinated jobs seal with the reference-parity b"true";
+        # fleet-coordinated ones seal a fenced JSON document — both
+        # parse as done (existence is the probe contract)
+        marker = await self.store.get_object(
+            STAGING, f"{job_id}/original/done")
+        assert parse_done_marker(marker)["done"] is True
 
     async def live_leases(self) -> list:
         """Lease keys whose coordination doc is LIVE (a delete leaves a
@@ -332,6 +351,44 @@ async def test_sigkill_mid_download_then_restart_completes(tmp_path):
         final = rig.journal_state().jobs.get("crash-dl")
         assert final is not None and final.state == "DONE"
         assert final.settle == "ack"
+    finally:
+        await rig.stop()
+        await origin.cleanup()
+
+
+async def test_sigstop_resume_mid_download_completes(tmp_path):
+    """Stall-resume chaos (SIGSTOP/SIGCONT, no kill): the worker is
+    frozen mid-transfer long enough for any lease/heartbeat to expire,
+    then resumed.  Unlike a SIGKILL there is no restart and no journal
+    replay — the process itself must ride out its own absence: the job
+    completes exactly once, staged bytes byte-identical, no orphan
+    workdirs, and the journal shows a single clean settle."""
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, gets = await start_origin(chunk_delay=0.15)
+    try:
+        rig.write_config()
+        await rig.spawn_worker()
+        await rig.publish("stall-dl", uri)
+
+        partial = os.path.join(rig.downloads, "stall-dl",
+                               "show.mkv.partial")
+        async with asyncio.timeout(20):
+            while not (os.path.exists(partial)
+                       and os.path.getsize(partial) > 0):
+                await asyncio.sleep(0.02)
+        # freeze mid-splice: longer than a short lease TTL would be,
+        # far shorter than the origin/watchdog stall budgets
+        await rig.stall(1.5)
+
+        body = await rig.wait_job_state("stall-dl", "DONE")
+        assert body.get("recovered") is not True  # same life, no replay
+        await rig.assert_staged_ok("stall-dl")
+        assert rig.orphan_workdirs() == []
+        final = rig.journal_state().jobs.get("stall-dl")
+        assert final is not None and final.state == "DONE"
+        assert final.settle == "ack"
+        assert gets[0] == 1  # one origin fetch: the stall refetched nothing
     finally:
         await rig.stop()
         await origin.cleanup()

@@ -1,0 +1,822 @@
+"""Degraded-world chaos suite (``make degraded``): brownouts, asymmetric
+partitions, flapping coordination, and split-brain fencing.
+
+PR 13's soak proved the fleet survives fail-stop (SIGKILL) chaos; this
+suite proves the *degraded-but-alive* failure modes that remain:
+
+- a store that answers every call successfully but slowly ("slow is
+  the new down") must open its breaker via the slow-call policy with
+  reason ``slow`` and shed via the park-then-nack path — zero poison;
+- an asymmetric coordination partition (reads pass, writes fail) must
+  degrade workers to uncoordinated fetching with zero job failures,
+  and must make the GC sweeper STAND DOWN rather than evict keys it
+  cannot prove unleased;
+- a leader stalled past its lease TTL that resumes mid-takeover must
+  LOSE at every cross-worker write (shared-tier manifest, done marker,
+  telemetry digest) — ``fleet_fenced_writes_total`` counts the saves
+  and zero stale bytes reach the shared tier;
+- a waiter under a *flapping* coordination store must not livelock:
+  ``fleet.max_wait`` is a per-job budget carried across coordination
+  errors and redeliveries;
+- the full degraded soak profile (SIGSTOP/SIGCONT stall past the lease
+  TTL + windowed store brownout against a real 2-worker subprocess
+  fleet) holds every SLO with zero staged-byte divergence.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+from helpers import start_media_server
+
+from downloader_tpu import schemas
+from downloader_tpu.control.registry import JobRecord, JobRegistry
+from downloader_tpu.fleet import FleetPlane, MemoryCoordStore
+from downloader_tpu.fleet.plane import LEASES_PREFIX
+from downloader_tpu.mq import InMemoryBroker
+from downloader_tpu.platform import faults
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.errors import (BreakerBoard, CircuitBreaker,
+                                            Retrier)
+from downloader_tpu.platform.faults import (FaultInjector, FaultRule,
+                                            InjectedFault, seam_is_write)
+from downloader_tpu.stages.upload import (STAGING_BUCKET, done_marker_body,
+                                          done_marker_name,
+                                          parse_done_marker)
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store.cache import ContentCache, cache_key
+
+from test_control import make_download_msg, serve_admin, wait_for
+from test_faults import chaos_config, counter_value, make_orchestrator
+
+pytestmark = pytest.mark.anyio
+
+PAYLOAD = b"G" * (64 << 10)
+STALE = b"S" * (64 << 10)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_injector():
+    """Every test must leave the process-global injector uninstalled."""
+    yield
+    assert faults.active() is None, "test leaked an installed fault plan"
+    faults.uninstall()
+
+
+def _install(rules):
+    return faults.install(FaultInjector(
+        [FaultRule.from_dict(dict(rule)) for rule in rules]))
+
+
+def _elapsed(injector, seconds):
+    """Rewind the injector's install anchor so 'now' reads as
+    ``seconds`` elapsed — windowed phases become unit-testable without
+    sleeping."""
+    injector.installed_mono = time.monotonic() - seconds
+
+
+# ---------------------------------------------------------------------------
+# Windowed fault kinds: pure phase math
+# ---------------------------------------------------------------------------
+
+def test_windowed_rule_phase_helpers_are_pure():
+    rule = FaultRule(seam="store.*", kind="brownout", start_s=5.0,
+                     window_s=10.0, latency_ms=100, jitter_ms=50)
+    assert not rule.window_active(4.9)
+    assert rule.window_active(5.0)
+    assert rule.window_active(14.9)
+    assert not rule.window_active(15.0)
+    # open-ended window
+    assert FaultRule(seam="s", kind="brownout",
+                     window_s=0).window_active(9999)
+    flap = FaultRule(seam="s", kind="flap", period_s=4.0, duty=0.25)
+    assert flap.flap_on(0.5)       # first quarter of the cycle: on
+    assert not flap.flap_on(1.5)   # rest of the cycle: healthy
+    assert flap.flap_on(4.2)       # next cycle partitions again
+    # deterministic brownout latency train: same fired index, same sample
+    d0 = rule.brownout_delay_s()
+    rule.fired += 1
+    d1 = rule.brownout_delay_s()
+    rule.fired -= 1
+    assert d0 == rule.brownout_delay_s() and d0 != d1
+    assert 0.1 <= d0 <= 0.15 and 0.1 <= d1 <= 0.15
+
+
+def test_windowed_rule_validation():
+    with pytest.raises(ValueError):
+        FaultRule(seam="s", kind="partition", mode="sideways")
+    with pytest.raises(ValueError):
+        FaultRule(seam="s", kind="flap", period_s=0)
+    with pytest.raises(ValueError):
+        FaultRule(seam="s", kind="flap", duty=0.0)
+    with pytest.raises(ValueError):
+        FaultRule.from_dict({"seam": "s", "kind": "brownout",
+                             "bogus_knob": 1})
+    # write/read classification behind mode asymmetry
+    assert seam_is_write("coord.put") and seam_is_write("store.bucket")
+    assert not seam_is_write("coord.get") and not seam_is_write(
+        "store.stat")
+
+
+# ---------------------------------------------------------------------------
+# Windowed fault kinds: injection behavior
+# ---------------------------------------------------------------------------
+
+async def test_brownout_adds_latency_only_inside_window():
+    injector = _install([{"seam": "dep.op", "kind": "brownout",
+                          "window_s": 60.0, "latency_ms": 80}])
+    try:
+        started = time.monotonic()
+        await faults.fire("dep.op", key="k")  # in-window: delayed, no error
+        assert time.monotonic() - started >= 0.07
+        _elapsed(injector, 120.0)  # window long closed
+        started = time.monotonic()
+        await faults.fire("dep.op", key="k")
+        assert time.monotonic() - started < 0.05
+    finally:
+        faults.uninstall()
+
+
+async def test_partition_mode_writes_passes_reads():
+    _install([{"seam": "coord.*", "kind": "partition", "mode": "writes",
+               "window_s": 0}])
+    try:
+        await faults.fire("coord.get", key="k")   # reads pass
+        await faults.fire("coord.list", key="k")
+        with pytest.raises(InjectedFault) as err:
+            await faults.fire("coord.put", key="k")
+        assert err.value.fault_class == "transient"
+        # sync seams refuse too (partition needs no sleep)
+        with pytest.raises(InjectedFault):
+            faults.fire_sync("coord.delete", key="k")
+        faults.fire_sync("coord.get", key="k")
+    finally:
+        faults.uninstall()
+
+
+async def test_partition_blackhole_hangs_until_cancelled():
+    _install([{"seam": "dep.*", "kind": "partition", "blackhole": True}])
+    try:
+        with pytest.raises(TimeoutError):
+            async with asyncio.timeout(0.1):
+                await faults.fire("dep.op", key="k")
+    finally:
+        faults.uninstall()
+
+
+async def test_flap_partitions_periodically():
+    injector = _install([{"seam": "coord.*", "kind": "flap",
+                          "period_s": 10.0, "duty": 0.5}])
+    try:
+        _elapsed(injector, 2.0)  # first half of the cycle: partitioned
+        with pytest.raises(InjectedFault):
+            await faults.fire("coord.put", key="k")
+        _elapsed(injector, 7.0)  # second half: healthy
+        await faults.fire("coord.put", key="k")
+        _elapsed(injector, 12.0)  # next cycle partitions again
+        with pytest.raises(InjectedFault):
+            await faults.fire("coord.put", key="k")
+    finally:
+        faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Slow-call breaker policy
+# ---------------------------------------------------------------------------
+
+def test_slow_calls_open_breaker_with_reason_slow():
+    metrics = prom.new(f"slow{os.urandom(3).hex()}")
+    breaker = CircuitBreaker("store", threshold=50, reset=0.1,
+                             slow_threshold=0.05, slow_ratio=0.5,
+                             slow_window=4, slow_min_calls=2,
+                             metrics=metrics)
+    breaker.record_success(0.2)
+    assert breaker.state == "closed"  # one sample: below min_calls
+    breaker.record_success(0.2)
+    assert breaker.state == "open"
+    assert breaker.open_reason == "slow"
+    assert breaker.failures == 0      # no failure was ever recorded
+    text = metrics.render().decode()
+    assert ('breaker_opened_total{dependency="store",reason="slow"} 1.0'
+            in text)
+    assert 'dependency_slow_total{dependency="store"} 2.0' in text
+
+
+def test_slow_half_open_probe_reopens_fast_probe_closes():
+    breaker = CircuitBreaker("store", threshold=50, reset=0.01,
+                             slow_threshold=0.05, slow_ratio=0.5,
+                             slow_window=4, slow_min_calls=2)
+    for _ in range(2):
+        breaker.record_success(0.2)
+    assert breaker.state == "open" and breaker.open_reason == "slow"
+    time.sleep(0.02)
+    assert breaker.allow()            # half-open probe slot
+    breaker.record_success(0.2)       # probe answers SLOWLY
+    assert breaker.state == "open"    # still browned out: stay shedding
+    assert breaker.open_reason == "slow"
+    time.sleep(0.02)
+    assert breaker.allow()
+    breaker.record_success(0.001)     # fast probe: recovered
+    assert breaker.state == "closed"
+    assert breaker.open_reason is None
+
+
+def test_failure_opens_carry_reason_failure_and_slow_ring_mixes():
+    breaker = CircuitBreaker("store", threshold=2, reset=30.0,
+                             slow_threshold=0.05, slow_window=8,
+                             slow_min_calls=4)
+    breaker.record_failure(0.001)
+    breaker.record_failure(0.001)
+    assert breaker.state == "open" and breaker.open_reason == "failure"
+    # slow transient failures count toward the slow verdict too
+    breaker2 = CircuitBreaker("store", threshold=50, reset=0.01,
+                              slow_threshold=0.05, slow_ratio=0.5,
+                              slow_window=4, slow_min_calls=2)
+    breaker2.record_failure(0.2)
+    breaker2.record_success(0.2)
+    assert breaker2.state == "open" and breaker2.open_reason == "slow"
+    # a brownout hardening into an outage RE-attributes: the half-open
+    # probe ERRORING means the dependency is down now — the reason
+    # must flip to "failure" so triage follows the outage runbook
+    time.sleep(0.02)
+    assert breaker2.allow()
+    breaker2.record_failure(0.001)
+    assert breaker2.state == "open" and breaker2.open_reason == "failure"
+
+
+def test_board_resolves_slow_knobs_and_reports_reasons():
+    config = ConfigNode({"breakers": {
+        "store": {"slow_threshold_ms": 200, "slow_ratio": 0.75,
+                  "slow_window": 5, "slow_min_calls": 3},
+    }})
+    board = BreakerBoard(config)
+    breaker = board.get("store")
+    assert breaker.slow_threshold == pytest.approx(0.2)
+    assert breaker.slow_ratio == 0.75
+    assert breaker.slow_window == 5 and breaker.slow_min_calls == 3
+    # default stays failure-count-only
+    assert board.get("publish").slow_threshold == 0.0
+    assert board.open_reasons() == {}
+    for _ in range(3):
+        breaker.record_success(0.5)
+    assert board.open_reasons() == {"store": "slow"}
+
+
+async def test_retrier_feeds_breaker_latency():
+    metrics = prom.new(f"ret{os.urandom(3).hex()}")
+    config = ConfigNode({
+        "retry": {"default": {"attempts": 1, "base": 0.01, "cap": 0.02}},
+        "breakers": {"store": {"slow_threshold_ms": 20, "slow_ratio": 0.5,
+                               "slow_window": 4, "slow_min_calls": 2,
+                               "reset": 60.0}},
+    })
+    retrier = Retrier(config=config,
+                      breakers=BreakerBoard(config, metrics=metrics),
+                      metrics=metrics)
+
+    async def slow_call():
+        await asyncio.sleep(0.04)
+        return "ok"
+
+    assert await retrier.run("store.put", slow_call) == "ok"
+    assert await retrier.run("store.put", slow_call) == "ok"
+    breaker = retrier.breakers.get("store")
+    assert breaker.state == "open" and breaker.open_reason == "slow"
+    # further calls are rejected without touching the dependency
+    from downloader_tpu.platform.errors import BreakerOpen
+
+    with pytest.raises(BreakerOpen):
+        await retrier.run("store.put", slow_call)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: store brownout -> slow-opened breaker, shed, zero poison
+# ---------------------------------------------------------------------------
+
+async def test_store_brownout_opens_slow_breaker_sheds_and_recovers(
+        tmp_path):
+    """Latency-only store brownout (ZERO errors): the slow-call policy
+    must open the store breaker with reason ``slow`` within the window,
+    deliveries (including BULK) shed via the existing park-then-nack
+    path with zero poison charges, and once the window closes the
+    half-open probe restores service — every job completes."""
+    runner, base = await start_media_server(b"V" * 4096)
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = chaos_config(
+        tmp_path,
+        plan=[{"seam": "store.*", "kind": "brownout", "window_s": 4.0,
+               "latency_ms": 100}],
+        retry={"store": {"attempts": 1, "base": 0.01, "cap": 0.02}},
+        breakers={"store": {"threshold": 50, "reset": 0.25,
+                            "slow_threshold_ms": 40, "slow_ratio": 0.5,
+                            "slow_window": 4, "slow_min_calls": 2}},
+    )
+    orchestrator = await make_orchestrator(tmp_path, broker, store, config)
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        uri = f"{base}/show.mkv"
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(uri, job_id="brown-high",
+                                         priority="HIGH"))
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(uri, job_id="brown-bulk-1",
+                                         priority="BULK"))
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(uri, job_id="brown-bulk-2",
+                                         priority="BULK"))
+
+        breaker = orchestrator.breakers.get("store")
+        await wait_for(lambda: breaker.state != "closed", timeout=15)
+        assert breaker.open_reason == "slow"
+        # attribution is on the wire: /readyz names the reason while
+        # not closed, /metrics counts the slow open and the slow calls
+        async with session.get(f"{api}/readyz") as resp:
+            body = await resp.json()
+            if body.get("breakers", {}).get("store") != "closed":
+                assert body.get("breakerReasons", {}).get("store") \
+                    == "slow"
+        async with session.get(f"{api}/metrics") as resp:
+            text = await resp.text()
+        assert ('breaker_opened_total{dependency="store",'
+                'reason="slow"}') in text
+        assert 'dependency_slow_total{dependency="store"}' in text
+
+        # the brownout window closes; the half-open probe answers fast,
+        # the breaker closes, every shed job completes — zero poison
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=40)
+        for job_id in ("brown-high", "brown-bulk-1", "brown-bulk-2"):
+            assert orchestrator.registry.get(job_id).state == "DONE"
+        metrics = orchestrator.metrics
+        assert counter_value(metrics.jobs_failed, reason="poison") == 0
+        assert not orchestrator.registry.jobs("DROPPED_POISON")
+        # shed happened through park-then-nack, never a hard failure
+        text = metrics.render().decode()
+        assert "jobs_parked_total" in text
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Fencing: a stalled leader resumes mid-takeover and must lose
+# ---------------------------------------------------------------------------
+
+def _fill_src(tmp_path, name, data):
+    src = tmp_path / f"src-{name}-{os.urandom(2).hex()}"
+    src.mkdir()
+    (src / name).write_bytes(data)
+    return str(src)
+
+
+async def test_stalled_leader_shared_tier_write_is_fenced(tmp_path):
+    """W0 wins the lease (fence 1) and stalls past the TTL; W1 takes
+    over (fence 2).  The resumed W0's shared-tier publish must be
+    rejected BEFORE any payload byte lands — zero stale bytes staged,
+    ``fleet_fenced_writes_total{op="shared_manifest"}`` counts the
+    save — and W1's publish (the real authority) proceeds."""
+    coord = MemoryCoordStore()
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    metrics = prom.new(f"fence{os.urandom(3).hex()}")
+    key = cache_key("http", "http://x/hot.mkv", '"v1"')
+    w0 = FleetPlane(coord, "w0", store=store, lease_ttl=0.2,
+                    metrics=metrics)
+    w1 = FleetPlane(coord, "w1", store=store, lease_ttl=0.2)
+
+    lease0 = await w0.try_acquire_lease(key)
+    assert lease0 is not None and lease0.fence == 1
+    # the stall: renewals stop (SIGSTOP'd renewer), TTL + grace elapse
+    lease0.renewer.cancel()
+    await asyncio.sleep(0.3)
+
+    lease1 = await w1.try_acquire_lease(key)
+    assert lease1 is not None and lease1.fence == 2
+
+    # W0 resumes, still believing it leads, with STALE content
+    cache0 = ContentCache(str(tmp_path / "cache0"))
+    await cache0.insert(key, _fill_src(tmp_path, "hot.mkv", STALE))
+    assert not await w0.publish_entry(key, cache0, fence=lease0.fence)
+    assert w0.stats["fencedWrites"] == 1
+    assert counter_value(metrics.fleet_fenced_writes,
+                         op="shared_manifest") == 1
+    # ZERO stale bytes staged: not the manifest, not a payload object
+    names = [info.name async for info in store.list_objects(
+        STAGING_BUCKET, ".fleet-cache/")]
+    assert names == []
+
+    # the real leader publishes; peers see ITS bytes
+    cache1 = ContentCache(str(tmp_path / "cache1"))
+    await cache1.insert(key, _fill_src(tmp_path, "hot.mkv", PAYLOAD))
+    assert await w1.publish_entry(key, cache1, fence=lease1.fence)
+    cache2 = ContentCache(str(tmp_path / "cache2"))
+    peer = FleetPlane(coord, "w2", store=store)
+    assert await peer.fetch_entry(key, cache2)
+    dest = str(tmp_path / "job")
+    assert await cache2.materialize(key, dest) == len(PAYLOAD)
+    with open(os.path.join(dest, "hot.mkv"), "rb") as fh:
+        assert fh.read() == PAYLOAD
+    # the peer learned the fence from the manifest it materialized
+    assert peer.observed_fence(key) == 2
+
+    # W0 retries after W1's publish: idempotent skip, never an overwrite
+    assert await w0.publish_entry(key, cache0, fence=lease0.fence)
+    raw = await store.get_object(
+        STAGING_BUCKET, f".fleet-cache/{key}/files/hot.mkv")
+    assert raw == PAYLOAD
+    await w1.release_lease(key)
+
+
+async def test_relead_after_release_is_not_self_fenced(tmp_path):
+    """Fence numbers must stay monotonic across full release/re-acquire
+    cycles: after a fence-2 takeover completes and releases, a LATER
+    legitimate leader of the same key must win a HIGHER fence (seeded
+    from the observed-fence memo, since the lease doc is gone) and its
+    shared-tier spill and telemetry digest must both land — never be
+    miscounted as split-brain saves against its own history."""
+    coord = MemoryCoordStore()
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/re.mkv", '"v1"')
+    w0 = FleetPlane(coord, "w0", store=store, lease_ttl=0.2)
+    w1 = FleetPlane(coord, "w1", store=store, lease_ttl=0.2)
+
+    lease0 = await w0.try_acquire_lease(key)
+    lease0.renewer.cancel()
+    await asyncio.sleep(0.3)
+    lease1 = await w1.try_acquire_lease(key)
+    assert lease1.fence == 2
+    await w1.release_lease(key)  # epoch over: the lease doc is GONE
+
+    # w1 re-leads the same key later (cache evicted, content re-hot)
+    lease2 = await w1.try_acquire_lease(key)
+    assert lease2.fence == 3  # memo-seeded: monotonic, not a reset to 1
+    cache = ContentCache(str(tmp_path / "cache"))
+    await cache.insert(key, _fill_src(tmp_path, "re.mkv", PAYLOAD))
+    assert await w1.publish_entry(key, cache, fence=lease2.fence)
+    record = JobRecord(1, "job-r", "job-r", "NORMAL")
+    record.trace_id = "aa" * 16
+    record.span_id = "bb" * 8
+    record.fleet_fence = lease2.fence
+    record.fleet_fence_key = key
+    assert await w1.publish_telemetry(record)
+    assert w1.stats["fencedWrites"] == 0
+    await w1.release_lease(key)
+
+
+async def test_publish_read_back_detects_lost_race(tmp_path):
+    """Even when the pre-write check passes (no lease doc, no memo),
+    the post-write read-back catches a newer-fenced manifest landing
+    over ours — last-write-wins races are attributed, not trusted."""
+
+    class RacingStore(InMemoryObjectStore):
+        async def put_object(self, bucket, name, data):
+            await super().put_object(bucket, name, data)
+            if name.endswith("manifest.json") and b'"fence": 1' in data:
+                # a concurrent fence-3 leader's manifest lands last
+                newer = data.replace(b'"fence": 1', b'"fence": 3')
+                await super().put_object(bucket, name, newer)
+
+    store = RacingStore()
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/race.mkv", '"v1"')
+    plane = FleetPlane(MemoryCoordStore(), "w0", store=store)
+    cache = ContentCache(str(tmp_path / "cache"))
+    await cache.insert(key, _fill_src(tmp_path, "race.mkv", PAYLOAD))
+    assert not await plane.publish_entry(key, cache, fence=1)
+    assert plane.stats["fencedWrites"] == 1
+    assert plane.observed_fence(key) == 3
+
+
+async def test_stale_telemetry_digest_is_fenced():
+    coord = MemoryCoordStore()
+    w0 = FleetPlane(coord, "w0", lease_ttl=0.2)
+    w1 = FleetPlane(coord, "w1", lease_ttl=0.2)
+    key = "contentkey"
+    lease0 = await w0.try_acquire_lease(key)
+    lease0.renewer.cancel()
+    await asyncio.sleep(0.3)
+    lease1 = await w1.try_acquire_lease(key)
+    assert lease1.fence == 2
+
+    record = JobRecord(1, "job-t", "job-t", "NORMAL")
+    record.trace_id = "ab" * 16
+    record.span_id = "cd" * 8
+    record.fleet_fence = lease0.fence
+    record.fleet_fence_key = key
+    assert not await w0.publish_telemetry(record)
+    assert w0.stats["fencedWrites"] == 1
+    # the current-authority worker's digest publishes fine
+    record2 = JobRecord(2, "job-t2", "job-t2", "NORMAL")
+    record2.trace_id = "ef" * 16
+    record2.span_id = "01" * 8
+    record2.fleet_fence = lease1.fence
+    record2.fleet_fence_key = key
+    assert await w1.publish_telemetry(record2)
+    await w1.release_lease(key)
+
+
+async def test_done_marker_fenced_against_newer_seal(tmp_path):
+    """A stale resumed leader must not re-seal a staging set a newer
+    authority already sealed: the marker write is suppressed, counted,
+    and the job treats the newer seal as its completion (no failure)."""
+    from downloader_tpu.mq import MemoryQueue
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.stages.base import StageContext
+    from downloader_tpu.stages.upload import Uploader
+    from downloader_tpu.utils import EventEmitter
+    from downloader_tpu.platform.logging import NullLogger
+
+    broker = InMemoryBroker()
+    mq = MemoryQueue(broker)
+    await mq.connect()
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    metrics = prom.new(f"seal{os.urandom(3).hex()}")
+
+    record = JobRecord(1, "job-m", "job-m", "NORMAL",
+                       worker_id="w-stale")
+    record.fleet_fence = 1
+    record.fleet_fence_key = "k"
+    ctx = StageContext(config={}, emitter=EventEmitter(),
+                       logger=NullLogger(), telemetry=Telemetry(mq),
+                       store=store, metrics=metrics, record=record)
+    uploader = Uploader(ctx)
+
+    # the newer leader (fence 2) already sealed this set
+    newer = done_marker_body(2, "w-new")
+    await store.put_object(STAGING_BUCKET, done_marker_name("job-m"),
+                           newer)
+    await uploader.write_done_marker("job-m")
+    assert await store.get_object(
+        STAGING_BUCKET, done_marker_name("job-m")) == newer  # untouched
+    assert counter_value(metrics.fleet_fenced_writes,
+                         op="done_marker") == 1
+    assert any(e["kind"] == "fenced_write"
+               for e in record.recorder.events())
+
+    # a fresh seal under our own fence writes a parseable fenced marker
+    await uploader.write_done_marker("job-fresh")
+    marker = parse_done_marker(await store.get_object(
+        STAGING_BUCKET, done_marker_name("job-fresh")))
+    assert marker == {"done": True, "fence": 1}
+    # and an UNfenced job still writes the reference-parity literal
+    record.fleet_fence = None
+    await uploader.write_done_marker("job-plain")
+    assert await store.get_object(
+        STAGING_BUCKET, done_marker_name("job-plain")) == b"true"
+
+
+# ---------------------------------------------------------------------------
+# Asymmetric partition: degrade-to-uncoordinated + GC stand-down
+# ---------------------------------------------------------------------------
+
+async def test_asymmetric_partition_degrades_to_uncoordinated(tmp_path):
+    """Reads pass, conditional puts fail (the classic degraded bucket):
+    coordinate() must degrade to UNCOORDINATED — never raise into the
+    job — leaving the caller to fetch alone (the pre-fleet path), with
+    the error counted."""
+    coord = MemoryCoordStore()
+    plane = FleetPlane(coord, "w0", store=None)
+    cache = ContentCache(str(tmp_path / "cache"))
+    filled = []
+
+    async def origin_fill():
+        filled.append(1)
+
+    _install([{"seam": "coord.*", "kind": "partition", "mode": "writes"}])
+    try:
+        outcome = await plane.coordinate("k1", cache, origin_fill)
+    finally:
+        faults.uninstall()
+    assert outcome == "uncoordinated"
+    # the fill did NOT run under a (failed) lease — the caller owns the
+    # uncoordinated fetch, exactly the pre-fleet behavior
+    assert filled == []
+    assert plane.stats["coordErrors"] >= 1
+    assert plane.stats["uncoordinatedFallbacks"] == 1
+
+
+async def test_bucket_coord_asymmetric_partition_lease_degrades(
+        tmp_path):
+    """The bucket backend under reads-ok/conditional-puts-failing: a
+    pre-partition lease doc stays READABLE (a waiter can still see the
+    leader), while acquire/renew/release writes fail — coordinate()
+    degrades to uncoordinated, and the pre-existing doc is untouched."""
+    from downloader_tpu.fleet import BucketCoordStore
+
+    store = InMemoryObjectStore()
+    coord = BucketCoordStore(store, bucket=STAGING_BUCKET,
+                             settle_delay=0.0)
+    token = await coord.put(LEASES_PREFIX + "held", {
+        "owner": "other", "fence": 3,
+        "expiresAt": time.time() + 3600,
+    })
+    assert token is not None
+    _install([{"seam": "coord.*", "kind": "partition", "mode": "writes"}])
+    try:
+        # reads pass: the partition is asymmetric
+        doc, _tok = await coord.get(LEASES_PREFIX + "held")
+        assert doc["fence"] == 3
+        assert LEASES_PREFIX + "held" in await coord.list_keys(
+            LEASES_PREFIX)
+        # conditional puts fail -> the plane degrades, never raises
+        plane = FleetPlane(coord, "w0", store=None, poll_interval=0.02,
+                           max_wait=0.2)
+        cache = ContentCache(str(tmp_path / "cache"))
+
+        async def origin_fill():
+            pass
+
+        outcome = await plane.coordinate("fresh", cache, origin_fill)
+        assert outcome == "uncoordinated"
+        assert plane.stats["coordErrors"] >= 1
+    finally:
+        faults.uninstall()
+    # the peer's doc survived the whole partitioned episode
+    doc, _tok = await coord.get(LEASES_PREFIX + "held")
+    assert doc == {"owner": "other", "fence": 3,
+                   "expiresAt": doc["expiresAt"]}
+
+
+async def test_gc_stands_down_when_lease_view_partitioned(tmp_path):
+    """A manifest-less shared-tier entry (possibly a live peer's
+    in-flight spill) must NOT be reclaimed while the lease view is
+    unreadable — the sweeper skips, garbage waits a sweep, and a
+    healthy sweep still reclaims it afterwards."""
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    plane = FleetPlane(MemoryCoordStore(), "w0", store=store,
+                       shared_max_age=3600.0)
+    husk = ".fleet-cache/mystery/files/x.bin"
+    await store.put_object(STAGING_BUCKET, husk, b"x" * 256)
+
+    await plane.gc_once()  # first sighting: noted, not reclaimed
+    assert await store.get_object(STAGING_BUCKET, husk)
+
+    _install([{"seam": "coord.list", "kind": "partition"}])
+    try:
+        out = await plane.gc_once()  # lease view dark: STAND DOWN
+    finally:
+        faults.uninstall()
+    assert out["shared_evicted"] == 0
+    assert await store.get_object(STAGING_BUCKET, husk)
+
+    # healed: the pre-partition sighting survived the stand-down, so
+    # this sweep is the second consecutive sighting — reclaimed now
+    out = await plane.gc_once()
+    assert out["shared_evicted"] == 1
+    with pytest.raises(KeyError):
+        await store.get_object(STAGING_BUCKET, husk)
+
+
+async def test_gc_skips_live_peer_leased_key_under_write_partition(
+        tmp_path):
+    """Writes failing, reads passing: the sweeper CAN see the peer's
+    live lease and must keep skipping its manifest-less in-flight
+    spill."""
+    coord = MemoryCoordStore()
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    sweeper = FleetPlane(coord, "w0", store=store, shared_max_age=0.01)
+    peer = FleetPlane(coord, "w1", store=store)
+    lease = await peer.try_acquire_lease("spilling")
+    assert lease is not None
+    spill = ".fleet-cache/spilling/files/part.bin"
+    await store.put_object(STAGING_BUCKET, spill, b"p" * 256)
+    _install([{"seam": "coord.put", "kind": "partition",
+               "mode": "writes"}])
+    try:
+        for _ in range(3):
+            out = await sweeper.gc_once()
+            assert out["shared_evicted"] == 0
+    finally:
+        faults.uninstall()
+    assert await store.get_object(STAGING_BUCKET, spill)
+    await peer.release_lease("spilling")
+
+
+# ---------------------------------------------------------------------------
+# fleet.max_wait ages across coordination errors (flap livelock bound)
+# ---------------------------------------------------------------------------
+
+async def test_max_wait_budget_carries_across_coordinate_calls(tmp_path):
+    coord = MemoryCoordStore()
+    # a live peer lease that never goes away: the waiter can only wait
+    await coord.put(LEASES_PREFIX + "k", {
+        "owner": "other", "fence": 1,
+        "acquiredAt": time.time(),
+        "expiresAt": time.time() + 3600,
+    })
+    plane = FleetPlane(coord, "w0", store=None, lease_ttl=20.0,
+                       poll_interval=0.02, max_wait=0.3)
+    cache = ContentCache(str(tmp_path / "cache"))
+    record = JobRecord(1, "job-w", "job-w", "NORMAL")
+    fills = []
+
+    async def origin_fill():
+        fills.append(1)
+
+    started = time.monotonic()
+    outcome = await plane.coordinate("k", cache, origin_fill,
+                                     record=record)
+    first_wall = time.monotonic() - started
+    assert outcome == "uncoordinated"
+    assert first_wall >= 0.25
+    assert record.fleet_waited_s >= 0.25
+
+    # the SAME job re-enters (flap/redelivery): the budget is spent —
+    # no fresh 0.3 s park, near-immediate uncoordinated fallback
+    started = time.monotonic()
+    outcome = await plane.coordinate("k", cache, origin_fill,
+                                     record=record)
+    assert outcome == "uncoordinated"
+    assert time.monotonic() - started < 0.15
+
+
+async def test_registry_carries_fleet_wait_across_redelivery():
+    registry = JobRegistry()
+    first = registry.register("job-f", "job-f")
+    first.fleet_waited_s = 12.5
+    first.state = "FAILED"  # the park-then-nack terminal posture
+    redelivered = registry.register("job-f", "job-f")
+    assert redelivered.fleet_waited_s == 12.5
+    # a DONE prior is a genuine resubmission: fresh budget
+    redelivered.state = "DONE"
+    fresh = registry.register("job-f", "job-f")
+    assert fresh.fleet_waited_s == 0.0
+
+
+async def test_flapping_coord_store_never_fails_jobs(tmp_path):
+    """A flapping coordination store (periodic write partition) under
+    repeated coordinate() calls: every call resolves — lead,
+    uncoordinated, or a bounded wait — and the origin fill always runs
+    for the winner; nothing raises into the job."""
+    coord = MemoryCoordStore()
+    plane = FleetPlane(coord, "w0", store=None, poll_interval=0.02,
+                       max_wait=0.5, lease_ttl=0.5)
+    cache = ContentCache(str(tmp_path / "cache"))
+    injector = _install([{"seam": "coord.*", "kind": "flap",
+                          "period_s": 60.0, "duty": 0.5,
+                          "mode": "writes"}])
+    outcomes = []
+    try:
+        for i in range(6):
+            record = JobRecord(i, f"job-{i}", f"job-{i}", "NORMAL")
+
+            async def origin_fill():
+                pass
+
+            # pin the flap phase per call: odd = partitioned half of
+            # the cycle, even = healthy half (period >> call duration,
+            # so the phase cannot drift mid-call)
+            _elapsed(injector, 10.0 if i % 2 else 40.0)
+            outcomes.append(await plane.coordinate(
+                f"key-{i}", cache, origin_fill, record=record))
+    finally:
+        faults.uninstall()
+    assert outcomes == ["led", "uncoordinated"] * 3
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: the degraded soak scenario (subprocess fleet)
+# ---------------------------------------------------------------------------
+
+async def test_degraded_soak_smoke(tmp_path):
+    """The full degraded-world scenario against a REAL 2-worker
+    subprocess fleet: a SIGSTOP/SIGCONT stall past the (shortened)
+    lease TTL on one worker plus a windowed store brownout on the
+    other, under the mixed workload.  Every SLO guard must hold —
+    crucially zero FAILED/DROPPED_POISON despite the stall and zero
+    staged-byte divergence despite any split-brain window — and the
+    brownout must open the store breaker via the SLOW policy while the
+    window is live."""
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.soak import (SoakProfile, brownout_shed_seconds,
+                                     slow_opens_total)
+
+    profile = SoakProfile.degraded(jobs=12, max_wall=90.0)
+    world = await SoakTestWorld.create(str(tmp_path), profile)
+    try:
+        report = await world.rig.run(world.workload)
+    finally:
+        await world.close()
+    assert report.ok, report.summary()
+    assert world.rig.stalls_delivered == 1
+
+    samples = world.rig.samples
+    # the brownout opened the breaker via the slow-call policy, within
+    # its 6 s window — the shed the profile exists for
+    assert slow_opens_total(samples, "store") >= 1
+    anchor = (world.rig.slots[0].ready_mono
+              + profile.brownout_start_s)
+    shed = brownout_shed_seconds(samples, anchor, "store")
+    assert shed is not None
+    assert shed <= 8.0  # window_s + sampling/ramp slack
+    # split-brain check: the byte-identity guard doubles as the
+    # stale-write oracle — zero divergent staged bytes
+    assert world.rig.world.byte_mismatches == []
