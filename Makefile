@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded chaos crash degraded fleet obs origins soak soak-smoke proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo chaos crash degraded fleet obs origins slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -70,6 +70,24 @@ soak:
 soak-smoke:
 	python -m pytest tests/test_soak.py -v -m "not slow"
 
+# the full 100k-job capacity profile (ROADMAP item 5's standing entry
+# point): the same test_soak_full guards, resized via the SOAK_* env
+# knobs — hours of wall clock, opt-in before capacity-sensitive
+# releases, deliberately NOT a CI job (docs/OPERATIONS.md
+# "Capacity & SLOs")
+soak-full:
+	SOAK_JOBS=100000 SOAK_WORKERS=3 SOAK_PUBLISH_RATE=60 \
+	SOAK_MAX_WALL=7200 SOAK_KILLS=20 SOAK_KILL_INTERVAL=120 \
+	python -m pytest tests/test_soak.py::test_soak_full -v -m slow
+
+# SLO plane suite (ISSUE 15): burn-rate/budget math against
+# hand-computed windows, settle classification, the /readyz slo block,
+# heartbeat digests + the aggregated fleet overview (mixed-shape
+# compat, brownout-bounded peer/coord queries, degradation contract),
+# per-hop budget guard, and the 3-worker fleet-overview acceptance run
+slo:
+	python -m pytest tests/test_slo.py tests/test_overview.py -v
+
 # graftlint (downloader_tpu/analysis, docs/ANALYSIS.md): the repo-
 # invariant static analyzer over the full tree (JSON for CI parsing),
 # then the tier-1 gate (zero unsuppressed findings + <10 s budget +
@@ -128,6 +146,13 @@ bench-soak:
 # split_brain_stale_writes must stay 0)
 bench-degraded:
 	python bench.py --degraded
+
+# standalone SLO-plane bench (one JSON line: slo_overhead_ms must stay
+# < 1 ms/job; fleet_overview_age_s must sit under 2x the heartbeat
+# interval in steady state; hop_budget_ok = every hop inside its
+# BASELINE_HOPS.json budget, failures name the guilty hop)
+bench-slo:
+	python bench.py --slo
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

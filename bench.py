@@ -167,7 +167,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  a resumed stale leader must not land a byte anywhere the fleet
 #  trusts); degraded_fenced_writes rides along unguarded (nonzero only
 #  when the stall actually caught a lease holder).
-HARNESS_VERSION = 19
+# v20 (r19): SLO plane (``--slo`` / `make bench-slo`, ISSUE 15):
+#  slo_overhead_ms = per-job cost of the in-process SLO tracker
+#  (settle classification + the scrape-cadence snapshot) as an
+#  enabled-minus-disabled A/B over the recorder-bench registry walk,
+#  guard < 1 ms/job; fleet_overview_age_s = steady-state staleness of
+#  the aggregated fleet-overview doc across a 3-plane in-process fleet,
+#  guard <= 2x the heartbeat interval; hop_budget_ok = every hop's
+#  measured seconds-per-GB (one calibration-shaped end-to-end job)
+#  inside its checked-in BASELINE_HOPS.json budget — failures NAME the
+#  guilty hop (the per-hop ratchet ROADMAP item 2's zero-copy work
+#  lands against).  ``--calibrate-hops`` re-measures and rewrites
+#  BASELINE_HOPS.json (docs/OPERATIONS.md recalibration procedure).
+HARNESS_VERSION = 20
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2378,6 +2390,260 @@ def _bench_degraded_safe() -> dict:
         }
 
 
+BASELINE_HOPS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE_HOPS.json")
+
+
+async def _hop_calibration_job(tag: str, mib: int = 48,
+                               no_splice: bool = False) -> dict:
+    """One calibration-shaped end-to-end job (the bench v16 coverage
+    workload: barrier dispatch, loopback HTTP origin, real-wire MiniS3)
+    — returns the settled job's ``{hop: seconds_per_gb}`` for every
+    hop heavy enough to carry a per-GB figure.  The SAME workload
+    ``--calibrate-hops`` baselines and ``--slo`` asserts, so the budget
+    comparison is apples-to-apples.
+
+    ``no_splice`` forces the chunked fallback (HTTP_NO_SPLICE), so the
+    calibration covers BOTH ingress regimes: the ``splice`` fast path
+    and the ``socket_read``/``disk_write`` pair."""
+    import sys as _sys
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.control.registry import DONE
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store.s3 import S3ObjectStore
+
+    tests_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests")
+    if tests_dir not in _sys.path:
+        _sys.path.insert(0, tests_dir)
+    from minis3 import MiniS3
+
+    payload = b"S" * (mib << 20)
+
+    async def serve(_request):
+        return web.Response(body=payload,
+                            headers={"ETag": f'"slo-{tag}"'})
+
+    app = web.Application()
+    app.router.add_get("/m.mkv", serve)
+    media_runner = web.AppRunner(app)
+    await media_runner.setup()
+    site = web.TCPSite(media_runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    s3 = MiniS3()
+    await s3.start()
+    client = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA",
+                           "SECRET")
+    splice_env = os.environ.pop("HTTP_NO_SPLICE", None)
+    if no_splice:
+        os.environ["HTTP_NO_SPLICE"] = "1"
+    try:
+        with tempfile.TemporaryDirectory() as work:
+            broker = InMemoryBroker()
+            telem_mq = MemoryQueue(broker)
+            await telem_mq.connect()
+            orchestrator = Orchestrator(
+                config=ConfigNode({"instance": {
+                    "download_path": os.path.join(work, "dl"),
+                    "max_concurrent_jobs": 1,
+                    # barrier: one stage at a time, so the per-hop
+                    # rates are not contention-diluted by overlap
+                    "pipeline": "barrier",
+                }}),
+                mq=MemoryQueue(broker), store=client,
+                telemetry=Telemetry(telem_mq), logger=NullLogger(),
+            )
+            await orchestrator.start()
+            try:
+                job_id = f"slo-cal-{tag}"
+                msg = schemas.Download(media=schemas.Media(
+                    id=job_id, creator_id="c",
+                    type=schemas.MediaType.Value("MOVIE"),
+                    source=schemas.SourceType.Value("HTTP"),
+                    source_uri=f"http://127.0.0.1:{port}/m.mkv",
+                ))
+                broker.publish(schemas.DOWNLOAD_QUEUE,
+                               schemas.encode(msg))
+                await broker.join(schemas.DOWNLOAD_QUEUE, timeout=120)
+                record = orchestrator.registry.get(job_id)
+                assert record.state == DONE, record.state
+                summary = record.hops.summary()
+            finally:
+                await orchestrator.shutdown(grace_seconds=5)
+    finally:
+        os.environ.pop("HTTP_NO_SPLICE", None)
+        if splice_env is not None:
+            os.environ["HTTP_NO_SPLICE"] = splice_env
+        await client.close()
+        await s3.stop()
+        await media_runner.cleanup()
+    return {hop: entry["secondsPerGb"]
+            for hop, entry in summary.items()
+            if "secondsPerGb" in entry}
+
+
+async def _hop_calibration_arms(tag: str) -> dict:
+    """Both ingress regimes' ``{hop: seconds_per_gb}``, merged (a hop
+    measured by both arms keeps its WORST value — the conservative
+    side of a budget guard)."""
+    spliced = await _hop_calibration_job(f"{tag}-splice")
+    chunked = await _hop_calibration_job(f"{tag}-chunk", no_splice=True)
+    merged = dict(spliced)
+    for hop, value in chunked.items():
+        merged[hop] = max(merged.get(hop, 0.0), value)
+    return merged
+
+
+async def bench_slo() -> dict:
+    """SLO-plane metrics (harness v20; ISSUE 15 acceptance trio).
+
+    - ``slo_overhead_ms``: per-job cost of the in-process SLO tracker —
+      settle classification plus a scrape-cadence snapshot — as an
+      enabled-minus-disabled A/B over the recorder-bench registry
+      walk; guard < 1 ms/job (the PR 9 discipline: observability that
+      taxes the hot path gets turned off in anger, so it must be free).
+    - ``fleet_overview_age_s``: steady-state staleness of the
+      aggregated overview doc across a 3-plane in-process fleet
+      (MemoryCoordStore, short heartbeats); guard <= 2x the heartbeat
+      interval — the elected aggregator must fold every beat.
+    - ``hop_budget_ok``: one calibration-shaped end-to-end job's
+      per-hop seconds-per-GB asserted against BASELINE_HOPS.json; a
+      breach names the guilty hop in ``hop_budget_failures``.
+    """
+    from downloader_tpu.control.registry import JobRegistry
+    from downloader_tpu.control.slo import (Objective, SloTracker,
+                                            evaluate_hop_budgets)
+    from downloader_tpu.fleet.plane import FleetPlane, MemoryCoordStore
+
+    jobs = 2000
+
+    # -- tracker overhead (enabled minus disabled A/B) ------------------
+    def _settle_walk(tracker) -> float:
+        registry = JobRegistry(terminal_ring=0)
+        t0 = time.perf_counter()
+        for i in range(jobs):
+            record = registry.register(f"slo-bench-{i}", "card",
+                                       priority="NORMAL")
+            record.note_hop("socket_read", 1 << 20, 0.001)
+            record.note_hop("upload", 1 << 20, 0.002)
+            record.stage_seconds["pipeline"] = 0.25
+            if tracker is not None:
+                tracker.note_settle(record, "ack", "done")
+                if i % 100 == 0:
+                    tracker.snapshot()  # the scrape-cadence cost
+        return (time.perf_counter() - t0) * 1000.0 / jobs
+
+    objectives = {name: Objective(name, p99, avail)
+                  for name, (p99, avail) in
+                  {"HIGH": (30000.0, 0.999), "NORMAL": (60000.0, 0.999),
+                   "BULK": (300000.0, 0.99)}.items()}
+    enabled_ms = _settle_walk(SloTracker(objectives))
+    disabled_ms = _settle_walk(None)
+    slo_ms = max(enabled_ms - disabled_ms, 0.0)
+
+    # -- fleet overview staleness ---------------------------------------
+    heartbeat = 0.5
+    coord = MemoryCoordStore()
+    planes = [
+        FleetPlane(
+            coord, f"slo-bench-w{i}",
+            heartbeat_interval=heartbeat, liveness_ttl=4 * heartbeat,
+            digest_fn=lambda i=i: {
+                "burn": {"NORMAL": {"fast": 0.0, "slow": 0.0}},
+                "budget": {"NORMAL": 1.0},
+                "tenantQueued": {"default": i},
+                "hops": {}, "hopSeconds": 0.0, "stageSeconds": 0.0,
+            },
+        )
+        for i in range(3)
+    ]
+    try:
+        for plane in planes:
+            await plane.start()
+            await asyncio.sleep(0.05)  # deterministic oldest
+        # several beats of steady state, then sample every plane's age
+        await asyncio.sleep(5 * heartbeat)
+        ages = [plane.overview_age() for plane in planes]
+        overview = await planes[-1].fetch_overview()
+    finally:
+        for plane in planes:
+            await plane.stop()
+    age_ok = (all(age is not None for age in ages)
+              and max(age for age in ages if age is not None)
+              <= 2.0 * heartbeat)
+    members = len((overview or {}).get("workers") or [])
+
+    # -- per-hop regression budgets -------------------------------------
+    measured = await _hop_calibration_arms("bench")
+    try:
+        with open(BASELINE_HOPS_PATH, "r", encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except OSError:
+        baseline = {"hops": {}}
+    budget_ok, failures = evaluate_hop_budgets(measured, baseline)
+
+    out = {
+        "slo_overhead_ms": round(slo_ms, 4),
+        "slo_overhead_ok": slo_ms < 1.0,
+        "fleet_overview_age_s": round(
+            max((age for age in ages if age is not None),
+                default=-1.0), 3),
+        "fleet_overview_age_ok": age_ok,
+        "fleet_overview_members": members,
+        "hop_budget_ok": budget_ok,
+        "slo_ok": bool(slo_ms < 1.0 and age_ok and members == 3
+                       and budget_ok),
+    }
+    if failures:
+        out["hop_budget_failures"] = failures[:4]
+    out["hop_s_per_gb"] = {hop: round(v, 3)
+                           for hop, v in sorted(measured.items())}
+    return out
+
+
+def _bench_slo_safe() -> dict:
+    """An SLO-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_slo())
+    except Exception as err:
+        return {"slo_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+def calibrate_hops(reps: int = 5, headroom: float = 4.0) -> dict:
+    """``--calibrate-hops``: re-measure the calibration workload and
+    rewrite BASELINE_HOPS.json (p50/p99/budget per hop).  Run on a
+    quiet host after a DELIBERATE hop-cost change only — see the
+    docs/OPERATIONS.md recalibration procedure."""
+    from downloader_tpu.control.slo import hop_budget_baseline
+
+    async def _runs() -> dict:
+        samples: dict = {}
+        for rep in range(reps):
+            measured = await _hop_calibration_arms(f"cal{rep}")
+            for hop, value in measured.items():
+                samples.setdefault(hop, []).append(value)
+        return samples
+
+    samples = asyncio.run(_runs())
+    doc = hop_budget_baseline(samples, headroom=headroom)
+    doc["calibrated_with"] = (
+        f"python bench.py --calibrate-hops (harness v{HARNESS_VERSION},"
+        f" {reps} reps, 48 MiB barrier HTTP->MiniS3 job)")
+    with open(BASELINE_HOPS_PATH, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
 # Final-line headline keys, in keep-priority order (first = kept
 # longest under the size cap).  ~15 keys: the driver's 2,000-char tail
 # capture must always see the full final line (VERDICT r5 item 1);
@@ -2432,6 +2698,13 @@ HEADLINE_KEYS = [
                                   # the brownout window)
     "split_brain_stale_writes",   # r18 guard: == 0 (fencing held)
     "degraded_bench_error",       # present only on failure — visible
+    "slo_ok",                     # r19: overhead + overview age + hop
+                                  # budgets all green
+    "slo_overhead_ms",            # r19 guard: SLO tracker < 1 ms/job
+    "fleet_overview_age_s",       # r19 guard: <= 2x heartbeat interval
+    "hop_budget_ok",              # r19 guard: every hop inside its
+                                  # BASELINE_HOPS.json budget
+    "slo_bench_error",            # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -2490,6 +2763,14 @@ def main() -> None:
         # standalone degraded-world soak run (`make bench-degraded`)
         print(json.dumps(_bench_degraded_safe()))
         return
+    if "--slo" in sys.argv:
+        # standalone SLO-plane run (`make bench-slo`)
+        print(json.dumps(_bench_slo_safe()))
+        return
+    if "--calibrate-hops" in sys.argv:
+        # rewrite BASELINE_HOPS.json from a fresh calibration run
+        print(json.dumps(calibrate_hops()))
+        return
     pipeline = asyncio.run(bench_pipeline())
     extra = {
         "harness_version": HARNESS_VERSION,
@@ -2516,6 +2797,7 @@ def main() -> None:
         **_bench_racing_safe(),
         **_bench_soak_safe(),
         **_bench_degraded_safe(),
+        **_bench_slo_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

@@ -72,7 +72,7 @@ CONFIG_SECTIONS = frozenset({
     "instance", "minio", "rabbitmq", "services", "store", "tracing",
     "health", "control", "retry", "breakers", "faults", "tenants",
     "overload", "origins", "fleet", "journal", "integrity", "obs",
-    "wire_remap",
+    "wire_remap", "slo",
 })
 
 #: documented knobs that are deliberately not read via cfg_get /
@@ -322,6 +322,10 @@ BOUNDED_LABELS = frozenset({
     "origin",       # bounded by origins.max_labels (overflow -> other)
     "prefix",       # the three coordination-store key prefixes
                     # (workers/leases/telemetry — fleet/plane.py literals)
+    "class",        # SLO objective names: the priority-class enum plus
+                    # config-bounded tenant-objective keys
+                    # (control/slo.py SloTracker.from_config)
+    "window",       # the fast|slow burn-rate window pair (literals)
 })
 
 _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
@@ -548,4 +552,92 @@ def check_seam_coverage(ctx: RepoContext) -> List[Finding]:
                 f'Retrier seam "{seam}" has no faults.fire() hook in '
                 f'its family "{family}" — the chaos suite cannot '
                 "inject failures at this seam (make chaos blind spot)"))
+    return out
+
+
+# -- event drift --------------------------------------------------------
+
+#: regex for a catalog-able event name (the flight-recorder kinds are
+#: all lower_snake identifiers)
+_EVENT_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+def _catalog_events(architecture_md: str) -> Set[str]:
+    """Event kinds documented in the ARCHITECTURE.md event-schema
+    catalog: every backticked identifier in the FIRST column of the
+    markdown table rows inside the flight-recorder section (rows like
+    ``| `queue_wait` / `sched_wait` | ... |`` contribute both names)."""
+    match = re.search(
+        r"^### Per-job flight recorder.*?(?=^### |^## |\Z)",
+        architecture_md, re.DOTALL | re.MULTILINE)
+    section = match.group(0) if match else ""
+    out: Set[str] = set()
+    for line in section.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = stripped.split("|")
+        if len(cells) < 3:
+            continue
+        out.update(_EVENT_NAME_RE.findall(cells[1]))
+    return out
+
+
+def _emitted_events(modules: Iterable[ModuleSource]):
+    """(event name, path, line) for every literal flight-recorder event
+    emitted in the package: ``<record>.event("<kind>", ...)`` and the
+    origin plane's ``self._event("<kind>", ...)`` wrapper, plus direct
+    ``<recorder>.record("<kind>", ...)`` calls (receiver named
+    *recorder — a bare ``.record`` is too common a method name)."""
+    out = []
+    for module in modules:
+        if module.tree is None:
+            continue
+        for node in module.nodes:
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr == "record":
+                receiver = func.value
+                rname = receiver.attr if isinstance(
+                    receiver, ast.Attribute) else (
+                    receiver.id if isinstance(receiver, ast.Name)
+                    else "")
+                if not rname.lower().endswith("recorder"):
+                    continue
+            elif func.attr not in ("event", "_event"):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic kind: the wrapper seams themselves
+            out.append((arg.value, module.rel_path, node.lineno))
+    return out
+
+
+@repo_checker(
+    "event-drift",
+    "Every FlightRecorder event kind emitted in downloader_tpu/ "
+    "(record.event(\"<kind>\") / self._event(\"<kind>\") / "
+    "recorder.record(\"<kind>\")) must appear in the "
+    "docs/ARCHITECTURE.md event-schema catalog table — the per-job "
+    "timeline is an operator API, and PRs 10/14 shipped "
+    "origin_probe/range_assign/fenced-write events that drifted past "
+    "the PR 3 docs unnoticed.")
+def check_event_drift(ctx: RepoContext) -> List[Finding]:
+    out: List[Finding] = []
+    catalog = _catalog_events(getattr(ctx, "architecture_md", ""))
+    flagged: Set[str] = set()
+    for name, path, line in _emitted_events(ctx.package_modules()):
+        if name in catalog or name in flagged:
+            continue
+        flagged.add(name)  # one finding per kind, at its first emitter
+        out.append(Finding(
+            "event-drift", path, line,
+            f'flight-recorder event "{name}" is not in the '
+            "docs/ARCHITECTURE.md event catalog (the Per-job flight "
+            "recorder table) — document its fields and emitter before "
+            "it ships"))
     return out

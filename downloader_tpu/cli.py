@@ -214,6 +214,22 @@ def _build_parser() -> argparse.ArgumentParser:
     fleet_show.add_argument("id", help="worker id (see `fleet list`)")
     fleet_show.add_argument("--url", default="http://127.0.0.1:3401",
                             help="service base URL")
+    fleet_top = fleet_sub.add_parser(
+        "top", help="live-refreshing fleet overview console (GET "
+                    "/v1/fleet/overview): members, burn rates, open "
+                    "breakers, tenant queue shares, top hops"
+    )
+    fleet_top.add_argument("--url", default="http://127.0.0.1:3401",
+                           help="service base URL (any worker serves "
+                                "the aggregated view)")
+    fleet_top.add_argument("--interval", type=float, default=2.0,
+                           help="refresh cadence, seconds (default 2)")
+    fleet_top.add_argument("--once", action="store_true",
+                           help="render one frame and exit (no screen "
+                                "clearing — scriptable)")
+    fleet_top.add_argument("--json", action="store_true",
+                           help="raw JSON frames instead of the console "
+                                "view (JSONL with --interval looping)")
 
     trace = sub.add_parser(
         "trace", help="cross-worker trace timelines (GET /v1/trace/{id}: "
@@ -588,6 +604,128 @@ async def _jobs(args) -> int:
             return 2
 
 
+def render_overview(body: dict) -> list:
+    """The `fleet top` frame lines for one GET /v1/fleet/overview body
+    (pure: unit-testable without a terminal or a fleet)."""
+    lines = []
+    overview = body.get("overview") or {}
+    totals = overview.get("totals") or {}
+    degraded = body.get("degraded", False)
+    header = (f"# fleet overview via {body.get('workerId')}"
+              + (f"  age={body.get('overviewAgeSeconds')}s"
+                 if body.get("overviewAgeSeconds") is not None else "")
+              + (f"  aggregated by {overview.get('updatedBy')}"
+                 if overview.get("updatedBy") else "")
+              + ("  [DEGRADED: local view only]" if degraded else ""))
+    lines.append(header)
+    for err in body.get("errors") or []:
+        lines.append(f"# error: {err}")
+    members = overview.get("workers")
+    if members is None:
+        # degraded to local-only: render this worker's own view so the
+        # console stays useful mid-incident
+        local = body.get("local") or {}
+        members = [{"workerId": local.get("workerId"),
+                    "signals": local.get("signals"),
+                    "digest": local.get("digest"),
+                    "heartbeatAt": None, "leases": "-"}]
+    import time as _time
+
+    now = _time.time()
+    lines.append("WORKER            QUEUE ACTIVE LEASES  "
+                 "BURN fast/slow (worst)   BREAKERS          BEAT")
+    for member in members:
+        signals = member.get("signals") or {}
+        digest = member.get("digest")
+        burn = "-"
+        breakers = "-"
+        if isinstance(digest, dict):
+            rates = digest.get("burn") or {}
+            if rates:
+                worst = max(
+                    rates.items(),
+                    key=lambda kv: ((kv[1] or {}).get("fast", 0.0),
+                                    (kv[1] or {}).get("slow", 0.0)))
+                burn = (f"{worst[0]} "
+                        f"{(worst[1] or {}).get('fast', 0):.2f}/"
+                        f"{(worst[1] or {}).get('slow', 0):.2f}")
+            open_breakers = digest.get("openBreakers") or {}
+            if open_breakers:
+                breakers = ",".join(
+                    f"{dep}:{(info or {}).get('reason') or 'open'}"
+                    for dep, info in sorted(open_breakers.items()))
+        elif digest is None:
+            burn = "(no digest)"  # pre-digest worker: listed, not lost
+        beat = member.get("heartbeatAt")
+        beat_s = (f"{max(now - float(beat), 0.0):.1f}s"
+                  if isinstance(beat, (int, float)) else "-")
+        lines.append(
+            f"{str(member.get('workerId'))[:17]:<17} "
+            f"{signals.get('queue_depth', '-'):>5} "
+            f"{signals.get('active_jobs', '-'):>6} "
+            f"{str(member.get('leases', '-')):>6}  "
+            f"{burn:<24} {breakers:<17} {beat_s}")
+    shares = totals.get("tenantShares") or {}
+    if shares:
+        lines.append("tenant queue shares: " + "  ".join(
+            f"{tenant}={share:.0%}"
+            for tenant, share in sorted(shares.items())))
+    hops = totals.get("topHops") or []
+    if hops:
+        lines.append("top hops (s/GB): " + "  ".join(
+            f"{h.get('hop')}={h.get('secondsPerGb')}" for h in hops))
+    ratio = totals.get("hopReconcileRatioMixed")
+    if ratio is not None:
+        lines.append(f"hop/stage reconcile (mixed, unguarded): {ratio}")
+    return lines
+
+
+async def _fleet_top(args) -> int:
+    """`cli fleet top`: a live-refreshing console over GET
+    /v1/fleet/overview — the fleet's burn rates, breakers, tenant
+    shares, and worst hops on one screen, from any worker."""
+    import json
+
+    import aiohttp
+
+    base = args.url.rstrip("/")
+    timeout = aiohttp.ClientTimeout(total=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        while True:
+            try:
+                async with session.get(
+                        f"{base}/v1/fleet/overview") as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as err:
+                print(f"{base}: unreachable ({err})", file=sys.stderr)
+                if args.once:
+                    return 2
+                # a refreshing console must SURVIVE one dropped
+                # connection or a worker restart — mid-incident is
+                # exactly when the operator is watching; keep the
+                # last frame on screen and retry next interval
+                await asyncio.sleep(max(args.interval, 0.2))
+                continue
+            if args.json:
+                print(json.dumps(body, sort_keys=True))
+            else:
+                if not args.once:
+                    # clear + home: a refreshing console, not a scroll
+                    print("\x1b[2J\x1b[H", end="")
+                for line in render_overview(body):
+                    print(line)
+            if args.once:
+                return 0
+            try:
+                await asyncio.sleep(max(args.interval, 0.2))
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                return 0
+
+
 async def _fleet(args) -> int:
     """Drive the fleet endpoints (mirrors the `jobs` UX)."""
     import json
@@ -595,6 +733,8 @@ async def _fleet(args) -> int:
 
     import aiohttp
 
+    if args.fleet_command == "top":
+        return await _fleet_top(args)
     base = args.url.rstrip("/")
     timeout = aiohttp.ClientTimeout(total=30)
     async with aiohttp.ClientSession(timeout=timeout) as session:

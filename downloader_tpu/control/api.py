@@ -19,6 +19,12 @@ serves probes, metrics, and operations:
     GET  /v1/fleet                  fleet membership: live workers (with
                                     heartbeat payloads), live content
                                     leases, this worker's fleet stats
+    GET  /v1/fleet/overview         the aggregated fleet overview doc
+                                    (burn rates, breakers, tenant queue
+                                    shares, top hops) the elected
+                                    aggregator folds each heartbeat;
+                                    coord trouble degrades to the local
+                                    view (degraded: true), never a 5xx
     GET  /v1/fleet/{id}             one worker's latest heartbeat doc
     GET  /v1/tenants                tenancy + overload posture: per-
                                     tenant weight/caps/quotas, live queue
@@ -42,6 +48,7 @@ parity posture for a service that previously had no API at all.
 
 from __future__ import annotations
 
+import asyncio
 import hmac
 import os
 from typing import Optional
@@ -179,6 +186,56 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
             )
         payload["heldLeases"] = plane.lease_snapshot()
         payload["stats"] = dict(plane.stats)
+        return web.json_response(payload)
+
+    async def fleet_overview(_request: web.Request) -> web.Response:
+        """The aggregated fleet overview (ISSUE 15): the one document
+        the elected aggregator folds every live member's heartbeat
+        digest into — fleet-wide tenant queue shares, worst-of-fleet
+        burn rates, open breakers per worker, top hops by
+        seconds-per-GB.  The trace-assembly degradation contract: any
+        coordination trouble (down, browned out past the 5 s budget)
+        serves the LOCAL view with ``degraded: true`` + a bounded
+        ``errors`` list — never a 5xx."""
+        plane = getattr(orchestrator, "fleet", None)
+        # the local view is always serveable — no I/O, no fleet
+        local = {"workerId": getattr(orchestrator, "worker_id", None)}
+        signals_fn = getattr(orchestrator, "autoscale_signals", None)
+        if callable(signals_fn):
+            try:
+                local["signals"] = dict(signals_fn())
+            except Exception:
+                pass
+        digest_fn = getattr(orchestrator, "slo_digest", None)
+        if callable(digest_fn):
+            try:
+                local["digest"] = dict(digest_fn())
+            except Exception:
+                pass
+        payload: dict = {
+            "enabled": plane is not None,
+            "workerId": getattr(orchestrator, "worker_id", None),
+            "local": local,
+            "overview": None,
+            "degraded": False,
+            "errors": [],
+        }
+        if plane is None:
+            return web.json_response(payload)
+        try:
+            doc = await plane.fetch_overview()
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            payload["degraded"] = True
+            payload["errors"].append(
+                f"coord overview: {type(err).__name__}: {err}"[:200])
+            doc = None
+        if doc is not None:
+            payload["overview"] = doc
+            age = plane.overview_age()
+            if age is not None:
+                payload["overviewAgeSeconds"] = round(age, 3)
         return web.json_response(payload)
 
     async def fleet_show(request: web.Request) -> web.Response:
@@ -324,6 +381,9 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     app.router.add_get("/v1/trace/{id}", trace_show)
     # fleet plane: membership, leases, per-worker heartbeat payloads
     app.router.add_get("/v1/fleet", fleet_list)
+    # the aggregated overview must register BEFORE the {id} route or
+    # "overview" would be captured as a worker id
+    app.router.add_get("/v1/fleet/overview", fleet_overview)
     app.router.add_get("/v1/fleet/{id}", fleet_show)
     # tenancy + overload: per-tenant weights/caps/quotas, live queue
     # depth and slot occupancy, and the saturation snapshot

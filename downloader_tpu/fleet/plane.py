@@ -77,6 +77,11 @@ LEASES_PREFIX = "leases/"
 # per-job trace digests: telemetry/<trace_id>/<worker_id>/<job_id> (on the bucket
 # backend that is `.fleet/telemetry/...` in the staging bucket)
 TELEMETRY_PREFIX = "telemetry/"
+# the one fleet-overview document the elected aggregator folds live
+# members into each heartbeat (ISSUE 15: the first fleet-WIDE view —
+# burn rates, breakers, tenant queue shares — any worker can serve)
+OVERVIEW_PREFIX = "overview/"
+OVERVIEW_KEY = OVERVIEW_PREFIX + "fleet"
 # shared-tier object layout in the staging bucket
 SHARED_PREFIX = ".fleet-cache/"
 MANIFEST_NAME = "manifest.json"
@@ -99,6 +104,10 @@ DEFAULT_TELEMETRY_TTL = 1800.0
 # events kept in one digest: enough for the lifecycle + failure tail,
 # bounded so a digest document stays a few KB
 DIGEST_EVENT_LIMIT = 48
+# per-read budget on the overview fetch (the trace assembler's
+# PEER_TIMEOUT posture): a browned-out coordination store must cost a
+# bounded wait and a degraded response, never a hung admin read
+OVERVIEW_FETCH_BUDGET = 5.0
 
 # a lease is only treated as dead once expired by this fraction of the
 # TTL: lease math compares the WRITER's wall clock against the READER's,
@@ -176,6 +185,7 @@ class FleetPlane:
         logger=None,
         retrier=None,
         payload_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        digest_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         if liveness_ttl <= heartbeat_interval:
             raise ValueError(
@@ -209,6 +219,17 @@ class FleetPlane:
         self.logger = logger
         self.retrier = retrier
         self.payload_fn = payload_fn
+        # compact SLO/health digest carried in every heartbeat
+        # (orchestrator.slo_digest: burn rates, open breakers, top
+        # hops, tenant queue shares) — the raw material the elected
+        # aggregator folds into the fleet-overview doc.  Optional by
+        # contract: a pre-PR-15 worker's heartbeat simply has no
+        # digest, and build_overview lists it with ``digest: null``.
+        self.digest_fn = digest_fn
+        # wall-clock ``updatedAt`` of the overview doc this worker last
+        # published or read (None until either happens) — the
+        # ``fleet_overview_age_seconds`` gauge's source
+        self._overview_updated_at: Optional[float] = None
         self.started_at = time.time()
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._gc_task: Optional[asyncio.Task] = None
@@ -245,7 +266,8 @@ class FleetPlane:
     @classmethod
     def from_config(cls, config, *, worker_id: str, store=None, coord=None,
                     metrics=None, logger=None, retrier=None,
-                    payload_fn=None) -> Optional["FleetPlane"]:
+                    payload_fn=None, digest_fn=None
+                    ) -> Optional["FleetPlane"]:
         """Build from ``fleet.*`` / env; None when the fleet is disabled
         (the default — a lone worker pays nothing for this subsystem).
 
@@ -310,7 +332,7 @@ class FleetPlane:
                 config, "fleet.telemetry_ttl", DEFAULT_TELEMETRY_TTL)),
             advertise_url=cfg_get(config, "fleet.advertise_url", None),
             metrics=metrics, logger=logger, retrier=retrier,
-            payload_fn=payload_fn,
+            payload_fn=payload_fn, digest_fn=digest_fn,
         )
 
     # -- plumbing -------------------------------------------------------
@@ -419,6 +441,14 @@ class FleetPlane:
                 doc["signals"] = dict(self.payload_fn())
             except Exception as err:  # a bad signal must not kill beats
                 doc["signalsError"] = str(err)[:120]
+        if self.digest_fn is not None:
+            # the SLO/health digest (burn rates, open breakers, top
+            # hops, tenant queue shares) — same failure posture as the
+            # autoscale signals: a broken digest must not kill beats
+            try:
+                doc["digest"] = dict(self.digest_fn())
+            except Exception as err:
+                doc["digestError"] = str(err)[:120]
         return doc
 
     async def _beat_once(self) -> None:
@@ -459,6 +489,15 @@ class FleetPlane:
                 raise
             except Exception as err:
                 self._note_coord_error("heartbeat", err)
+            try:
+                # fold (or track) the fleet overview on the same
+                # cadence — its own try: overview trouble must never
+                # starve the liveness beat above
+                await self._overview_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("overview", err)
             await asyncio.sleep(self.heartbeat_interval)
 
     async def start(self) -> None:
@@ -1004,6 +1043,75 @@ class FleetPlane:
                 op="fetched").inc(len(docs))
         return docs
 
+    # -- fleet overview --------------------------------------------------
+    def overview_age(self) -> Optional[float]:
+        """Seconds since the overview doc this worker last published or
+        read was written (wall clocks — heartbeats already compare
+        them); None until any overview has been seen.  The
+        ``fleet_overview_age_seconds`` gauge's source: in steady state
+        every worker refreshes its stamp each heartbeat, so a climbing
+        age means the aggregation (or the coordination store) stalled.
+        """
+        if self._overview_updated_at is None:
+            return None
+        return max(time.time() - self._overview_updated_at, 0.0)
+
+    def _note_overview(self, doc: Optional[dict]) -> None:
+        if doc is None:
+            return
+        try:
+            self._overview_updated_at = float(doc.get("updatedAt", 0))
+        except (TypeError, ValueError):
+            pass
+
+    async def _overview_tick(self) -> None:
+        """One heartbeat's worth of overview work.
+
+        Cheap-by-default election (the PR 7 GC-sweeper discipline,
+        without paying a membership listing on every worker every
+        beat): read the one overview doc first — if it is FRESH and
+        someone else wrote it, this worker's job is just to note the
+        age.  Only when the doc is stale/absent (the aggregator died)
+        or this worker wrote it last does it pay the listing, re-check
+        the oldest-live-worker election, and fold.  Self-stabilizing:
+        an aggregator's death makes the doc stale within ~2 beats,
+        every survivor then runs the election, the oldest wins, the
+        rest settle back to one GET per beat.
+        """
+        entry = await self.coord.get(OVERVIEW_KEY)
+        doc = entry[0] if entry is not None else None
+        self._note_overview(doc)
+        if doc is not None and doc.get("updatedBy") != self.worker_id:
+            age = time.time() - float(doc.get("updatedAt", 0) or 0)
+            if age < 2.0 * self.heartbeat_interval:
+                return  # a live aggregator owns it
+        workers = await self.workers()
+        if not workers or workers[0].get("workerId") != self.worker_id:
+            # not the oldest live worker — or an EMPTY liveness view
+            # (our own registration failed, or a partition/clock issue
+            # expired every heartbeat doc): stand down rather than
+            # have every worker "win" the election and publish an
+            # empty-members overview each beat mid-incident.  The doc
+            # just ages, which the staleness gauge surfaces honestly.
+            return
+        fresh = build_overview(self.worker_id, workers)
+        await self.coord.put(OVERVIEW_KEY, fresh, expect=ANY)
+        self._note_overview(fresh)
+
+    async def fetch_overview(self) -> Optional[dict]:
+        """The current fleet-overview doc (None when absent), bounded
+        by :data:`OVERVIEW_FETCH_BUDGET` — a browned-out coordination
+        store costs one bounded wait, never a hung admin read.  Raises
+        on coordination trouble (incl. the budget expiring): the
+        endpoint downgrades to its local view and says so, the
+        trace-assembly degradation contract."""
+        async with asyncio.timeout(OVERVIEW_FETCH_BUDGET):
+            entry = await self.coord.get(OVERVIEW_KEY)
+        if entry is None:
+            return None
+        self._note_overview(entry[0])
+        return entry[0]
+
     # -- shared-tier / tombstone GC -------------------------------------
     async def _should_gc(self) -> bool:
         """Elect one sweeper per interval: the OLDEST live worker.
@@ -1465,6 +1573,127 @@ class FleetPlane:
         return LED
 
 
+def build_overview(worker_id: str, workers: List[dict]) -> dict:
+    """Fold live worker heartbeat docs into the one fleet-overview doc.
+
+    Pure (unit-testable without a store).  Rolling-upgrade tolerant by
+    contract: a worker publishing the pre-digest heartbeat shape is
+    listed with ``digest: null`` and simply contributes nothing to the
+    digest-derived totals — a mixed fleet aggregates, never errors.
+
+    Totals:
+    - ``queueDepth``/``activeJobs`` — summed autoscale signals;
+    - ``tenantQueued``/``tenantShares`` — the first fleet-WIDE tenant
+      fairness view (each worker only ever saw its own apportionment);
+    - ``burn`` (worst-of-fleet per objective/window) and ``budget``
+      (min-of-fleet) — one sick worker must show, not average away;
+    - ``openBreakers`` — per worker, with open reasons;
+    - ``topHops`` — fleet seconds-per-GB per hop (summed seconds over
+      summed bytes), worst three: where the fleet's gigabyte-time goes;
+    - ``hopReconcileRatioMixed`` — summed hop seconds over summed
+      stage seconds across the fleet (the soak's unguarded mixed-phase
+      attribution stat, surfaced live so drift is at least visible).
+    """
+    from ..control.slo import top_hops
+
+    members: List[dict] = []
+    tenant_queued: Dict[str, int] = {}
+    burn: Dict[str, Dict[str, float]] = {}
+    budget: Dict[str, float] = {}
+    open_breakers: Dict[str, dict] = {}
+    hop_totals: Dict[str, dict] = {}
+    queue_depth = 0
+    active_jobs = 0
+    hop_seconds_sum = 0.0
+    stage_seconds_sum = 0.0
+    for doc in workers:
+        wid = doc.get("workerId")
+        signals = doc.get("signals")
+        digest = doc.get("digest")
+        if not isinstance(digest, dict):
+            digest = None  # pre-PR-15 heartbeat shape: listed, null
+        members.append({
+            "workerId": wid,
+            "startedAt": doc.get("startedAt"),
+            "heartbeatAt": doc.get("heartbeatAt"),
+            "leases": len(doc.get("leases") or []),
+            "signals": dict(signals) if isinstance(signals, dict)
+            else None,
+            "digest": digest,
+        })
+        if isinstance(signals, dict):
+            queue_depth += int(signals.get("queue_depth", 0) or 0)
+            active_jobs += int(signals.get("active_jobs", 0) or 0)
+        if digest is None:
+            continue
+        for name, rates in (digest.get("burn") or {}).items():
+            worst = burn.setdefault(name, {"fast": 0.0, "slow": 0.0})
+            for window in ("fast", "slow"):
+                try:
+                    worst[window] = max(
+                        worst[window],
+                        float((rates or {}).get(window, 0.0) or 0.0))
+                except (TypeError, ValueError):
+                    pass
+        for name, remaining in (digest.get("budget") or {}).items():
+            try:
+                remaining = float(remaining)
+            except (TypeError, ValueError):
+                continue
+            budget[name] = min(budget.get(name, 1.0), remaining)
+        breakers = digest.get("openBreakers") or {}
+        if breakers:
+            open_breakers[wid] = dict(breakers)
+        for tenant, depth in (digest.get("tenantQueued") or {}).items():
+            try:
+                tenant_queued[tenant] = (tenant_queued.get(tenant, 0)
+                                         + int(depth))
+            except (TypeError, ValueError):
+                pass
+        for hop, entry in (digest.get("hops") or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            total = hop_totals.setdefault(
+                hop, {"bytes": 0, "seconds": 0.0})
+            try:
+                total["bytes"] += int(entry.get("bytes", 0) or 0)
+                total["seconds"] += float(entry.get("seconds", 0.0)
+                                          or 0.0)
+            except (TypeError, ValueError):
+                pass
+        try:
+            hop_seconds_sum += float(digest.get("hopSeconds", 0.0)
+                                     or 0.0)
+            stage_seconds_sum += float(digest.get("stageSeconds", 0.0)
+                                       or 0.0)
+        except (TypeError, ValueError):
+            pass
+    total_queued = sum(tenant_queued.values())
+    tenant_shares = {
+        tenant: round(depth / total_queued, 4)
+        for tenant, depth in sorted(tenant_queued.items())
+    } if total_queued else {}
+    return {
+        "updatedAt": round(time.time(), 3),
+        "updatedBy": worker_id,
+        "workers": members,
+        "totals": {
+            "workers": len(members),
+            "queueDepth": queue_depth,
+            "activeJobs": active_jobs,
+            "tenantQueued": tenant_queued,
+            "tenantShares": tenant_shares,
+            "burn": burn,
+            "budget": budget,
+            "openBreakers": open_breakers,
+            "topHops": top_hops(hop_totals),
+            "hopReconcileRatioMixed": round(
+                hop_seconds_sum / stage_seconds_sum, 4)
+            if stage_seconds_sum > 0 else None,
+        },
+    }
+
+
 def _json_bytes(doc: dict) -> bytes:
     return json.dumps(doc, sort_keys=True).encode("utf-8")
 
@@ -1477,4 +1706,5 @@ def _json_load(raw: bytes) -> dict:
 __all__ = [
     "FleetPlane", "resolve_worker_id", "MemoryCoordStore",
     "BucketCoordStore", "CoordError", "LED", "SHARED", "UNCOORDINATED",
+    "build_overview", "OVERVIEW_KEY",
 ]

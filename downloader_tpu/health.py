@@ -94,18 +94,31 @@ def build_app(orchestrator: Orchestrator, metrics: Optional[Metrics] = None) -> 
         blocked = (breakers.blocking_dependencies(
             getattr(orchestrator, "admission_dependencies", None))
             if breakers is not None else [])
+        # live SLO posture (control/slo.py): burn rates per objective
+        # and window, error budget remaining, current p50/p99 — the
+        # same numbers as slo_burn_rate/slo_error_budget_remaining on
+        # /metrics (one memoized snapshot feeds both).  Carried on the
+        # 503 breaker body too: burn-rate triage (is the SLO actually
+        # bleeding?) and breaker triage (which dependency, slow or
+        # failed?) read off one probe.
+        slo = getattr(orchestrator, "slo", None)
+        slo_block = slo.snapshot() if slo is not None else None
         if blocked:
             body = {"status": "breaker_open", "breakers": states,
                     "blocked": blocked,
                     "active": len(orchestrator.active_jobs)}
             if reasons:
                 body["breakerReasons"] = reasons
+            if slo_block is not None:
+                body["slo"] = slo_block
             return web.json_response(body, status=503)
         payload = {"status": "ready",
                    "active": len(orchestrator.active_jobs),
                    "breakers": states}
         if reasons:
             payload["breakerReasons"] = reasons
+        if slo_block is not None:
+            payload["slo"] = slo_block
         # overload controller (control/overload.py): a saturated worker
         # is still READY — HIGH/NORMAL flow, only BULK is shed — but the
         # posture is surfaced so routing layers can prefer idle peers

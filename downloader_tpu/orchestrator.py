@@ -41,6 +41,7 @@ from .control.overload import OverloadController
 from .control.scheduler import (PriorityScheduler, RunSlot,
                                 aging_from_config, backlog_from_config,
                                 priority_name, priority_rank)
+from .control.slo import SloTracker
 from .control.tenancy import TenantTable
 from .fleet.plane import FleetPlane, resolve_worker_id
 from .mq.base import Delivery, MessageQueue
@@ -257,6 +258,13 @@ class Orchestrator:
         # With no ``tenants`` config every delivery is the "default"
         # tenant and the scheduler behaves exactly as before.
         self.tenants = TenantTable(config, logger=self.logger)
+        # in-process SLO accounting (control/slo.py, ``slo.*``): every
+        # settled delivery classified against its priority class's (and
+        # optionally its tenant's) time-to-staged objective; burn rates
+        # and error-budget remaining ride /metrics, /readyz, and the
+        # fleet heartbeat digest.  None = ``slo.enabled: false``.
+        self.slo = SloTracker.from_config(
+            config, tenant_names=self.tenants.names())
         self.scheduler = PriorityScheduler(
             prefetch, aging_seconds=aging_from_config(config),
             tenants=self.tenants,
@@ -332,11 +340,16 @@ class Orchestrator:
             config, worker_id=self.worker_id, store=store,
             metrics=metrics, logger=self.logger, retrier=self.retrier,
             payload_fn=self.autoscale_signals,
+            digest_fn=self.slo_digest,
         )
         if self.fleet is not None and self.fleet.payload_fn is None:
             # a plane built by hand (tests/bench) still heartbeats the
             # autoscale trio once an orchestrator adopts it
             self.fleet.payload_fn = self.autoscale_signals
+        if self.fleet is not None and self.fleet.digest_fn is None:
+            # same adoption for the SLO/health digest the fleet
+            # overview aggregates
+            self.fleet.digest_fn = self.slo_digest
         self.stage_resources["fleet_plane"] = self.fleet
         self.stage_resources["job_registry"] = self.registry
         # the stages stack each job's per-tenant byte quota under the
@@ -358,6 +371,14 @@ class Orchestrator:
             metrics.bind_autoscale(self.autoscale_signals)
             metrics.bind_tenants(self.tenants.names(),
                                  self.registry.tenant_queue_depths)
+            if self.slo is not None:
+                # slo_burn_rate{class,window} + slo_error_budget_
+                # remaining{class}: the live SLO plane on /metrics
+                metrics.bind_slo(self.slo)
+            if self.fleet is not None:
+                # overview staleness: steady state must sit under 2x
+                # the heartbeat interval (bench v20 guards it)
+                metrics.bind_overview_age(self.fleet.overview_age)
             # per-tenant staging *footprint* (ROADMAP item 5 remaining
             # depth): live workdir bytes per tenant — quotas today cover
             # transfer rate; this gauge is the disk-accounting half
@@ -468,6 +489,33 @@ class Orchestrator:
             "cache_headroom_bytes": headroom,
             "active_jobs": len(self.active_jobs),
         }
+
+    def slo_digest(self) -> dict:
+        """The compact SLO/health digest the fleet heartbeat carries
+        (fleet/plane.py ``digest_fn``): burn rates + budgets per
+        objective, open breakers with reasons, per-hop totals (the
+        overview's top-hops + mixed-phase reconcile ratio), and this
+        worker's per-tenant queue depths — the fleet-wide tenant
+        fairness view is aggregated from exactly these
+        (``build_overview``).  Sync and cheap: the SLO snapshot is
+        memoized, the rest are dict reads."""
+        digest = self.slo.digest() if self.slo is not None else {}
+        breakers = getattr(self, "breakers", None)
+        if breakers is not None:
+            states = breakers.states()
+            reasons = breakers.open_reasons()
+            open_breakers = {
+                dependency: {"state": state,
+                             "reason": reasons.get(dependency)}
+                for dependency, state in states.items()
+                if state != "closed"
+            }
+            if open_breakers:
+                digest["openBreakers"] = open_breakers
+        queued = self.registry.tenant_queue_depths()
+        if queued:
+            digest["tenantQueued"] = queued
+        return digest
 
     async def assemble_trace(self, trace_id: str,
                              remote: bool = True) -> dict:
@@ -800,8 +848,7 @@ class Orchestrator:
                 entry["watcher"].cancel()
             self._clear_failures(record.job_id)
             record.event("settle", mode="none", why="staged_elsewhere")
-            self._journal_settle(record.job_id, "ack",
-                                 "staged_elsewhere")
+            self._journal_settle(record, "ack", "staged_elsewhere")
             self.registry.transition(
                 record, control.DONE,
                 reason="recovered: staged by a fleet peer")
@@ -1239,7 +1286,7 @@ class Orchestrator:
         await self._remove_workdir(job_id, logger)
         record.event("settle", mode="ack", why="cancelled",
                      reason=token.reason or "cancelled")
-        self._journal_settle(job_id, "ack", "cancelled")
+        self._journal_settle(record, "ack", "cancelled")
         await delivery.ack()
         # terminal state BEFORE the telemetry await: observers woken by
         # the ack (broker join, drain, /v1/jobs pollers) must already
@@ -1325,12 +1372,24 @@ class Orchestrator:
                 and self.journal is not None:
             self.journal.append("retry_clear", job_id)
 
-    def _journal_settle(self, job_id: str, mode: str, why: str) -> None:
+    def _journal_settle(self, record: JobRecord, mode: str,
+                        why: str) -> None:
         """Record how the delivery settled — the bit recovery uses to
         decide whether a redelivery is still coming (nack) or the job's
-        story is over and its workdir is an orphan (ack)."""
+        story is over and its workdir is an orphan (ack).
+
+        Also the ONE seam every settle path funnels through, so the
+        SLO tracker (control/slo.py) classifies each resolution here:
+        acked done/staged inside its objective's latency target is
+        good, acked failures and latency breaches burn error budget
+        (and stamp an ``slo_breach`` event on the record before it
+        retires), nacks and cancels are not resolutions at all.
+        """
         if self.journal is not None:
-            self.journal.append("settle", job_id, mode=mode, why=why)
+            self.journal.append("settle", record.job_id, mode=mode,
+                                why=why)
+        if self.slo is not None:
+            self.slo.note_settle(record, mode, why)
 
     async def _remove_workdir(self, job_id: str, logger: Logger) -> None:
         """Best-effort workdir removal for settles after which no
@@ -1407,7 +1466,7 @@ class Orchestrator:
         record.retry = None
         record.event("settle", mode="nack", why="overload_shed",
                      reason=reason)
-        self._journal_settle(record.job_id, "nack", "overload_shed")
+        self._journal_settle(record, "nack", "overload_shed")
         await delivery.nack()
         self.registry.transition(
             record, control.FAILED, reason=f"overload_shed: {reason}"
@@ -1456,7 +1515,7 @@ class Orchestrator:
             logger.warn("expired-job status emit failed", error=str(err))
         record.event("settle", mode="ack", why="deadline",
                      overdue_s=round(overdue, 3), where=where)
-        self._journal_settle(record.job_id, "ack", "deadline")
+        self._journal_settle(record, "ack", "deadline")
         await delivery.ack()
         self._clear_failures(record.job_id)
         # terminal state BEFORE the workdir removal's await: anything
@@ -1508,7 +1567,7 @@ class Orchestrator:
             record.retry = None
             record.event("settle", mode="nack", why="breaker_open",
                          dependency=dependency)
-            self._journal_settle(job_id, "nack", "breaker_open")
+            self._journal_settle(record, "nack", "breaker_open")
             await delivery.nack()
             self.registry.transition(
                 record, control.FAILED,
@@ -1530,7 +1589,7 @@ class Orchestrator:
             record.retry = None
             record.event("settle", mode="ack", why=fault,
                          type=type(err).__name__)
-            self._journal_settle(job_id, "ack", fault)
+            self._journal_settle(record, "ack", fault)
             await delivery.ack()
             self.registry.transition(
                 record,
@@ -1559,7 +1618,7 @@ class Orchestrator:
             record.retry = None
             record.event("settle", mode="ack", why="poison",
                          failures=failures)
-            self._journal_settle(job_id, "ack", "poison")
+            self._journal_settle(record, "ack", "poison")
             await delivery.ack()
             self.registry.transition(record, control.DROPPED_POISON,
                                      reason=f"{failures} failures")
@@ -1573,7 +1632,7 @@ class Orchestrator:
         record.retry = None
         record.event("settle", mode="nack", why=why,
                      delay_s=round(delay, 3))
-        self._journal_settle(job_id, "nack", why)
+        self._journal_settle(record, "nack", why)
         await delivery.nack()
         self.registry.transition(record, control.FAILED, reason=why)
 
@@ -1718,7 +1777,7 @@ class Orchestrator:
                         self.metrics.jobs_failed.labels(reason="stalled").inc()
                     self._clear_failures(job_id)  # job is settled
                     record.event("settle", mode="ack", why="stalled")
-                    self._journal_settle(job_id, "ack", "stalled")
+                    self._journal_settle(record, "ack", "stalled")
                     await delivery.ack()
                     self.registry.transition(record, control.FAILED,
                                              reason="stalled")
@@ -1820,7 +1879,7 @@ class Orchestrator:
         if faults.enabled():
             await faults.fire("settle.ack", key=job_id)
         record.event("settle", mode="ack", why="done")
-        self._journal_settle(job_id, "ack", "done")
+        self._journal_settle(record, "ack", "done")
         await delivery.ack()
         # success clears the poison counter: transient-failure retries that
         # eventually succeed must not count against a later redelivery

@@ -173,6 +173,23 @@ DEFAULTS: dict = {
     #   "shared_max_bytes": 0,       # shared-tier size budget (oldest
     #       # evicted first; 0 = age bound only)
     # },
+    #
+    # In-process SLO accounting (control/slo.py; docs/OPERATIONS.md
+    # "SLOs, burn rates & the fleet overview").  On by default — the
+    # tracker is a deque append per settle.
+    # "slo": {
+    #   "enabled": True,         # false removes the tracker entirely
+    #   "objectives": {          # per-priority-class targets; a key
+    #     "HIGH": {              # matching a configured tenant name
+    #       "p99_ms": 30000.0,   # creates a tenant-scoped objective
+    #       "availability": 0.999,
+    #     },
+    #   },
+    #   "fast_window": 300.0,    # burn-rate fast window, seconds
+    #   "slow_window": 3600.0,   # burn-rate slow window, seconds
+    #   "budget_window": 86400.0,  # error budget accounting window
+    #   "max_events": 8192,      # bounded per-objective event ring
+    # },
     "minio": {
         "endpoint": os.environ.get("MINIO_ENDPOINT", "localhost:9000"),
         "access_key": os.environ.get("MINIO_ACCESS_KEY", ""),

@@ -363,6 +363,36 @@ class Metrics:
             ["op"],
             registry=self.registry,
         )
+        # -- SLO plane (control/slo.py) --------------------------------
+        # "class" is bounded by the priority-class enum plus the
+        # config-bounded tenant-objective names; "window" is the
+        # fast|slow literal pair
+        self.slo_burn_rate = Gauge(
+            f"{ns}_slo_burn_rate",
+            "Error-budget burn rate per SLO objective and window "
+            "(fast ~5 m / slow ~1 h): bad_fraction / (1 - availability)."
+            "  1.0 spends the budget exactly at the allowed rate; "
+            "sustained > 1 on BOTH windows is the page condition",
+            ["class", "window"],
+            registry=self.registry,
+        )
+        self.slo_budget_remaining = Gauge(
+            f"{ns}_slo_error_budget_remaining",
+            "Fraction of the error budget left per SLO objective over "
+            "slo.budget_window (1 = untouched, 0 = exhausted; clamped "
+            "at 0)",
+            ["class"],
+            registry=self.registry,
+        )
+        self.fleet_overview_age = Gauge(
+            f"{ns}_fleet_overview_age_seconds",
+            "Age of the fleet-overview document this worker last "
+            "published or read (steady state: under 2x "
+            "fleet.heartbeat_interval; climbing = the elected "
+            "aggregator stopped folding, or the coordination store is "
+            "unreachable).  -1 until an overview has been seen",
+            registry=self.registry,
+        )
         # -- multi-tenant overload control (control/tenancy+overload) --
         self.jobs_shed = Counter(
             f"{ns}_jobs_shed_total",
@@ -555,6 +585,40 @@ class Metrics:
             lambda: float(journal.size_bytes))
         self.journal_lines.set_function(
             lambda: float(journal.lines))
+
+    def bind_slo(self, tracker) -> None:
+        """Wire the SLO gauges to a live
+        :class:`~..control.slo.SloTracker`.
+
+        The label set is fixed at bind time (priority classes + the
+        config-bounded tenant objectives); every gauge reads the
+        tracker's memoized snapshot, so one scrape pays one bounded
+        ring scan however many objective/window series exist.
+        """
+        def entry(name: str) -> dict:
+            return tracker.snapshot()["objectives"].get(name) or {}
+
+        for name in tracker.objective_names():
+            self.slo_burn_rate.labels(
+                **{"class": name, "window": "fast"}).set_function(
+                lambda n=name: float(entry(n).get("burnFast", 0.0)))
+            self.slo_burn_rate.labels(
+                **{"class": name, "window": "slow"}).set_function(
+                lambda n=name: float(entry(n).get("burnSlow", 0.0)))
+            self.slo_budget_remaining.labels(
+                **{"class": name}).set_function(
+                lambda n=name: float(
+                    entry(n).get("budgetRemaining", 1.0)))
+
+    def bind_overview_age(self, age_fn) -> None:
+        """Wire ``fleet_overview_age_seconds`` to the fleet plane's
+        last-seen overview stamp (``FleetPlane.overview_age``; None
+        until any overview doc has been published or read -> -1)."""
+        def _age() -> float:
+            age = age_fn()
+            return float(age) if age is not None else -1.0
+
+        self.fleet_overview_age.set_function(_age)
 
     def bind_autoscale(self, signals_fn) -> None:
         """Wire the autoscale trio to a live snapshot callable.

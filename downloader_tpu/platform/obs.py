@@ -95,6 +95,15 @@ class HopLedger:
     def __bool__(self) -> bool:
         return bool(self._hops)
 
+    def iter_hops(self):
+        """``(hop, bytes, seconds)`` triples — the public read the SLO
+        tracker's hop accumulation rides (the internal ``[bytes,
+        seconds]`` list layout is not a contract; named away from the
+        mapping protocol's ``items`` because these are triples, not
+        key/value pairs)."""
+        for hop, (nbytes, seconds) in self._hops.items():
+            yield hop, nbytes, seconds
+
     def total_seconds(self) -> float:
         return sum(entry[1] for entry in self._hops.values())
 

@@ -249,6 +249,10 @@ class SoakRig:
         if profile.breakers:
             # the degraded profile arms the slow-call policy here
             cfg["breakers"] = dict(profile.breakers)
+        if profile.slo:
+            # fleet-overview tests tighten the objectives so brownout
+            # latency visibly burns budget inside a short run
+            cfg["slo"] = dict(profile.slo)
         os.makedirs(slot.config_dir, exist_ok=True)
         with open(os.path.join(slot.config_dir, "converter.yaml"), "w",
                   encoding="utf-8") as fh:

@@ -10,20 +10,15 @@ capacity regression arrives with attribution, not as a vibe.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+# THE percentile: one implementation shared with the in-process SLO
+# plane (control/slo.py), so `make soak` and the production /readyz
+# block report the same statistic by construction (re-exported here —
+# the soak's public name since PR 13)
+from ..control.slo import percentile
 from .workload import PRIORITY_CLASSES
-
-
-def percentile(values: Sequence[float], q: float) -> float:
-    """Nearest-rank percentile (q in 0..100); 0.0 on empty input."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    rank = max(int(math.ceil(q / 100.0 * len(ordered))) - 1, 0)
-    return float(ordered[min(rank, len(ordered) - 1)])
 
 
 def fit_slope(xs: Sequence[float], ys: Sequence[float]) -> float:
@@ -85,12 +80,22 @@ class SoakReport:
         }
 
     def summary(self) -> str:
+        # the mixed-phase attribution ratio rides the narrative even
+        # though it stays unguarded by design (contention-dominated
+        # wall): drift in WHERE the time went should be read in every
+        # report, not discovered after a quarter of silent rot.  The
+        # same number is live on the fleet overview
+        # (totals.hopReconcileRatioMixed).
+        mixed = self.stats.get("hop_reconcile_ratio_mixed")
+        tail = (f" [hop_reconcile_ratio_mixed={mixed:.3f}, unguarded]"
+                if mixed else "")
         failed = self.failures()
         if not failed:
-            return f"soak OK: {len(self.guards)} guards green"
+            return f"soak OK: {len(self.guards)} guards green{tail}"
         names = ", ".join(
             f"{g.name}={g.value:.3f}!{g.op}{g.bound}" for g in failed)
-        return f"soak FAILED {len(failed)}/{len(self.guards)}: {names}"
+        return (f"soak FAILED {len(failed)}/{len(self.guards)}: "
+                f"{names}{tail}")
 
 
 def _ceiling(name: str, value: float, bound: float,

@@ -17,6 +17,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 from ..platform.config import cfg_get
+# ONE priority-class tuple repo-wide (the percentile discipline): the
+# soak's p99 guards and the live SLO tracker must agree on which
+# classes exist — re-exported here under the soak's historical name
+from ..control.slo import PRIORITY_CLASSES
 
 # job kinds (the ``kind`` of each JobSpec; job ids carry them too)
 HOT = "hot"            # cache-hot fan-in: every hot job shares one URI
@@ -32,8 +36,9 @@ PLAIN = "plain"        # one ordinary HTTP fetch per job
 #: per-job ledger can or should account for)
 PROBE = "probe"
 
-#: priority classes the p99 guards are keyed on (JobPriority enum names)
-PRIORITY_CLASSES = ("HIGH", "NORMAL", "BULK")
+__all__ = ["PRIORITY_CLASSES", "SoakProfile", "WorkloadOrigin",
+           "SoakEndpoints", "JobSpec", "SoakWorkload", "download_msg",
+           "HOT", "RACING", "MANIFEST", "BULK", "PLAIN", "PROBE"]
 
 
 @dataclass(frozen=True)
@@ -65,6 +70,9 @@ class SoakProfile:
     #: extra ``breakers`` config section for the workers (the degraded
     #: profile arms the store slow-call policy here)
     breakers: Dict[str, dict] = field(default_factory=dict)
+    #: extra ``slo`` config section for the workers (tests tighten
+    #: objectives so a browned-out worker's burn rate visibly rises)
+    slo: Dict[str, dict] = field(default_factory=dict)
     #: wall-clock offset (seconds after worker 0 installs its fault
     #: plan) at which the profile's brownout window opens — kept in
     #: sync with ``fault_plan`` so the rig can anchor the

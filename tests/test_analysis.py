@@ -32,9 +32,10 @@ def run_rule(source, rule, path=LIB):
             if f.rule == rule]
 
 
-def repo_ctx(sources=None, operations="", proto=""):
+def repo_ctx(sources=None, operations="", proto="", architecture=""):
     modules = [module(src, path) for path, src in (sources or {}).items()]
-    return RepoContext(modules, operations_md=operations, proto_text=proto)
+    return RepoContext(modules, operations_md=operations,
+                       proto_text=proto, architecture_md=architecture)
 
 
 def run_repo_rule(rule, **kwargs):
@@ -803,3 +804,89 @@ def test_suppression_for_wrong_rule_does_not_apply():
         analyze_module(mod, rules=["bare-except"]), mod.rel_path, mod.lines)
     assert [f.rule for f in kept] == ["bare-except"]
     assert suppressed == 0
+
+
+# ---------------------------------------------------------------------------
+# event drift (ISSUE 15 satellite: the PRs 10/14 events that slipped
+# past the PR 3 docs)
+# ---------------------------------------------------------------------------
+
+EVENT_CATALOG_DOC = """
+### Per-job flight recorder (`platform/obs.py`)
+
+Each event is one flat JSON object.
+
+| kind | fields | emitted by |
+|---|---|---|
+| `received` | `priority` | registry |
+| `queue_wait` / `sched_wait` | `seconds` | orchestrator |
+| `origin_probe` | `origin`, `ok` | racing fetch |
+
+### Runtime introspection
+
+Prose mentioning `totally_undocumented_kind` outside the table must
+NOT count as catalog coverage.
+"""
+
+EVENT_MOD_BAD = """
+    def emit(record):
+        record.event("totally_undocumented_kind", x=1)
+"""
+
+EVENT_MOD_GOOD = """
+    def emit(record, recorder):
+        record.event("received", priority="HIGH")
+        record.event("origin_probe", origin="o1", ok=True)
+        record.event("sched_wait", seconds=0.1)   # combined-row name
+        recorder.record("queue_wait", seconds=0.2)
+"""
+
+EVENT_MOD_WRAPPER = """
+    class Racer:
+        def _event(self, kind, **fields):
+            self.record.event(kind, **fields)
+
+        def go(self):
+            self._event("range_assign", origin="o1")
+"""
+
+
+def test_event_drift_flags_undocumented_event():
+    found = run_repo_rule("event-drift",
+                          sources={LIB: EVENT_MOD_BAD},
+                          architecture=EVENT_CATALOG_DOC)
+    assert len(found) == 1
+    assert "totally_undocumented_kind" in found[0].message
+    assert "ARCHITECTURE" in found[0].message
+
+
+def test_event_drift_accepts_cataloged_events():
+    # table rows cover record.event, combined-name rows, and direct
+    # recorder.record calls alike
+    assert run_repo_rule("event-drift",
+                         sources={LIB: EVENT_MOD_GOOD},
+                         architecture=EVENT_CATALOG_DOC) == []
+
+
+def test_event_drift_sees_wrapper_emitters():
+    # the origin plane's self._event("...") wrapper is an emitter too
+    # (range_assign is exactly the PR 10 event that drifted) — and
+    # prose mentions outside the catalog table do not count
+    found = run_repo_rule("event-drift",
+                          sources={LIB: EVENT_MOD_WRAPPER},
+                          architecture=EVENT_CATALOG_DOC)
+    assert len(found) == 1
+    assert "range_assign" in found[0].message
+
+
+def test_event_drift_one_finding_per_kind_and_dynamic_kinds_skipped():
+    src = """
+    def emit(record, kind):
+        record.event(kind, x=1)          # dynamic: the wrapper seam
+        record.event("drifted", a=1)
+        record.event("drifted", b=2)     # same kind: one finding
+    """
+    found = run_repo_rule("event-drift", sources={LIB: src},
+                          architecture=EVENT_CATALOG_DOC)
+    assert len(found) == 1
+    assert "drifted" in found[0].message

@@ -1,0 +1,596 @@
+"""Fleet overview aggregation + degradation (ISSUE 15 piece 2).
+
+Layers:
+
+- pure ``build_overview`` folding: totals, worst-of-fleet burn,
+  min-of-fleet budget, tenant shares, top hops — and the
+  rolling-upgrade contract (a pre-PR-15 heartbeat with no digest is
+  listed with ``digest: null``, never an aggregation error);
+- the election/publish tick on a real coordination store (elected
+  oldest publishes, a younger worker just notes the age, a stale doc
+  triggers takeover);
+- bounded degradation under PR 14 windowed brownout: the overview
+  fetch budget actually bounds a browned-out coordination store, and
+  the trace assembler's 5 s/peer budget actually bounds a browned-out
+  peer — both come back ``degraded: true`` with the slow party in
+  ``errors`` (previously only hard failures were covered);
+- ``cli fleet top`` frame rendering;
+- the acceptance run: a REAL 3-worker subprocess fleet (SoakRig) with
+  one worker under a windowed store brownout — ``GET
+  /v1/fleet/overview`` on a healthy worker shows all 3 members, the
+  browned-out worker's slow-opened breaker and elevated burn rate, and
+  fleet-wide tenant queue shares with ``degraded`` false; killing the
+  coordination store degrades to the local-only view with ``degraded:
+  true`` and zero job failures.
+"""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu.cli import render_overview
+from downloader_tpu.control.slo import Objective, SloTracker
+from downloader_tpu.fleet.coord import ANY, MemoryCoordStore
+from downloader_tpu.fleet.plane import (OVERVIEW_KEY, WORKERS_PREFIX,
+                                        FleetPlane, build_overview)
+
+pytestmark = pytest.mark.anyio
+
+
+def _digest(burn_fast=0.0, burn_slow=0.0, budget=1.0, breakers=None,
+            tenants=None, hops=None, hop_s=0.0, stage_s=0.0):
+    return {
+        "burn": {"NORMAL": {"fast": burn_fast, "slow": burn_slow}},
+        "budget": {"NORMAL": budget},
+        "breached": [],
+        "openBreakers": breakers or {},
+        "tenantQueued": tenants or {},
+        "hops": hops or {},
+        "hopSeconds": hop_s,
+        "stageSeconds": stage_s,
+    }
+
+
+def _worker_doc(worker_id, started_at, digest="absent", signals=None):
+    doc = {
+        "workerId": worker_id,
+        "startedAt": started_at,
+        "heartbeatAt": time.time(),
+        "expiresAt": time.time() + 60,
+        "leases": [],
+        "stats": {},
+    }
+    if signals is not None:
+        doc["signals"] = signals
+    if digest != "absent":
+        doc["digest"] = digest
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# build_overview folding
+# ---------------------------------------------------------------------------
+
+def test_build_overview_folds_totals_and_worst_of_fleet():
+    docs = [
+        _worker_doc("w0", 1.0,
+                    digest=_digest(burn_fast=4.0, burn_slow=2.0,
+                                   budget=0.2,
+                                   breakers={"store": {
+                                       "state": "open",
+                                       "reason": "slow"}},
+                                   tenants={"vip": 3},
+                                   hops={"upload": {
+                                       "bytes": 1 << 30,
+                                       "seconds": 8.0}},
+                                   hop_s=8.0, stage_s=10.0),
+                    signals={"queue_depth": 5, "active_jobs": 2}),
+        _worker_doc("w1", 2.0,
+                    digest=_digest(burn_fast=0.5, burn_slow=0.1,
+                                   budget=0.9, tenants={"vip": 1,
+                                                        "batch": 4},
+                                   hops={"upload": {
+                                       "bytes": 1 << 30,
+                                       "seconds": 2.0}},
+                                   hop_s=2.0, stage_s=10.0),
+                    signals={"queue_depth": 3, "active_jobs": 1}),
+    ]
+    doc = build_overview("w1", docs)
+    totals = doc["totals"]
+    assert doc["updatedBy"] == "w1"
+    assert totals["workers"] == 2
+    assert totals["queueDepth"] == 8 and totals["activeJobs"] == 3
+    # worst-of-fleet burn, min-of-fleet budget: one sick worker shows
+    assert totals["burn"]["NORMAL"] == {"fast": 4.0, "slow": 2.0}
+    assert totals["budget"]["NORMAL"] == 0.2
+    assert totals["openBreakers"] == {
+        "w0": {"store": {"state": "open", "reason": "slow"}}}
+    # fleet-wide tenant shares: vip 4/8, batch 4/8
+    assert totals["tenantQueued"] == {"vip": 4, "batch": 4}
+    assert totals["tenantShares"] == {"vip": 0.5, "batch": 0.5}
+    # fleet per-hop rate: 10 s over 2 GiB
+    (hop,) = totals["topHops"]
+    assert hop["hop"] == "upload"
+    assert hop["secondsPerGb"] == pytest.approx(
+        10.0 / ((2 << 30) / 1e9), rel=1e-3)
+    # the soak's unguarded mixed-phase ratio, surfaced live
+    assert totals["hopReconcileRatioMixed"] == pytest.approx(0.5)
+
+
+def test_build_overview_tolerates_pre_digest_heartbeats():
+    """Rolling-upgrade compat: a worker on the pre-PR-15 heartbeat
+    shape (no digest, no signals) aggregates as a member with
+    ``digest: null`` — never an aggregation error."""
+    docs = [
+        _worker_doc("old-worker", 1.0),  # pre-PR-15 shape
+        _worker_doc("new-worker", 2.0,
+                    digest=_digest(burn_fast=1.5, tenants={"vip": 2}),
+                    signals={"queue_depth": 2, "active_jobs": 1}),
+    ]
+    doc = build_overview("new-worker", docs)
+    members = {m["workerId"]: m for m in doc["workers"]}
+    assert set(members) == {"old-worker", "new-worker"}
+    assert members["old-worker"]["digest"] is None
+    assert members["old-worker"]["signals"] is None
+    # digest-derived totals come from the modern worker alone
+    assert doc["totals"]["workers"] == 2
+    assert doc["totals"]["burn"]["NORMAL"]["fast"] == 1.5
+    assert doc["totals"]["tenantQueued"] == {"vip": 2}
+    # a digest of the WRONG TYPE (garbage) is normalized to null too
+    docs.append(_worker_doc("weird", 3.0, digest="not-a-dict"))
+    doc = build_overview("new-worker", docs)
+    members = {m["workerId"]: m for m in doc["workers"]}
+    assert members["weird"]["digest"] is None
+
+
+# ---------------------------------------------------------------------------
+# election + publish tick on a real coordination store
+# ---------------------------------------------------------------------------
+
+async def test_overview_tick_elected_oldest_publishes_mixed_fleet():
+    coord = MemoryCoordStore()
+    plane = FleetPlane(coord, "new-worker",
+                       digest_fn=lambda: _digest(burn_fast=0.25))
+    # an OLD-shape peer heartbeat, younger than this plane (so the
+    # plane stays the elected oldest)
+    await coord.put(
+        WORKERS_PREFIX + "old-worker",
+        _worker_doc("old-worker", plane.started_at + 100),
+        expect=ANY)
+    await plane._beat_once()
+    await plane._overview_tick()
+    doc = await plane.fetch_overview()
+    assert doc is not None and doc["updatedBy"] == "new-worker"
+    members = {m["workerId"]: m for m in doc["workers"]}
+    assert set(members) == {"old-worker", "new-worker"}
+    assert members["old-worker"]["digest"] is None
+    assert members["new-worker"]["digest"]["burn"]["NORMAL"]["fast"] \
+        == 0.25
+    assert plane.overview_age() is not None
+
+
+async def test_overview_tick_younger_worker_defers_then_takes_over():
+    coord = MemoryCoordStore()
+    older = FleetPlane(coord, "older", digest_fn=lambda: _digest())
+    younger = FleetPlane(coord, "younger", digest_fn=lambda: _digest())
+    younger.started_at = older.started_at + 10
+    await older._beat_once()
+    await younger._beat_once()
+    await older._overview_tick()
+    # a fresh doc written by the elected older worker: the younger one
+    # only notes the age (one GET — no listing, no publish)
+    await younger._overview_tick()
+    doc = (await coord.get(OVERVIEW_KEY))[0]
+    assert doc["updatedBy"] == "older"
+    assert younger.overview_age() is not None
+    # the aggregator dies: its heartbeat doc vanishes and the overview
+    # goes stale — the survivor must take over within its tick
+    await coord.delete(WORKERS_PREFIX + "older")
+    stale = dict(doc)
+    stale["updatedAt"] = time.time() - 120.0
+    await coord.put(OVERVIEW_KEY, stale, expect=ANY)
+    await younger._overview_tick()
+    doc = (await coord.get(OVERVIEW_KEY))[0]
+    assert doc["updatedBy"] == "younger"
+
+
+async def test_overview_tick_stands_down_on_empty_liveness_view():
+    """An EMPTY workers() view (own registration failed, or a
+    partition/clock issue expired every heartbeat doc) must STAND
+    DOWN, not let every worker 'win' the election and overwrite the
+    overview with an empty-members doc mid-incident."""
+    coord = MemoryCoordStore()
+    plane = FleetPlane(coord, "w0", digest_fn=lambda: _digest())
+    await plane._beat_once()
+    await plane._overview_tick()
+    good = (await coord.get(OVERVIEW_KEY))[0]
+    assert [m["workerId"] for m in good["workers"]] == ["w0"]
+    # every heartbeat doc expires (never beats again; view goes empty)
+    entry = await coord.get(WORKERS_PREFIX + "w0")
+    dead = dict(entry[0])
+    dead["expiresAt"] = time.time() - 60
+    await coord.put(WORKERS_PREFIX + "w0", dead, expect=ANY)
+    # age the doc so the tick cannot take the fresh-doc early return
+    stale = dict(good)
+    stale["updatedAt"] = time.time() - 120.0
+    await coord.put(OVERVIEW_KEY, stale, expect=ANY)
+    await plane._overview_tick()
+    doc = (await coord.get(OVERVIEW_KEY))[0]
+    # the last GOOD membership view survives (stale but honest — the
+    # age gauge surfaces the staleness); no empty-members overwrite
+    assert [m["workerId"] for m in doc["workers"]] == ["w0"]
+    assert doc["updatedAt"] == stale["updatedAt"]
+
+
+# ---------------------------------------------------------------------------
+# bounded degradation under windowed brownout (PR 14 satellite)
+# ---------------------------------------------------------------------------
+
+class BrownedOutCoord(MemoryCoordStore):
+    """A coordination store under a latency-only brownout: every read
+    succeeds, slowly — the PR 14 failure mode only hard errors covered
+    before."""
+
+    def __init__(self, delay: float):
+        super().__init__()
+        self.delay = delay
+
+    async def get(self, key):
+        await asyncio.sleep(self.delay)
+        return await super().get(key)
+
+
+async def test_overview_fetch_budget_bounds_a_browned_out_coord_store():
+    plane = FleetPlane(BrownedOutCoord(8.0), "w0")
+    started = time.monotonic()
+    with pytest.raises(TimeoutError):
+        await plane.fetch_overview()
+    elapsed = time.monotonic() - started
+    # the 5 s budget actually bounds: well under the 8 s brownout
+    assert 4.0 <= elapsed < 7.0
+
+
+async def test_overview_endpoint_degrades_on_brownout_never_5xx():
+    import aiohttp
+
+    from downloader_tpu.health import build_app
+
+    class StubOrchestrator:
+        config = None
+        registry = None
+        worker_id = "stub-worker"
+        active_jobs: list = []
+        consuming = True
+
+        def __init__(self, plane):
+            self.fleet = plane
+
+        def autoscale_signals(self):
+            return {"queue_depth": 1, "oldest_queued_seconds": 0.0,
+                    "cache_headroom_bytes": 1 << 30, "active_jobs": 0}
+
+        def slo_digest(self):
+            return _digest(burn_fast=0.1)
+
+    plane = FleetPlane(BrownedOutCoord(8.0), "stub-worker")
+    app = build_app(StubOrchestrator(plane), None)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        async with aiohttp.ClientSession() as session:
+            started = time.monotonic()
+            async with session.get(
+                    f"http://127.0.0.1:{port}/v1/fleet/overview"
+            ) as resp:
+                assert resp.status == 200  # NEVER a 5xx
+                body = await resp.json()
+        assert time.monotonic() - started < 7.0
+        assert body["degraded"] is True
+        assert any("coord overview" in err for err in body["errors"])
+        # the local view is always served
+        assert body["local"]["workerId"] == "stub-worker"
+        assert body["local"]["digest"]["burn"]["NORMAL"]["fast"] == 0.1
+        assert body["local"]["signals"]["queue_depth"] == 1
+        assert body["overview"] is None
+    finally:
+        await runner.cleanup()
+
+
+async def test_trace_peer_budget_bounds_a_browned_out_peer(tmp_path):
+    """The trace assembler's 5 s/peer budget against a peer that
+    ANSWERS, slowly (brownout) — only hard failures were tested
+    before.  The response must come back degraded with the slow peer
+    named in errors, inside the budget."""
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import InMemoryObjectStore
+
+    async def slow_trace(_request):
+        await asyncio.sleep(8.0)  # browned out, not down
+        return web.json_response({"segments": [], "spans": []})
+
+    peer_app = web.Application()
+    peer_app.router.add_get("/v1/trace/{id}", slow_trace)
+    peer_runner = web.AppRunner(peer_app)
+    await peer_runner.setup()
+    peer_site = web.TCPSite(peer_runner, "127.0.0.1", 0)
+    await peer_site.start()
+    peer_port = peer_site._server.sockets[0].getsockname()[1]
+
+    coord = MemoryCoordStore()
+    plane = FleetPlane(coord, "local-worker")
+    await coord.put(
+        WORKERS_PREFIX + "slow-peer",
+        {**_worker_doc("slow-peer", 1.0),
+         "adminUrl": f"http://127.0.0.1:{peer_port}"},
+        expect=ANY)
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({"instance": {
+            "download_path": str(tmp_path / "dl")}}),
+        mq=MemoryQueue(broker), store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq), logger=NullLogger(),
+        fleet=plane, worker_id="local-worker",
+    )
+    await orchestrator.start()
+    try:
+        record = orchestrator.registry.register("trace-job", "card")
+        record.trace_id = "ab" * 16
+        started = time.monotonic()
+        document = await orchestrator.assemble_trace("ab" * 16)
+        elapsed = time.monotonic() - started
+        assert elapsed < 7.5, "peer budget did not bound the brownout"
+        assert document["degraded"] is True
+        assert any("slow-peer" in err for err in document["errors"])
+        # the local segment is still served
+        assert any(s["jobId"] == "trace-job"
+                   for s in document["segments"])
+    finally:
+        await orchestrator.shutdown(grace_seconds=5)
+        await peer_runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator digest + cli rendering
+# ---------------------------------------------------------------------------
+
+def test_slo_digest_carries_breakers_and_tenants():
+    """The heartbeat digest fed by a (synthetic) orchestrator shape:
+    SloTracker digest + open breakers + tenant depths."""
+    tracker = SloTracker({"NORMAL": Objective("NORMAL", 1000.0, 0.99)})
+    digest = tracker.digest()
+    assert set(digest) >= {"burn", "budget", "hops", "hopSeconds",
+                           "stageSeconds", "hopReconcileRatio",
+                           "breached"}
+    assert digest["hopReconcileRatio"] is None  # nothing settled yet
+
+
+def test_render_overview_frames():
+    body = {
+        "workerId": "w1",
+        "degraded": False,
+        "overviewAgeSeconds": 0.8,
+        "errors": [],
+        "overview": {
+            "updatedBy": "w0",
+            "workers": [
+                {"workerId": "w0", "heartbeatAt": time.time(),
+                 "leases": 1,
+                 "signals": {"queue_depth": 4, "active_jobs": 2},
+                 "digest": _digest(
+                     burn_fast=3.2, burn_slow=1.1,
+                     breakers={"store": {"state": "open",
+                                         "reason": "slow"}})},
+                {"workerId": "w-old", "heartbeatAt": time.time(),
+                 "leases": 0, "signals": None, "digest": None},
+            ],
+            "totals": {
+                "tenantShares": {"vip": 0.75, "batch": 0.25},
+                "topHops": [{"hop": "upload", "secondsPerGb": 8.1}],
+                "hopReconcileRatioMixed": 0.93,
+            },
+        },
+    }
+    lines = render_overview(body)
+    text = "\n".join(lines)
+    assert "aggregated by w0" in text
+    assert "store:slow" in text
+    assert "NORMAL 3.20/1.10" in text
+    assert "(no digest)" in text  # the pre-digest worker is listed
+    assert "vip=75%" in text
+    assert "upload=8.1" in text
+    assert "0.93" in text
+    # degraded local-only frame renders from the local view
+    degraded = {
+        "workerId": "w1", "degraded": True,
+        "errors": ["coord overview: boom"], "overview": None,
+        "local": {"workerId": "w1",
+                  "signals": {"queue_depth": 1, "active_jobs": 0},
+                  "digest": _digest()},
+    }
+    text = "\n".join(render_overview(degraded))
+    assert "DEGRADED" in text and "coord overview: boom" in text
+    assert "w1" in text
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a real 3-worker fleet, one worker browned out
+# ---------------------------------------------------------------------------
+
+async def test_fleet_overview_acceptance_3_worker_brownout(tmp_path):
+    """ISSUE 15 acceptance: a REAL 3-worker subprocess fleet (SoakRig)
+    with worker 0 under a windowed store brownout and the slow-call
+    breaker policy armed.  ``GET /v1/fleet/overview`` on a HEALTHY
+    worker must show all 3 members, worker 0's slow-opened breaker and
+    elevated burn rate, and fleet-wide tenant queue shares — with
+    ``degraded`` false while the coordination store is reachable.
+    Killing the coordination store then degrades to the local-only view
+    (``degraded: true``, still HTTP 200) — and the run itself finishes
+    with zero job failures."""
+    import aiohttp
+
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.soak import SoakProfile
+
+    profile = SoakProfile.smoke(
+        jobs=18, workers=3, kills=0, kill_interval=0.0,
+        probe_jobs=0, manifest_jobs=0, racing_fraction=0.0,
+        hot_fraction=0.4, bulk_fraction=0.3,
+        # one job at a time per worker: worker 0 must ACK a few slow
+        # jobs (burning error budget against the tightened targets
+        # below) BEFORE its slow-call window fills and the breaker
+        # sheds the rest to the peers — with higher concurrency the
+        # breaker trips before the first settle and every worker-0 job
+        # migrates as a nack, which is a redelivery, not a resolution
+        max_concurrent_jobs=1,
+        # worker 0: latency-only store brownout from (near) boot —
+        # workers are ready in <1 s and an 18-job burst drains in a few
+        # seconds, so the window must already be open when the traffic
+        # lands (zero errors: the slow-call policy must trip, and the
+        # tightened SLO targets must visibly burn)
+        fault_plan=(
+            '[{"seam": "store.*", "kind": "brownout",'
+            ' "start_s": 0.3, "window_s": 30.0,'
+            ' "latency_ms": 300, "jitter_ms": 100}]'),
+        # slow_min_calls sized to ~3-4 jobs' worth of ring-entering
+        # store calls (~2 puts per job): the first few browned-out
+        # jobs settle (slowly — the burn observation), then the
+        # sustained slow fraction opens the breaker (the slow-open
+        # observation)
+        breakers={"store": {"slow_threshold_ms": 120,
+                            "slow_ratio": 0.5, "slow_window": 16,
+                            "slow_min_calls": 8, "reset": 4.0}},
+        slo={"objectives": {
+            "HIGH": {"p99_ms": 800}, "NORMAL": {"p99_ms": 800},
+            "BULK": {"p99_ms": 2000}}},
+    )
+    world = await SoakTestWorld.create(str(tmp_path), profile)
+    rig = world.rig
+    rig._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=5.0))
+    publisher = None
+    try:
+        for slot in rig.slots:
+            await asyncio.to_thread(rig.write_config, slot)
+            await rig.spawn(
+                slot,
+                fault_plan=profile.fault_plan if slot.index == 0
+                else "")
+        browned = rig.slots[0].worker_id
+        healthy = rig.slots[1]
+        publisher = asyncio.get_running_loop().create_task(
+            rig.publish_all(world.workload.specs))
+
+        observed = {"members3": False, "slow_breaker": False,
+                    "burn": False, "tenant_shares": False,
+                    "age_gauge": False}
+        overview_url = (f"http://127.0.0.1:{healthy.health_port}"
+                        "/v1/fleet/overview")
+        metrics_url = (f"http://127.0.0.1:{healthy.health_port}"
+                       "/metrics")
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            pending = [o for o in rig.outcomes.values()
+                       if o.resolved_mono is None]
+            for start in range(0, len(pending), 16):
+                await asyncio.gather(*(
+                    rig._check_marker(o)
+                    for o in pending[start:start + 16]))
+            try:
+                async with rig._session.get(overview_url) as resp:
+                    assert resp.status == 200, await resp.text()
+                    body = await resp.json()
+            except (aiohttp.ClientError, OSError, TimeoutError):
+                await asyncio.sleep(0.4)
+                continue
+            # the coordination store is reachable throughout this
+            # phase: aggregation must NEVER read degraded
+            assert body["degraded"] is False, body["errors"]
+            overview = body.get("overview") or {}
+            totals = overview.get("totals") or {}
+            members = {m.get("workerId"): m
+                       for m in overview.get("workers") or []}
+            if len(members) == 3:
+                observed["members3"] = True
+            member = members.get(browned) or {}
+            digest = member.get("digest") or {}
+            breakers = digest.get("openBreakers") or {}
+            store_breaker = breakers.get("store") or {}
+            if store_breaker.get("reason") == "slow":
+                observed["slow_breaker"] = True
+            if any((rates or {}).get("fast", 0.0) > 0.0
+                   for rates in (digest.get("burn") or {}).values()):
+                observed["burn"] = True
+            shares = totals.get("tenantShares") or {}
+            if shares and abs(sum(shares.values()) - 1.0) < 0.01:
+                observed["tenant_shares"] = True
+            if not observed["age_gauge"]:
+                try:
+                    async with rig._session.get(metrics_url) as resp:
+                        text = await resp.text()
+                    for line in text.splitlines():
+                        if line.startswith(
+                                "downloader_fleet_overview_age_seconds"):
+                            age = float(line.rsplit(" ", 1)[1])
+                            # published + read each heartbeat (the
+                            # browned-out aggregator pays +300 ms per
+                            # coord op, so this bound is looser than
+                            # the steady-state 2x-heartbeat guard
+                            # bench v20 holds)
+                            if 0.0 <= age <= 8.0:
+                                observed["age_gauge"] = True
+                except (aiohttp.ClientError, OSError, TimeoutError):
+                    pass
+            if (all(observed.values())
+                    and len(rig.outcomes) >= len(world.workload.specs)
+                    and not pending):
+                break
+            await asyncio.sleep(0.4)
+        missing = sorted(k for k, v in observed.items() if not v)
+        assert not missing, f"never observed: {missing}"
+
+        # zero job failures: every job resolved, none FAILED/POISONED
+        assert len(rig.outcomes) == len(world.workload.specs)
+        unresolved = [o.spec.job_id for o in rig.outcomes.values()
+                      if o.resolved_mono is None]
+        assert not unresolved, unresolved
+        bad = [f"{o.spec.job_id}={o.terminal_state}"
+               for o in rig.outcomes.values()
+               if o.terminal_state in ("FAILED", "DROPPED_POISON")]
+        assert not bad, bad
+
+        # -- kill the coordination store ---------------------------------
+        await world.s3.stop()
+        world.s3 = None  # world.close() must not double-stop it
+        async with rig._session.get(overview_url) as resp:
+            assert resp.status == 200  # NEVER a 5xx
+            body = await resp.json()
+        assert body["degraded"] is True
+        assert body["errors"], "degraded response must list the error"
+        assert body["overview"] is None
+        # the local view survives: identity + live signals + digest
+        local = body["local"]
+        assert local["workerId"] == healthy.worker_id
+        assert "signals" in local and "digest" in local
+    finally:
+        if publisher is not None and not publisher.done():
+            publisher.cancel()
+            try:
+                await publisher
+            except asyncio.CancelledError:
+                pass
+        await rig._session.close()
+        rig._session = None
+        await world.close()

@@ -1,0 +1,450 @@
+"""In-process SLO accounting (downloader_tpu/control/slo.py; ISSUE 15).
+
+Three layers:
+
+- pure burn-rate / error-budget math against HAND-COMPUTED windows
+  (breach, recovery past the fast window, budget exhaustion and its
+  clamp) on a fake clock;
+- settle classification through a real registry record: good inside
+  target, latency breach, availability breach, nacks/cancels excluded,
+  the ``slo_breach`` flight-recorder event, tenant-scoped objectives,
+  config parsing (defaults, overrides, typo'd objective keys);
+- the serving surfaces: ``/readyz`` ``slo`` block + the
+  ``slo_burn_rate`` / ``slo_error_budget_remaining`` gauges off a real
+  orchestrator settling real jobs, and the per-hop budget guard
+  (``evaluate_hop_budgets``) failing BY NAME when a hop's baseline is
+  artificially tightened — the bench v20 ``--slo`` contract.
+"""
+
+import os
+
+import pytest
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.control.registry import JobRegistry
+from downloader_tpu.control.slo import (DEFAULT_OBJECTIVES, Objective,
+                                        SloTracker, evaluate_hop_budgets,
+                                        hop_budget_baseline, percentile,
+                                        top_hops)
+from downloader_tpu.health import build_app
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.store import InMemoryObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def make_tracker(availability=0.99, p99_ms=1000.0, clock=None, **kwargs):
+    clock = clock or FakeClock()
+    tracker = SloTracker(
+        {"NORMAL": Objective("NORMAL", p99_ms, availability)},
+        fast_window=300.0, slow_window=3600.0, budget_window=86400.0,
+        clock=clock, **kwargs)
+    return tracker, clock
+
+
+class Settled:
+    """The minimal record shape note_settle reads (a real JobRecord is
+    used in the classification tests below; this one pins the clock)."""
+
+    def __init__(self, clock, age_s=0.1, priority="NORMAL",
+                 tenant="default"):
+        self._created_mono = clock.now - age_s
+        self.priority = priority
+        self.tenant = tenant
+        self.hops = None
+        self.stage_seconds = {"pipeline": age_s}
+        self.events = []
+
+    def event(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math vs hand-computed windows
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_hand_computed_breach():
+    # availability 0.99 -> budget fraction 0.01.  9 good + 1 bad in the
+    # fast window: bad_fraction = 0.1 -> burn = 0.1 / 0.01 = 10.
+    tracker, clock = make_tracker(availability=0.99)
+    for _ in range(9):
+        tracker.note_settle(Settled(clock), "ack", "done")
+    tracker.note_settle(Settled(clock), "ack", "permanent")
+    assert tracker.burn_rate("NORMAL", 300.0) == pytest.approx(10.0)
+    assert tracker.burn_rate("NORMAL", 3600.0) == pytest.approx(10.0)
+    snap = tracker.snapshot()["objectives"]["NORMAL"]
+    assert snap["breached"] is True
+    assert snap["bad"] == 1
+
+
+def test_burn_rate_recovery_fast_window_clears_first():
+    # the bad event ages out of the 300 s fast window but stays in the
+    # 3600 s slow window: fast burn 0 well before slow burn clears —
+    # exactly the multiwindow "is it still happening" distinction.
+    tracker, clock = make_tracker(availability=0.99)
+    for _ in range(9):
+        tracker.note_settle(Settled(clock), "ack", "done")
+    tracker.note_settle(Settled(clock), "ack", "permanent")
+    clock.now += 600.0  # past fast, inside slow
+    for _ in range(10):
+        tracker.note_settle(Settled(clock), "ack", "done")
+    tracker._memo["snap"] = None  # new window, fresh scan
+    assert tracker.burn_rate("NORMAL", 300.0) == pytest.approx(0.0)
+    # slow window: 1 bad / 20 total = 0.05 -> burn 5
+    assert tracker.burn_rate("NORMAL", 3600.0) == pytest.approx(5.0)
+    snap = tracker.snapshot()["objectives"]["NORMAL"]
+    assert snap["breached"] is False  # fast cleared: not paging
+
+
+def test_budget_exhaustion_and_clamp():
+    # availability 0.9 -> 10% budget.  10 resolutions allow exactly 1
+    # bad: 1 bad -> remaining 0; more bad stays clamped at 0.
+    tracker, clock = make_tracker(availability=0.9)
+    for _ in range(9):
+        tracker.note_settle(Settled(clock), "ack", "done")
+    tracker.note_settle(Settled(clock), "ack", "permanent")
+    assert tracker.budget_remaining("NORMAL") == pytest.approx(0.0)
+    tracker.note_settle(Settled(clock), "ack", "permanent")
+    assert tracker.budget_remaining("NORMAL") == 0.0
+    # half the budget: 20 resolutions, 1 bad -> 1 - 1/2 = 0.5
+    tracker2, clock2 = make_tracker(availability=0.9)
+    for _ in range(19):
+        tracker2.note_settle(Settled(clock2), "ack", "done")
+    tracker2.note_settle(Settled(clock2), "ack", "permanent")
+    assert tracker2.budget_remaining("NORMAL") == pytest.approx(0.5)
+
+
+def test_no_events_is_quiet():
+    tracker, _clock = make_tracker()
+    assert tracker.burn_rate("NORMAL", 300.0) == 0.0
+    assert tracker.budget_remaining("NORMAL") == 1.0
+    snap = tracker.snapshot()["objectives"]["NORMAL"]
+    assert snap["breached"] is False and snap["resolved"] == 0
+
+
+def test_ring_is_bounded():
+    tracker, clock = make_tracker(max_events=64)
+    for _ in range(500):
+        tracker.note_settle(Settled(clock), "ack", "done")
+    assert len(tracker._series["NORMAL"].ring) == 64
+    # cumulative totals keep counting past the ring
+    assert tracker._series["NORMAL"].good_total == 500
+
+
+# ---------------------------------------------------------------------------
+# settle classification
+# ---------------------------------------------------------------------------
+
+def test_latency_breach_is_bad_and_stamps_slo_breach():
+    tracker, clock = make_tracker(p99_ms=1000.0)
+    record = Settled(clock, age_s=2.5)  # 2500 ms > 1000 ms target
+    tracker.note_settle(record, "ack", "done")
+    assert tracker.burn_rate("NORMAL", 300.0) > 0
+    (event,) = [e for e in record.events if e["kind"] == "slo_breach"]
+    assert event["breach"] == "latency"
+    assert event["objective"] == "NORMAL"
+    assert event["latency_ms"] == pytest.approx(2500.0, abs=50)
+    assert event["target_ms"] == 1000.0
+
+
+def test_availability_breach_names_the_why():
+    tracker, clock = make_tracker()
+    record = Settled(clock)
+    tracker.note_settle(record, "ack", "poison")
+    (event,) = [e for e in record.events if e["kind"] == "slo_breach"]
+    assert event["breach"] == "availability"
+    assert event["why"] == "poison"
+
+
+def test_nacks_and_cancels_are_not_resolutions():
+    tracker, clock = make_tracker()
+    for why in ("stage_error", "breaker_open", "overload_shed"):
+        tracker.note_settle(Settled(clock), "nack", why)
+    tracker.note_settle(Settled(clock), "ack", "cancelled")
+    series = tracker._series["NORMAL"]
+    assert series.good_total == 0 and series.bad_total == 0
+
+
+def test_good_settle_no_breach_event():
+    tracker, clock = make_tracker()
+    record = Settled(clock, age_s=0.05)
+    tracker.note_settle(record, "ack", "done")
+    assert not [e for e in record.events if e["kind"] == "slo_breach"]
+    assert tracker._series["NORMAL"].good_total == 1
+
+
+def test_unknown_priority_resolves_to_normal():
+    tracker, clock = make_tracker()
+    record = Settled(clock, priority="WEIRD")
+    tracker.note_settle(record, "ack", "done")
+    assert tracker._series["NORMAL"].good_total == 1
+
+
+def test_tenant_objective_tracks_alongside_class():
+    clock = FakeClock()
+    tracker = SloTracker(
+        {"NORMAL": Objective("NORMAL", 60000.0, 0.999)},
+        tenant_objectives={"vip": Objective("vip", 100.0, 0.999)},
+        clock=clock)
+    record = Settled(clock, age_s=0.5, tenant="vip")  # 500 ms
+    tracker.note_settle(record, "ack", "done")
+    # inside NORMAL's 60 s target, outside vip's 100 ms target
+    assert tracker._series["NORMAL"].good_total == 1
+    assert tracker._series["vip"].bad_total == 1
+    assert "vip" in tracker.snapshot()["objectives"]
+
+
+def test_hop_and_stage_accumulation_feeds_digest():
+    tracker, clock = make_tracker()
+    registry = JobRegistry()
+    record = registry.register("slo-digest-1", "card")
+    record.note_hop("upload", 2 << 20, 0.25)
+    record.stage_seconds["pipeline"] = 0.5
+    record._created_mono = clock.now - 0.1
+    tracker.note_settle(record, "ack", "done")
+    digest = tracker.digest()
+    assert digest["hops"]["upload"]["bytes"] == 2 << 20
+    assert digest["hopSeconds"] == pytest.approx(0.25)
+    assert digest["stageSeconds"] == pytest.approx(0.5)
+    assert digest["hopReconcileRatio"] == pytest.approx(0.5)
+    assert digest["burn"]["NORMAL"] == {"fast": 0.0, "slow": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# config parsing
+# ---------------------------------------------------------------------------
+
+def test_from_config_defaults_and_overrides():
+    tracker = SloTracker.from_config(ConfigNode({"slo": {
+        "objectives": {"HIGH": {"p99_ms": 5000,
+                                "availability": 0.9999}},
+        "fast_window": 60,
+    }}))
+    assert tracker.objectives["HIGH"].p99_ms == 5000
+    assert tracker.objectives["HIGH"].availability == 0.9999
+    # untouched classes keep defaults
+    p99, avail = DEFAULT_OBJECTIVES["BULK"]
+    assert tracker.objectives["BULK"].p99_ms == p99
+    assert tracker.fast_window == 60.0
+
+
+def test_tenant_objective_defaults_inherit_configured_normal():
+    """A tenant key without its own numbers defaults to NORMAL's
+    RESOLVED bounds — including a configured NORMAL override, not the
+    stock constant."""
+    tracker = SloTracker.from_config(
+        ConfigNode({"slo": {"objectives": {
+            "NORMAL": {"p99_ms": 10000, "availability": 0.95},
+            "vip": {},
+        }}}),
+        tenant_names=("vip",))
+    assert tracker.tenant_objectives["vip"].p99_ms == 10000
+    assert tracker.tenant_objectives["vip"].availability == 0.95
+
+
+def test_from_config_disabled_and_tenant_and_typo():
+    assert SloTracker.from_config(
+        ConfigNode({"slo": {"enabled": False}})) is None
+    tracker = SloTracker.from_config(
+        ConfigNode({"slo": {"objectives": {"vip": {"p99_ms": 1500}}}}),
+        tenant_names=("vip",))
+    assert tracker.tenant_objectives["vip"].p99_ms == 1500
+    with pytest.raises(ValueError, match="neither a priority class"):
+        SloTracker.from_config(
+            ConfigNode({"slo": {"objectives": {"vipp": {}}}}),
+            tenant_names=("vip",))
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        Objective("X", 1000.0, 1.0)
+    with pytest.raises(ValueError):
+        Objective("X", 0.0, 0.99)
+
+
+# ---------------------------------------------------------------------------
+# hop budgets: the guilty hop is NAMED
+# ---------------------------------------------------------------------------
+
+def test_hop_budget_green_and_guilty_hop_named():
+    measured = {"splice": 1.2, "upload": 6.0}
+    baseline = {"hops": {
+        "splice": {"budget_s_per_gb": 5.0, "p99_s_per_gb": 1.3},
+        "upload": {"budget_s_per_gb": 25.0, "p99_s_per_gb": 6.3},
+    }}
+    ok, failures = evaluate_hop_budgets(measured, baseline)
+    assert ok and not failures
+    # artificially tighten ONE hop's budget below its measurement: the
+    # guard must fail and the failure must name that hop (the whole
+    # point of per-hop budgets vs one aggregate floor)
+    baseline["hops"]["upload"]["budget_s_per_gb"] = 1.0
+    ok, failures = evaluate_hop_budgets(measured, baseline)
+    assert not ok
+    assert len(failures) == 1
+    assert "'upload'" in failures[0]
+    assert "'splice'" not in failures[0]
+
+
+def test_hop_budget_missing_hop_is_attribution_drift():
+    ok, failures = evaluate_hop_budgets(
+        {"upload": 6.0},
+        {"hops": {"splice": {"budget_s_per_gb": 5.0},
+                  "upload": {"budget_s_per_gb": 25.0}}})
+    assert not ok
+    assert "'splice'" in failures[0] and "missing" in failures[0]
+
+
+def test_hop_budget_baseline_shape():
+    doc = hop_budget_baseline(
+        {"splice": [1.0, 1.1, 1.2, 1.3, 2.0]}, headroom=4.0)
+    row = doc["hops"]["splice"]
+    assert row["p50_s_per_gb"] == pytest.approx(percentile(
+        [1.0, 1.1, 1.2, 1.3, 2.0], 50.0), abs=1e-4)
+    assert row["budget_s_per_gb"] == pytest.approx(
+        row["p99_s_per_gb"] * 4.0, rel=1e-3)
+    assert row["samples"] == 5
+
+
+def test_top_hops_orders_by_seconds_per_gb_and_skips_noise():
+    rows = top_hops({
+        "upload": {"bytes": 1 << 30, "seconds": 8.0},
+        "splice": {"bytes": 1 << 30, "seconds": 1.0},
+        "hash": {"bytes": 1 << 30, "seconds": 2.0},
+        "filter": {"bytes": 100, "seconds": 50.0},  # < 1 MiB: noise
+    })
+    assert [r["hop"] for r in rows] == ["upload", "hash", "splice"]
+
+
+# ---------------------------------------------------------------------------
+# the serving surfaces, end to end
+# ---------------------------------------------------------------------------
+
+async def _serve(orchestrator):
+    app = build_app(orchestrator, orchestrator.metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_readyz_and_metrics_surface_live_slo(tmp_path):
+    """A real orchestrator settles a real job; /readyz carries the slo
+    block and /metrics carries the burn/budget gauges with literal
+    label sets."""
+    import aiohttp
+
+    payload = b"D" * (1 << 20)
+
+    async def serve_media(_request):
+        return web.Response(body=payload)
+
+    media_app = web.Application()
+    media_app.router.add_get("/m.mkv", serve_media)
+    media_runner = web.AppRunner(media_app)
+    await media_runner.setup()
+    media_site = web.TCPSite(media_runner, "127.0.0.1", 0)
+    await media_site.start()
+    media_port = media_site._server.sockets[0].getsockname()[1]
+
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "dl"),
+                         "max_concurrent_jobs": 1},
+            # a deliberately-impossible NORMAL target: the settle must
+            # classify as a latency breach and burn budget
+            "slo": {"objectives": {"NORMAL": {"p99_ms": 0.001}}},
+        }),
+        mq=MemoryQueue(broker), store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"slo{os.urandom(4).hex()}"),
+        logger=NullLogger(),
+    )
+    await orchestrator.start()
+    runner = None
+    try:
+        runner, base = await _serve(orchestrator)
+        msg = schemas.Download(media=schemas.Media(
+            id="slo-e2e-1", creator_id="c",
+            type=schemas.MediaType.Value("MOVIE"),
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=f"http://127.0.0.1:{media_port}/m.mkv",
+        ))
+        broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        record = orchestrator.registry.get("slo-e2e-1")
+        assert record.state == "DONE"
+        # the breach rides the job's own timeline
+        kinds = [e["kind"] for e in record.recorder.events()]
+        assert "slo_breach" in kinds
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/readyz") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            assert "slo" in body
+            normal = body["slo"]["objectives"]["NORMAL"]
+            assert normal["burnFast"] > 0
+            assert normal["bad"] >= 1
+            assert body["slo"]["windows"]["fastS"] > 0
+            async with session.get(f"{base}/metrics") as resp:
+                text = await resp.text()
+        assert 'slo_burn_rate{class="NORMAL",window="fast"}' in text
+        assert 'slo_error_budget_remaining{class="NORMAL"}' in text
+        # the breached objective's fast burn gauge is live and nonzero
+        for line in text.splitlines():
+            if ('slo_burn_rate{class="NORMAL",window="fast"}'
+                    in line):
+                assert float(line.rsplit(" ", 1)[1]) > 0
+    finally:
+        if runner is not None:
+            await runner.cleanup()
+        await orchestrator.shutdown(grace_seconds=5)
+        await media_runner.cleanup()
+
+
+async def test_slo_disabled_keeps_surfaces_silent(tmp_path):
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    orchestrator = Orchestrator(
+        config=ConfigNode({
+            "instance": {"download_path": str(tmp_path / "dl")},
+            "slo": {"enabled": False},
+        }),
+        mq=MemoryQueue(broker), store=InMemoryObjectStore(),
+        telemetry=Telemetry(telem_mq), logger=NullLogger(),
+    )
+    await orchestrator.start()
+    runner = None
+    try:
+        assert orchestrator.slo is None
+        runner, base = await _serve(orchestrator)
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/readyz") as resp:
+                body = await resp.json()
+        assert "slo" not in body
+    finally:
+        if runner is not None:
+            await runner.cleanup()
+        await orchestrator.shutdown(grace_seconds=5)
