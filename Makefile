@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo chaos crash degraded fleet obs origins slo soak soak-smoke soak-full proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip compute-shard chaos crash degraded fleet obs origins slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -88,6 +88,15 @@ soak-full:
 slo:
 	python -m pytest tests/test_slo.py tests/test_overview.py -v
 
+# sharded compute plane suite (ISSUE 16): the pjit/shard_map chooser
+# (decisions pinned per (shape, mesh)), the regex->PartitionSpec table
+# (every upscaler param matches exactly one rule, unmatched raises),
+# buffer donation, the double-buffered TransferQueue, hop billing, and
+# the mesh-reshape parity tests ({'data':4,'model':2} vs
+# {'data':2,'model':4} produce identical losses and updated params)
+compute-shard:
+	python -m pytest tests/test_compute_shard.py tests/test_multichip.py -v
+
 # graftlint (downloader_tpu/analysis, docs/ANALYSIS.md): the repo-
 # invariant static analyzer over the full tree (JSON for CI parsing),
 # then the tier-1 gate (zero unsuppressed findings + <10 s budget +
@@ -153,6 +162,13 @@ bench-degraded:
 # BASELINE_HOPS.json budget, failures name the guilty hop)
 bench-slo:
 	python bench.py --slo
+
+# standalone sharded-compute bench (one JSON line:
+# multichip_scaling_efficiency = single-device wall / data=4-sharded
+# wall for the same total batch on the dry-run mesh, must stay >= 0.8
+# — virtual devices share one CPU, so this bounds sharding OVERHEAD)
+bench-multichip:
+	python bench.py --multichip
 
 # regenerate protobuf gencode (no protoc in the image: the script
 # applies the declarative edits in scripts/gen_proto.py to the current

@@ -418,7 +418,7 @@ def check_metric_drift(ctx: RepoContext) -> List[Finding]:
 #: crash-only pre-ack fault seam (no Retrier rides it).
 KNOWN_DEPENDENCIES = frozenset({
     "store", "publish", "http", "tracker", "disk", "coord", "origin",
-    "settle",
+    "settle", "compute",
 })
 
 #: families exempt from the WINDOWED-drillability requirement (every

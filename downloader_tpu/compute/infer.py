@@ -15,26 +15,39 @@ import jax.numpy as jnp
 
 from .models.upscaler import Upscaler, UpscalerConfig
 from .ops.pixel_shuffle import quantize_u8
+from .parallel.chooser import compile_step
 
 
 @functools.lru_cache(maxsize=4)
-def make_infer_fn(config: UpscalerConfig = UpscalerConfig()):
+def make_infer_fn(config: UpscalerConfig = UpscalerConfig(), mesh=None):
     """Returns ``infer(params, frames_u8) -> upscaled_u8`` (cached per
-    config, so every caller shares one compiled function).
+    (config, mesh), so every caller shares one compiled function).
 
     Input frames are uint8 (B, H, W, C) as a media decoder would hand
     them; output is uint8 (B, H*scale, W*scale, C).  Normalization to the
     model's [0, 1] float range and re-quantization live inside the jit.
+
+    With ``mesh`` the batch dim is data-parallel over its ``data`` axis
+    and params replicate, routed through the pjit-vs-shard_map chooser
+    like the planar engine (compute/pipeline.py).
     """
     model = Upscaler(config)
 
-    @jax.jit
     def infer(params, frames_u8: jax.Array) -> jax.Array:
         x = frames_u8.astype(jnp.float32) / 255.0
         out = model.apply(params, x)           # bf16 forward (incl. shuffle)
         return quantize_u8(out.astype(jnp.float32) * 255.0)
 
-    return infer
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        in_shardings = (NamedSharding(mesh, P()),
+                        NamedSharding(mesh, P("data", None, None, None)))
+        compiled, _decision = compile_step(fn=infer, mesh=mesh,
+                                           in_shardings=in_shardings)
+    else:
+        compiled, _decision = compile_step(fn=infer, mesh=None)
+    return compiled
 
 
 def upscale_frames(params, frames_u8,

@@ -89,3 +89,14 @@ def init_params(rng: jax.Array, config: UpscalerConfig = UpscalerConfig(),
     model = Upscaler(config)
     params = model.init(rng, jnp.zeros(sample_shape, jnp.float32))
     return model, params
+
+
+def param_paths(config: UpscalerConfig = UpscalerConfig()) -> "list[str]":
+    """Every param leaf path (``/``-joined, under the flax ``params``
+    collection) the model creates — derivable from the config alone, no
+    init needed.  The partition-table coverage test checks the regex →
+    PartitionSpec rules against THIS list, so a new submodule shows up
+    as a failing rule match before it ever reaches a mesh."""
+    mods = ["stem"] + [f"body_{i}" for i in range(config.depth - 1)]
+    mods.append("subpixel")
+    return [f"params/{m}/{leaf}" for m in mods for leaf in ("kernel", "bias")]

@@ -1,0 +1,117 @@
+"""pjit-vs-shard_map compile chooser.
+
+Two ways to run one step over a mesh:
+
+- **pjit** (``jax.jit`` + explicit shardings): the caller states where
+  inputs live, XLA propagates layouts and inserts collectives.  Right
+  when the caller already placed its arrays (the inference engine
+  device_puts planes under a NamedSharding before dispatch).
+- **shard_map**: the function body runs per-shard with explicit specs;
+  no layout search, no surprise resharding.  Right for even
+  data-parallel batches where the caller thinks in per-device terms.
+
+``choose`` picks per (mesh, batch shape) and caches the decision so a
+hot loop never re-derives it; ``compile_step`` turns the decision into
+a compiled callable with ``donate_argnums`` applied either way, so HBM
+is not double-resident across steps regardless of strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+
+try:  # jax >= 0.6 promotes shard_map out of experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jaxlib in some images
+    from jax.experimental.shard_map import shard_map
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    strategy: str  # "jit" | "pjit" | "shard_map"
+    reason: str
+
+
+_DECISIONS: dict = {}
+
+
+def _mesh_key(mesh) -> Optional[tuple]:
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape))
+
+
+def choose(mesh, batch_shape: Optional[Tuple[int, ...]], *,
+           explicit_shardings: bool, data_axis: str = "data") -> Decision:
+    """Pick the compile strategy for one step; cached per (mesh, shape).
+
+    ``batch_shape`` is the leading-dim shape of the batched argument
+    (``None`` for shape-polymorphic callers — they get the mesh-level
+    answer).  ``explicit_shardings`` says the caller provides
+    in_shardings (arrays already placed) — the pjit precondition.
+    """
+    key = (_mesh_key(mesh), batch_shape, explicit_shardings, data_axis)
+    hit = _DECISIONS.get(key)
+    if hit is not None:
+        return hit
+
+    if mesh is None or mesh.size == 1:
+        decision = Decision("jit", "single device: no mesh to map over")
+    elif explicit_shardings:
+        decision = Decision(
+            "pjit", "explicit shardings provided: let XLA propagate")
+    elif batch_shape is None:
+        decision = Decision(
+            "pjit", "shape-polymorphic: propagate from input placements")
+    else:
+        data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(data_axis, 1)
+        if batch_shape[0] % max(1, data) != 0:
+            decision = Decision(
+                "pjit",
+                f"batch {batch_shape[0]} not divisible by "
+                f"{data_axis}={data}: pjit pads, shard_map cannot")
+        else:
+            decision = Decision(
+                "shard_map", "even data-parallel batch: per-shard specs")
+    _DECISIONS[key] = decision
+    return decision
+
+
+def decision_cache() -> dict:
+    """Snapshot of cached decisions (tests pin entries per fixture)."""
+    return dict(_DECISIONS)
+
+
+def clear_decisions() -> None:
+    _DECISIONS.clear()
+
+
+def compile_step(fn, mesh, *, batch_shape=None, data_axis="data",
+                 in_shardings=None, out_shardings=None,
+                 in_specs=None, out_specs=None, donate_argnums=()):
+    """Compile ``fn`` per the cached decision; returns ``(compiled,
+    decision)``.
+
+    pjit route passes shardings straight to ``jax.jit``; shard_map
+    route wraps ``fn`` with the given per-shard specs then jits the
+    wrapper.  ``donate_argnums`` applies on every route.
+    """
+    decision = choose(mesh, batch_shape,
+                      explicit_shardings=in_shardings is not None,
+                      data_axis=data_axis)
+    if decision.strategy == "jit":
+        return jax.jit(fn, donate_argnums=donate_argnums), decision
+    if decision.strategy == "pjit":
+        return jax.jit(fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=donate_argnums), decision
+    if in_specs is None or out_specs is None:
+        raise ValueError(
+            "shard_map chosen but in_specs/out_specs not provided; "
+            "pass per-shard specs or place inputs and pass in_shardings")
+    mapped = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    return jax.jit(mapped, donate_argnums=donate_argnums), decision

@@ -37,18 +37,13 @@ class MeshPlan:
         return NamedSharding(self.mesh, P())
 
     def param_spec(self, path: tuple, value) -> P:
-        """Tensor-parallel param layout: split conv kernels' output-channel
-        dim (last axis) across ``model``; biases likewise.  Sub-pixel head
-        stays replicated (its channel count is scale^2*3, not divisible by
-        typical model-axis sizes)."""
-        name = "/".join(str(p) for p in path)
-        if "subpixel" in name:
-            return P()
-        if value.ndim == 4:  # conv kernel (kh, kw, cin, cout)
-            return P(None, None, None, "model")
-        if value.ndim == 1:  # bias (cout,)
-            return P("model")
-        return P()
+        """Tensor-parallel param layout, resolved through the
+        regex→PartitionSpec table in ``partition.py`` (single source of
+        truth; an upscaler param the table doesn't know raises instead
+        of silently replicating)."""
+        from .partition import UPSCALER_RULES, _leaf_name, spec_for
+
+        return spec_for(UPSCALER_RULES, _leaf_name(path), value)
 
     def param_sharding(self, path: tuple, value) -> NamedSharding:
         return NamedSharding(self.mesh, self.param_spec(path, value))

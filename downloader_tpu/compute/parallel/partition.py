@@ -1,0 +1,87 @@
+"""Regex → PartitionSpec tables for model params.
+
+``match_partition_rules`` walks a param pytree and assigns every leaf a
+``PartitionSpec`` by matching the first rule whose regex hits the
+``/``-joined key path.  Two deliberate hard edges:
+
+- an UNMATCHED param raises — silently replicating a tensor the table
+  forgot is how sharding rules drift between bench rounds.  If a param
+  should be replicated, say so with an explicit rule.
+- scalars (``ndim == 0``, e.g. optax step counts) are always ``P()``;
+  no rule can shard a rank-0 array.
+
+``UPSCALER_RULES`` is the production table for the upscaler: conv
+kernels split their output-channel dim over ``model``, biases likewise,
+and the sub-pixel head stays replicated (its channel count is
+``scale^2 * channels``, not divisible by typical model-axis sizes).
+The rules are disjoint by construction — every upscaler param matches
+exactly one — and tests/test_compute_shard.py pins that property.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+UPSCALER_RULES: Rules = (
+    # sub-pixel head: replicated (channel count indivisible by model axis)
+    (r"subpixel/(kernel|bias)", P()),
+    # trunk conv kernels (kh, kw, cin, cout): split cout over `model`
+    (r"(stem|body_\d+)/kernel", P(None, None, None, "model")),
+    # trunk biases (cout,): follow their kernels' channel split
+    (r"(stem|body_\d+)/bias", P("model")),
+)
+
+
+def _leaf_name(path: tuple) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = getattr(p, "idx", p)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def spec_for(rules: Rules, name: str, value) -> P:
+    """PartitionSpec for one leaf; raises if no rule matches.
+
+    ``name`` is the ``/``-joined key path; ``value`` only needs ``ndim``.
+    """
+    if getattr(value, "ndim", None) == 0:
+        return P()
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            return spec
+    raise ValueError(f"Partition rule not found for param: {name}")
+
+
+def match_partition_rules(rules: Rules, params):
+    """Map a param pytree to a pytree of PartitionSpecs (same structure).
+
+    Exemplar-style: ``re.search`` over the joined key path, first match
+    wins, rank-0 leaves replicate, unmatched leaves raise.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = [spec_for(rules, _leaf_name(path), value) for path, value in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def rule_audit(rules: Rules, params) -> dict:
+    """Map leaf name → list of matching rule patterns (diagnostics; the
+    exactly-one-match test asserts every list has length 1)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    audit = {}
+    for path, value in flat:
+        name = _leaf_name(path)
+        if getattr(value, "ndim", None) == 0:
+            continue  # scalars bypass the table entirely
+        audit[name] = [pat for pat, _ in rules if re.search(pat, name)]
+    return audit

@@ -35,6 +35,7 @@ from .ops.colorspace import (
 )
 from .ops.pixel_shuffle import quantize_u8
 from .ops.s2d_head import s2d_head
+from .parallel.transfer import HopSink, TransferQueue, timed_hop
 from .video import Y4MReader, Y4MWriter
 
 
@@ -138,6 +139,7 @@ class FrameUpscaler:
         checkpoint_dir: Optional[str] = None,
         use_mesh: bool = True,
         seed: int = 0,
+        donate: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -146,6 +148,31 @@ class FrameUpscaler:
         self._jnp = jnp
         self.config = config
         self.model = Upscaler(config)
+        # Donating the input planes is OFF by default, on measurement:
+        # the u8 planes can never alias an output (outputs are scale^2
+        # larger), so donation buys no HBM here — real donation lives on
+        # the state-shaped train step (train.compile_train_step), where
+        # params/opt_state alias in place.  Worse, the donation
+        # bookkeeping forces a synchronous dispatch on the host-CPU
+        # backend (measured: ~0.07 s blocking dispatch vs ~0.0003 s
+        # async, overlap 1.2 -> 0), which would undo the transfer
+        # queue.  The knob stays for backends/configs where the
+        # trade-off differs (e.g. HBM-pressured scale-1 passthrough).
+        self.donate = donate
+        # per-job hop billing target; a worker thread binds the current
+        # job's HopLedger around transcode (stages/upscale.py) and the
+        # engine bills h2d/compute/d2h without signature changes through
+        # the decoder stack.  Unbound (benches, direct calls) it drops.
+        self.hop_sink = HopSink()
+        # (sub_h, sub_w) -> chooser Decision, for observability/tests
+        self.compile_decisions: dict = {}
+        if donate:
+            import warnings
+
+            # donated-but-unaliasable buffers make XLA warn per call;
+            # the donation is still valid — drop the per-dispatch noise
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
 
         rng = jax.random.PRNGKey(seed)
         # fully-convolutional: params are geometry-independent
@@ -299,7 +326,25 @@ class FrameUpscaler:
                         jnp.concatenate(out_rows_cr, axis=1))
             return out_rows_y[0], out_rows_cb[0], out_rows_cr[0]
 
-        return jax.jit(fn)
+        # pjit-vs-shard_map chooser (parallel/chooser.py): the engine
+        # places planes under explicit NamedShardings in _place, so the
+        # cached decision lands on pjit when meshed, plain jit when not;
+        # donated plane args free their HBM for the (bigger) outputs.
+        from .parallel.chooser import compile_step
+
+        donate_argnums = (1, 2, 3) if self.donate else ()
+        if self._mesh is not None:
+            in_shardings = (self._replicated, self._plane_sharding,
+                            self._plane_sharding, self._plane_sharding)
+            compiled, decision = compile_step(
+                fn, self._mesh, batch_shape=(self.batch,),
+                in_shardings=in_shardings, donate_argnums=donate_argnums)
+        else:
+            compiled, decision = compile_step(
+                fn, None, batch_shape=(self.batch,),
+                donate_argnums=donate_argnums)
+        self.compile_decisions[(sub_h, sub_w)] = decision
+        return compiled
 
     def batch_for(self, height: int, width: int) -> int:
         """Resolution-aware dispatch size: the configured batch, capped
@@ -314,7 +359,12 @@ class FrameUpscaler:
 
     def _place(self, arr: np.ndarray):
         if self._plane_sharding is not None:
-            return self._make_global(arr, self._plane_sharding)
+            # h2d is billed as the wall time of the placement call: an
+            # async backend keeps this near-zero until the staging queue
+            # backs up, so a regression that turns h2d synchronous
+            # balloons exactly this hop (and trips its budget)
+            with timed_hop(self.hop_sink, "h2d", int(arr.nbytes)):
+                return self._make_global(arr, self._plane_sharding)
         return arr
 
     # ------------------------------------------------------------------
@@ -351,10 +401,20 @@ class FrameUpscaler:
                 arr.copy_to_host_async()
         return out, n
 
-    @staticmethod
-    def _fetch(dispatched) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        (y2, cb2, cr2), n = dispatched
-        return np.asarray(y2)[:n], np.asarray(cb2)[:n], np.asarray(cr2)[:n]
+    def _fetch(self, dispatched) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Materialize one dispatched batch, billing the remaining two
+        hops at the points the host actually blocks: ``compute`` is the
+        ready-wait, ``d2h`` the host gather (mostly prefetched by the
+        async copy started in :meth:`_dispatch`)."""
+        out, n = dispatched
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in out)
+        with timed_hop(self.hop_sink, "compute", nbytes):
+            for arr in out:
+                if hasattr(arr, "block_until_ready"):
+                    arr.block_until_ready()
+        with timed_hop(self.hop_sink, "d2h", nbytes):
+            y2, cb2, cr2 = (np.asarray(a) for a in out)
+        return y2[:n], cb2[:n], cr2[:n]
 
     def upscale_batch(
         self,
@@ -372,20 +432,15 @@ class FrameUpscaler:
         of fetch, like :meth:`upscale_to`, so chunked 4K batches keep
         the async d2h overlap instead of paying serial round trips).
         """
-        from collections import deque
-
         eff = self.batch_for(y.shape[1], y.shape[2])
         if y.shape[0] <= eff:
             return self._fetch(self._dispatch(y, cb, cr, sub_h, sub_w))
-        inflight: deque = deque()
+        queue = TransferQueue(self._dispatch, self._fetch, depth=3)
         parts = []
         for i in range(0, y.shape[0], eff):
-            inflight.append(self._dispatch(
+            parts.extend(queue.submit(
                 y[i:i + eff], cb[i:i + eff], cr[i:i + eff], sub_h, sub_w))
-            if len(inflight) >= 3:
-                parts.append(self._fetch(inflight.popleft()))
-        while inflight:
-            parts.append(self._fetch(inflight.popleft()))
+        parts.extend(queue.drain())
         return tuple(
             np.concatenate([part[plane] for part in parts])
             for plane in range(3)
@@ -408,36 +463,36 @@ class FrameUpscaler:
         pipe such as an encode back-end's ``ffmpeg -f yuv4mpegpipe -i -``
         stdin; returns the number of frames written.
 
-        Keeps up to ``depth`` batches in flight: batch i+1 is read and
-        dispatched while batch i is still executing, so host IO (and the
-        per-dispatch RPC latency of a tunneled device) overlaps device
-        compute instead of serializing with it.
+        Keeps up to ``depth`` batches in flight through a double-buffered
+        :class:`TransferQueue`: batch i+1 is read, staged (h2d) and
+        dispatched while batch i is still executing and batch i-1's d2h
+        drains, so host IO (and the per-dispatch RPC latency of a
+        tunneled device) overlaps device compute instead of serializing
+        with it.
         """
-        from collections import deque
-
         reader = Y4MReader(src_fh)
         hdr = reader.header
         writer = Y4MWriter(dst_fh, hdr.scaled(self.config.scale))
         sub_h, sub_w = hdr.subsampling
         frames = 0
-        inflight: deque = deque()
 
-        def drain_one() -> None:
+        def write_out(result) -> None:
             nonlocal frames
-            y2, cb2, cr2 = self._fetch(inflight.popleft())
+            y2, cb2, cr2 = result
             for i in range(y2.shape[0]):
                 writer.write_frame(y2[i], cb2[i], cr2[i])
             frames += y2.shape[0]
 
+        queue = TransferQueue(self._dispatch, self._fetch,
+                              depth=max(1, depth))
         # resolution-capped batch: a 4K stream must not blow HBM just
         # because the configured batch suits 720p (see batch_for)
         batch = self.batch_for(hdr.height, hdr.width)
         for y, cb, cr in _batched(iter(reader), batch):
-            inflight.append(self._dispatch(y, cb, cr, sub_h, sub_w))
-            if len(inflight) >= depth:
-                drain_one()
-        while inflight:
-            drain_one()
+            for result in queue.submit(y, cb, cr, sub_h, sub_w):
+                write_out(result)
+        for result in queue.drain():
+            write_out(result)
         return frames
 
 

@@ -48,3 +48,28 @@ def make_train_step(config: UpscalerConfig = UpscalerConfig(),
         return params, opt_state
 
     return train_step, init_state
+
+
+def compile_train_step(config: UpscalerConfig = UpscalerConfig(),
+                       mesh=None, learning_rate: float = 1e-3,
+                       donate: bool = True, in_shardings=None):
+    """``make_train_step`` compiled through the pjit-vs-shard_map
+    chooser with the state args donated.
+
+    This is where buffer donation is REAL: ``params``/``opt_state`` go
+    in and come back the same shapes and dtypes, so XLA aliases them in
+    place — the old state's HBM is never resident alongside the new
+    (the caller's input arrays are consumed; ``is_deleted()`` afterwards,
+    pinned by tests).  Sharding comes from the input placements unless
+    explicit ``in_shardings`` are passed (then the chooser takes the
+    pjit route).
+
+    Returns ``(step, init_state, decision)``.
+    """
+    from .parallel.chooser import compile_step
+
+    train_step, init_state = make_train_step(config, learning_rate)
+    step, decision = compile_step(
+        train_step, mesh, in_shardings=in_shardings,
+        donate_argnums=(0, 1) if donate else ())
+    return step, init_state, decision

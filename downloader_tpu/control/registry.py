@@ -134,7 +134,7 @@ class JobRecord:
         "recorder", "trace_id", "span_id", "transferred", "retry",
         "worker_id", "tenant", "ttl_seconds", "deadline_mono",
         "recovered", "hops", "fleet_fence", "fleet_fence_key",
-        "fleet_waited_s",
+        "fleet_waited_s", "workload",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
@@ -223,6 +223,10 @@ class JobRecord:
         # fleet.max_wait livelock bound holds under a flapping coord
         # store (each re-park used to reset the clock)
         self.fleet_waited_s = 0.0
+        # workload class (control/slo.py WORKLOAD_CLASSES): stamped by a
+        # stage that ran a chip-bound subsystem (the upscale stage sets
+        # "UPSCALE"), so the job ALSO burns that subsystem's SLO budget
+        self.workload: Optional[str] = None
 
     @property
     def terminal(self) -> bool:

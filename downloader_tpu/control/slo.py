@@ -56,6 +56,12 @@ from ..platform.config import cfg_get
 # priorities resolve to NORMAL, the control plane's usual posture
 PRIORITY_CLASSES = ("HIGH", "NORMAL", "BULK")
 
+# workload classes: orthogonal to priority — a job that exercised a
+# chip-bound subsystem (record.workload, stamped by the stage) ALSO
+# counts against that subsystem's objective, so compute is a
+# first-class worker class on the same burn-rate plane as downloads
+WORKLOAD_CLASSES = ("UPSCALE",)
+
 # default per-class objectives: p99 time-to-staged target (ms) and
 # availability target.  Sized like the soak ceilings: interactive HIGH
 # work is the tight one, BULK is deliberately loose (it is the class
@@ -64,6 +70,13 @@ DEFAULT_OBJECTIVES: Dict[str, "tuple[float, float]"] = {
     "HIGH": (30_000.0, 0.999),
     "NORMAL": (60_000.0, 0.999),
     "BULK": (300_000.0, 0.99),
+}
+
+# upscale jobs decode + infer + encode whole videos: minutes-scale by
+# nature, and a faulted compute seam should page well before the
+# generic availability floor would
+DEFAULT_WORKLOAD_OBJECTIVES: Dict[str, "tuple[float, float]"] = {
+    "UPSCALE": (120_000.0, 0.99),
 }
 
 DEFAULT_FAST_WINDOW = 300.0      # ~5 m: the page-fast window
@@ -183,19 +196,24 @@ class SloTracker:
                  budget_window: float = DEFAULT_BUDGET_WINDOW,
                  max_events: int = DEFAULT_MAX_EVENTS,
                  tenant_objectives: Optional[Dict[str, Objective]] = None,
+                 workload_objectives: Optional[Dict[str, Objective]] = None,
                  clock=time.monotonic):
         self.objectives = dict(objectives)
         # tenant-scoped objectives: fed ALONGSIDE the class objective
         # (a vip job counts against both vip's target and HIGH's)
         self.tenant_objectives = dict(tenant_objectives or {})
+        # workload-scoped objectives (UPSCALE): fed alongside too, keyed
+        # by record.workload — chips get their own burn rate
+        self.workload_objectives = dict(workload_objectives or {})
         self.fast_window = float(fast_window)
         self.slow_window = float(slow_window)
         self.budget_window = float(budget_window)
         self.clock = clock
         self._series: Dict[str, _Series] = {
             name: _Series(max_events)
-            for name in list(self.objectives) + list(
-                self.tenant_objectives)
+            for name in (list(self.objectives)
+                         + list(self.tenant_objectives)
+                         + list(self.workload_objectives))
         }
         # cumulative per-hop totals + stage wall across settled jobs:
         # the live (mixed-traffic) attribution the fleet digest carries
@@ -237,17 +255,22 @@ class SloTracker:
             name: objective(name, p99, avail)
             for name, (p99, avail) in DEFAULT_OBJECTIVES.items()
         }
+        workload_objectives = {
+            name: objective(name, p99, avail)
+            for name, (p99, avail) in DEFAULT_WORKLOAD_OBJECTIVES.items()
+        }
         tenant_objectives: Dict[str, Objective] = {}
         configured = cfg_get(config, "slo.objectives", None)
         for name in list(configured) if configured is not None else []:
-            if name in objectives:
+            if name in objectives or name in workload_objectives:
                 continue
             if name not in tenant_names:
                 # neither a class nor a configured tenant: a typo'd key
                 # must not silently track nothing
                 raise ValueError(
                     f"slo.objectives.{name!r} is neither a priority "
-                    f"class {PRIORITY_CLASSES} nor a configured tenant")
+                    f"class {PRIORITY_CLASSES}, a workload class "
+                    f"{WORKLOAD_CLASSES}, nor a configured tenant")
             # tenant objectives default to NORMAL's bounds — the
             # RESOLVED ones, so a configured NORMAL override carries
             # into tenants that don't pin their own numbers
@@ -257,6 +280,7 @@ class SloTracker:
         return cls(
             objectives,
             tenant_objectives=tenant_objectives,
+            workload_objectives=workload_objectives,
             fast_window=float(cfg_get(
                 config, "slo.fast_window", DEFAULT_FAST_WINDOW)),
             slow_window=float(cfg_get(
@@ -297,6 +321,16 @@ class SloTracker:
                 now,
                 succeeded and latency_s * 1000.0 <= tenant_obj.p99_ms,
                 latency_s)
+        # workload class (UPSCALE): stamped by the stage that ran the
+        # chip path, so compute burns its own budget alongside the
+        # priority class's
+        workload = getattr(record, "workload", None)
+        workload_obj = self.workload_objectives.get(workload)
+        if workload_obj is not None:
+            self._series[workload].add(
+                now,
+                succeeded and latency_s * 1000.0 <= workload_obj.p99_ms,
+                latency_s)
         if not good:
             # the breach rides the job's own timeline (and from there
             # the debug bundle + the fleet trace digest) BEFORE the
@@ -332,7 +366,8 @@ class SloTracker:
         error budget exactly at the allowed rate; 0.0 with no events."""
         series = self._series.get(name)
         objective = (self.objectives.get(name)
-                     or self.tenant_objectives.get(name))
+                     or self.tenant_objectives.get(name)
+                     or self.workload_objectives.get(name))
         if series is None or objective is None:
             return 0.0
         good, bad = series.window_counts(
@@ -349,7 +384,8 @@ class SloTracker:
         0, the actionable floor)."""
         series = self._series.get(name)
         objective = (self.objectives.get(name)
-                     or self.tenant_objectives.get(name))
+                     or self.tenant_objectives.get(name)
+                     or self.workload_objectives.get(name))
         if series is None or objective is None:
             return 1.0
         good, bad = series.window_counts(
@@ -364,7 +400,8 @@ class SloTracker:
 
     # -- surfaces --------------------------------------------------------
     def objective_names(self) -> List[str]:
-        return list(self.objectives) + list(self.tenant_objectives)
+        return (list(self.objectives) + list(self.tenant_objectives)
+                + list(self.workload_objectives))
 
     def snapshot(self) -> dict:
         """The ``/readyz`` ``slo`` block (memoized: /metrics, /readyz,
@@ -376,7 +413,8 @@ class SloTracker:
         out: Dict[str, Any] = {}
         for name in self.objective_names():
             objective = (self.objectives.get(name)
-                         or self.tenant_objectives[name])
+                         or self.tenant_objectives.get(name)
+                         or self.workload_objectives[name])
             series = self._series[name]
             fast = self.burn_rate(name, self.fast_window, now)
             slow = self.burn_rate(name, self.slow_window, now)

@@ -147,7 +147,8 @@ class Metrics:
             f"{ns}_hop_seconds_per_gb",
             "Seconds spent per gigabyte moved through each transfer hop "
             "(socket_read/splice/disk_write/hash/filter/upload/"
-            "bucket_fetch), observed once per job at settle — the "
+            "bucket_fetch/cache/h2d/compute/d2h), observed once per job "
+            "at settle — the "
             "attribution the zero-copy staging work (ROADMAP item 3) "
             "ratchets against",
             ["hop"],

@@ -133,6 +133,13 @@ class Job:
     # HLS-style media playlist; AUTO/DIRECT keep the historical
     # whole-entity dispatch on Media.source.
     source_kind: str = "AUTO"
+    # cache-hit serving (stages/download.py materialize_hit): the
+    # absolute paths the cache entry materialized into the workdir, so
+    # the process stage (and the streaming pipeline's authoritative
+    # reconcile) can serve straight from the known list instead of
+    # re-walking the directory tree.  None = not served from cache;
+    # downstream walks as before.
+    cache_files: Optional[list] = None
 
 
 @dataclasses.dataclass

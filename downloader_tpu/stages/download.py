@@ -1789,14 +1789,23 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                              params["secret_key"])
         return None
 
-    async def materialize_hit(key: str, download_path: str,
+    async def materialize_hit(key: str, download_path: str, job: Job,
                               *, coalesced: bool) -> bool:
-        """Serve the job from the cache; False = miss (or entry lost)."""
+        """Serve the job from the cache; False = miss (or entry lost).
+
+        A hit stamps ``job.cache_files`` with the materialized paths so
+        downstream (process stage, streaming reconcile) serves from the
+        known list instead of re-walking the workdir, and bills the
+        ``cache`` hop with the measured link wall — the hop-budget
+        ratchet sees cache serving get cheaper, not vanish.
+        """
         entry = await cache.lookup(key)
         if entry is None:
             return False
         with ctx.tracer.span("stage.download.cache", key=key[:16]) as span:
-            got = await cache.materialize(key, download_path)
+            mark = time.monotonic()
+            materialized = await cache.materialize_entry(key, download_path)
+            got = materialized[0] if materialized is not None else None
             outcome = ("lost" if got is None
                        else ("coalesced" if coalesced else "hit"))
             span.set_tag("outcome", outcome)
@@ -1805,6 +1814,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                              bytes=got or 0)
         if got is None:
             return False  # evicted between lookup and link: treat as miss
+        job.cache_files = materialized[1]
+        if ctx.record is not None:
+            ctx.record.note_hop("cache", got, time.monotonic() - mark)
         if ctx.metrics is not None:
             if not coalesced:
                 ctx.metrics.cache_hits.inc()
@@ -1827,7 +1839,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         """
         # warm path: no network at all (acceptance: a warm-cache job
         # never re-fetches — only the HEAD revalidation above ran)
-        if await materialize_hit(key, download_path, coalesced=False):
+        if await materialize_hit(key, download_path, job, coalesced=False):
             return
 
         async def origin_fill(report) -> None:
@@ -1864,7 +1876,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         async def leader_fetch(report) -> None:
             # re-probe under the flight: a previous leader may have
             # filled the key while this job queued for leadership
-            if await materialize_hit(key, download_path, coalesced=False):
+            if await materialize_hit(key, download_path, job, coalesced=False):
                 return
             fleet = ctx.resources.get("fleet_plane")
             if fleet is not None:
@@ -1889,7 +1901,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if outcome == "shared":
                     # a peer worker's bytes landed in the LOCAL cache:
                     # serve this job (and the flight's waiters) from it
-                    if await materialize_hit(key, download_path,
+                    if await materialize_hit(key, download_path, job,
                                              coalesced=False):
                         return
                     # evicted between fill and link: fetch ourselves
@@ -1907,7 +1919,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # cache it just filled
             if ctx.metrics is not None:
                 ctx.metrics.cache_coalesced.inc()
-            if not await materialize_hit(key, download_path, coalesced=True):
+            if not await materialize_hit(key, download_path, job, coalesced=True):
                 # leader succeeded but its fill wasn't usable (nothing
                 # cacheable, fill error, instant eviction): fetch alone
                 logger.warn("coalesced fetch left no cache entry; "

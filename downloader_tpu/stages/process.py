@@ -210,11 +210,27 @@ async def stage_factory(ctx: StageContext) -> StageFn:
 
         with ctx.tracer.span("stage.process", path=download_path):
             walk_mark = time.monotonic()
-            found = await asyncio.to_thread(
-                find_media_files, download_path, job.media, logger, exts
-            )
+            cache_files = getattr(job, "cache_files", None)
+            if cache_files is not None:
+                # cache-hit serving: the entry already named its files
+                # (stages/download.py materialize_hit), so apply the
+                # SAME per-file verdict the walk would reach — without
+                # the directory re-walk.  Missing paths (clobbered
+                # workdir) fall back to the authoritative walk.
+                if all(os.path.exists(p) for p in cache_files):
+                    allow = incremental_filter(
+                        download_path, job.media, logger, exts)
+                    found = sorted(p for p in cache_files if allow(p))
+                else:
+                    found = await asyncio.to_thread(
+                        find_media_files, download_path, job.media,
+                        logger, exts)
+            else:
+                found = await asyncio.to_thread(
+                    find_media_files, download_path, job.media, logger, exts
+                )
             if ctx.record is not None:
-                # the media-filter walk, on the hop ledger (barrier
+                # the media-filter verdicts, on the hop ledger (barrier
                 # dispatch; the streaming pipeline bills its own)
                 ctx.record.note_hop("filter", 0,
                                     time.monotonic() - walk_mark)

@@ -287,12 +287,19 @@ async def run_streaming_job(ctx: StageContext, media, mirrors=(),
 
             # the post-download walk is the source of truth, exactly like
             # the process stage: it catches files the stream never
-            # announced (cache hits materialize a whole workdir at once)
-            # and decides the zero-matches error
+            # announced and decides the zero-matches error.  A cache hit
+            # materializes a whole workdir at once AND names every file
+            # it placed (job.cache_files), so that case serves from the
+            # known list through the same per-file verdicts — no re-walk
             walk_mark = time.monotonic()
-            found = await asyncio.to_thread(
-                find_media_files, download_path, media, logger, exts
-            )
+            cache_files = job.cache_files
+            if cache_files is not None and all(
+                    os.path.exists(p) for p in cache_files):
+                found = sorted(p for p in cache_files if allow(p))
+            else:
+                found = await asyncio.to_thread(
+                    find_media_files, download_path, media, logger, exts
+                )
             if record is not None:
                 record.note_hop("filter", 0,
                                 time.monotonic() - walk_mark)

@@ -48,6 +48,8 @@ import os
 import shutil
 import threading
 
+from ..platform import faults
+from ..platform.errors import Retrier
 from .base import Job, StageContext, StageFn
 
 _ENGINE_KEY = "upscale.engine"
@@ -75,6 +77,10 @@ def _engine_config(config):
         "batch": int(opt("batch", 8)),
         "checkpoint": opt("checkpoint", None),
         "use_mesh": bool(opt("use_mesh", True)),
+        # donation of the input planes is off by default on measurement
+        # (compute/pipeline.py: cannot alias the scale^2-larger outputs,
+        # and serializes dispatch on async backends)
+        "donate": bool(opt("donate", False)),
         "decode": bool(opt("decode", False)),
         "decoder": str(opt("decoder", "ffmpeg")),
         "encode": bool(opt("encode", False)),
@@ -110,6 +116,7 @@ def _get_engine(ctx: StageContext):
                 batch=opts["batch"],
                 checkpoint_dir=opts["checkpoint"],
                 use_mesh=opts["use_mesh"],
+                donate=opts["donate"],
             )
             ctx.resources[_ENGINE_KEY] = engine
     return engine
@@ -118,10 +125,21 @@ def _get_engine(ctx: StageContext):
 async def stage_factory(ctx: StageContext) -> StageFn:
     logger = ctx.logger
     opts = _engine_config(ctx.config)
+    # chip calls ride the service's shared retry executor + a "compute"
+    # circuit breaker of their own (same board as store/publish/http, so
+    # a wedged device shows up beside a hard-down backend on /readyz)
+    retrier = Retrier.shared(ctx.resources, ctx.config,
+                             metrics=ctx.metrics, logger=ctx.logger)
 
     async def upscale(job: Job):
         from ..compute.transcode import transcode
         from ..compute.video import sniff_y4m
+
+        if ctx.record is not None:
+            # upscale jobs are their own SLO class (control/slo.py
+            # WORKLOAD_CLASSES): the settle seam feeds the UPSCALE
+            # objective alongside the priority class's
+            ctx.record.workload = "UPSCALE"
 
         last = job.last_stage
         files = last["files"] if isinstance(last, dict) else last.files
@@ -196,11 +214,31 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 # renames on success, so on failure dst either doesn't
                 # exist or is a COMPLETE output from a prior attempt —
                 # which a redelivered job should keep, not delete.
-                frames = await asyncio.to_thread(
-                    transcode, engine, path, dst,
-                    decoder=decoder, encoder=encoder,
-                    encode_args=opts["encode_args"],
-                )
+                record = ctx.record
+
+                def _run_transcode(src=path, out=dst, dec=decoder,
+                                   enc=encoder):
+                    # bind the job's hop ledger to the engine for this
+                    # worker thread: the h2d/compute/d2h hops billed
+                    # inside the dispatch/fetch path land on THIS job
+                    if record is not None and record.hops is not None:
+                        with engine.hop_sink.bound(record.note_hop):
+                            return transcode(
+                                engine, src, out, decoder=dec, encoder=enc,
+                                encode_args=opts["encode_args"])
+                    return transcode(engine, src, out, decoder=dec,
+                                     encoder=enc,
+                                     encode_args=opts["encode_args"])
+
+                async def _compute(src=path):
+                    if faults.enabled():
+                        await faults.fire("compute.upscale",
+                                          key=os.path.basename(src))
+                    return await asyncio.to_thread(_run_transcode)
+
+                frames = await retrier.run(
+                    "compute.upscale", _compute, cancel=ctx.cancel,
+                    record=ctx.record, logger=logger)
                 logger.info(
                     "upscaled", path=os.path.basename(dst), frames=frames
                 )

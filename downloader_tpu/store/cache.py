@@ -379,15 +379,26 @@ class ContentCache:
             return self._entry_from_meta(key, meta)
 
     async def materialize(self, key: str, dest_dir: str) -> Optional[int]:
+        """Hardlink-or-copy entry ``key``'s files into ``dest_dir``;
+        returns bytes materialized, None when the entry vanished
+        (see :meth:`materialize_entry`)."""
+        got = await self.materialize_entry(key, dest_dir)
+        return got[0] if got is not None else None
+
+    async def materialize_entry(
+        self, key: str, dest_dir: str
+    ) -> "Optional[tuple[int, list]]":
         """Hardlink-or-copy entry ``key``'s files into ``dest_dir``.
 
-        Returns bytes materialized, or None when the entry vanished
-        (evicted between lookup and use) — the caller treats that as a
-        miss.  Never exposes a partial workdir: files land under a temp
-        name in ``dest_dir`` and rename into place only after every file
-        linked; a lost race leaves only temp droppings in the job's own
-        workdir, which the job overwrites or the upload-stage cleanup
-        removes with the directory.
+        Returns ``(bytes, dest_paths)`` — the absolute paths just
+        materialized, so a cache-hit job can be served from the known
+        list without re-walking the workdir — or None when the entry
+        vanished (evicted between lookup and use); the caller treats
+        that as a miss.  Never exposes a partial workdir: files land
+        under a temp name in ``dest_dir`` and rename into place only
+        after every file linked; a lost race leaves only temp droppings
+        in the job's own workdir, which the job overwrites or the
+        upload-stage cleanup removes with the directory.
         """
         async with self.pinned(key):
             # pin BEFORE the manifest read: once pinned the entry
@@ -426,7 +437,11 @@ class ContentCache:
                 return True
 
             ok = await asyncio.to_thread(_link_all)
-            return entry.size if ok else None
+            if not ok:
+                return None
+            dests = [os.path.join(dest_dir, *rel.split("/"))
+                     for rel in entry.files]
+            return entry.size, dests
 
     async def insert(self, key: str, src_dir: str) -> Optional[CacheEntry]:
         """Fill ``key`` from a completed job workdir.

@@ -1,0 +1,317 @@
+"""Sharded compute plane: chooser, partition table, donation, transfer
+queue, and hop billing (compute/parallel/).
+
+Virtual 8-device CPU mesh via conftest (XLA_FLAGS host device count).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from downloader_tpu.compute.models.upscaler import (  # noqa: E402
+    UpscalerConfig,
+    param_paths,
+)
+from downloader_tpu.compute.parallel import (  # noqa: E402
+    Decision,
+    HopSink,
+    TransferQueue,
+    UPSCALER_RULES,
+    choose,
+    compile_step,
+    decision_cache,
+    make_mesh,
+    match_partition_rules,
+    rule_audit,
+    spec_for,
+    timed_hop,
+)
+from downloader_tpu.compute.parallel.chooser import clear_decisions  # noqa: E402
+from downloader_tpu.compute.train import (  # noqa: E402
+    compile_train_step,
+    make_train_step,
+)
+
+TINY = UpscalerConfig(features=16, depth=2, scale=2)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_decisions():
+    clear_decisions()
+    yield
+    clear_decisions()
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8, model_axis=2).mesh
+
+
+# ---------------------------------------------------------------- chooser
+
+def test_chooser_no_mesh_is_jit():
+    d = choose(None, (8,), explicit_shardings=False)
+    assert d.strategy == "jit"
+
+
+def test_chooser_single_device_mesh_is_jit():
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:1]).reshape(1), ("data",))
+    d = choose(mesh, (8,), explicit_shardings=False)
+    assert d.strategy == "jit"
+
+
+def test_chooser_explicit_shardings_is_pjit(mesh8):
+    d = choose(mesh8, (8,), explicit_shardings=True)
+    assert d.strategy == "pjit"
+    assert "explicit" in d.reason
+
+
+def test_chooser_shape_polymorphic_is_pjit(mesh8):
+    d = choose(mesh8, None, explicit_shardings=False)
+    assert d.strategy == "pjit"
+    assert "polymorphic" in d.reason
+
+
+def test_chooser_even_batch_is_shard_map(mesh8):
+    # mesh8 is data=4; 8 % 4 == 0 -> per-shard specs win
+    d = choose(mesh8, (8,), explicit_shardings=False)
+    assert d.strategy == "shard_map"
+
+
+def test_chooser_indivisible_batch_is_pjit(mesh8):
+    # 7 % 4 != 0: shard_map cannot pad, pjit can
+    d = choose(mesh8, (7,), explicit_shardings=False)
+    assert d.strategy == "pjit"
+    assert "not divisible" in d.reason
+
+
+def test_chooser_decisions_pinned_per_shape_and_mesh(mesh8):
+    """The fixture table this suite pins: one decision per (shape, mesh),
+    cached — a hot loop never re-derives it."""
+    expected = {
+        (None, (8,)): "jit",
+        (mesh8, (8,)): "shard_map",
+        (mesh8, (7,)): "pjit",
+        (mesh8, None): "pjit",
+    }
+    for (mesh, shape), strategy in expected.items():
+        assert choose(mesh, shape, explicit_shardings=False).strategy == \
+            strategy
+    # every verdict above landed in the cache, and a re-ask is a hit
+    # (identical Decision object, not a recomputation)
+    assert len(decision_cache()) == len(expected)
+    before = choose(mesh8, (8,), explicit_shardings=False)
+    assert choose(mesh8, (8,), explicit_shardings=False) is before
+
+
+def test_compile_step_shard_map_requires_specs(mesh8):
+    with pytest.raises(ValueError, match="in_specs/out_specs"):
+        compile_step(lambda x: x, mesh8, batch_shape=(8,))
+
+
+def test_compile_step_shard_map_route_executes(mesh8):
+    fn, decision = compile_step(
+        lambda x: x * 2.0, mesh8, batch_shape=(8,),
+        in_specs=(P("data"),), out_specs=P("data"))
+    assert decision.strategy == "shard_map"
+    x = jnp.arange(8.0)
+    with mesh8:
+        np.testing.assert_allclose(np.asarray(fn(x)), np.arange(8.0) * 2)
+
+
+# -------------------------------------------------------- partition table
+
+@pytest.fixture(scope="module")
+def upscaler_params():
+    _, init_state = make_train_step(TINY)
+    params, _ = init_state(jax.random.PRNGKey(0), sample_shape=(1, 8, 8, 3))
+    return params
+
+
+def test_every_upscaler_param_matches_exactly_one_rule(upscaler_params):
+    """Unmatched → replicated is a FAILURE, not a fallback; so is a
+    param matched by two rules (first-match-wins would hide the drift)."""
+    audit = rule_audit(UPSCALER_RULES, upscaler_params)
+    assert audit, "audit saw no params"
+    bad = {name: pats for name, pats in audit.items() if len(pats) != 1}
+    assert not bad, f"params without exactly one rule: {bad}"
+
+
+def test_param_paths_helper_covers_initialized_tree(upscaler_params):
+    """The static name list (no init needed) agrees with a real init."""
+    audit = rule_audit(UPSCALER_RULES, upscaler_params)
+    assert sorted(param_paths(TINY)) == sorted(audit)
+
+
+def test_match_partition_rules_specs(upscaler_params):
+    specs = match_partition_rules(UPSCALER_RULES, upscaler_params)
+    inner = specs["params"]
+    assert inner["stem"]["kernel"] == P(None, None, None, "model")
+    assert inner["stem"]["bias"] == P("model")
+    assert inner["body_0"]["kernel"] == P(None, None, None, "model")
+    assert inner["subpixel"]["kernel"] == P()
+    assert inner["subpixel"]["bias"] == P()
+
+
+def test_unmatched_param_raises():
+    with pytest.raises(ValueError,
+                       match="Partition rule not found for param"):
+        spec_for(UPSCALER_RULES, "params/mystery/kernel",
+                 np.zeros((3, 3, 4, 4)))
+    with pytest.raises(ValueError, match="norm/scale"):
+        match_partition_rules(
+            UPSCALER_RULES,
+            {"params": {"norm": {"scale": np.zeros((16,))}}})
+
+
+def test_scalar_leaves_replicate_without_a_rule():
+    assert spec_for(UPSCALER_RULES, "count", np.asarray(0)) == P()
+
+
+# --------------------------------------------------------------- donation
+
+def test_compile_train_step_donates_state():
+    """Donation is real on the state-shaped step: the input params and
+    opt_state buffers are consumed (aliased into the outputs), so the
+    old state's memory is never resident alongside the new."""
+    step, init_state, decision = compile_train_step(TINY)
+    params, opt_state = init_state(
+        jax.random.PRNGKey(0), sample_shape=(1, 8, 8, 3))
+    low = jax.random.uniform(jax.random.PRNGKey(1), (4, 8, 8, 3))
+    high = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)
+
+    donated_leaf = jax.tree_util.tree_leaves(params)[0]
+    new_params, new_opt, loss = step(params, opt_state, low, high)
+    jax.block_until_ready(loss)
+    assert donated_leaf.is_deleted()
+    assert not jax.tree_util.tree_leaves(new_params)[0].is_deleted()
+    assert decision.strategy == "jit"
+
+    # the returned state is live and steps again (the aliasing didn't
+    # corrupt anything)
+    _, _, loss2 = step(new_params, new_opt, low, high)
+    assert np.isfinite(float(loss2))
+
+
+def test_compile_train_step_donate_off_keeps_inputs():
+    step, init_state, _ = compile_train_step(TINY, donate=False)
+    params, opt_state = init_state(
+        jax.random.PRNGKey(0), sample_shape=(1, 8, 8, 3))
+    low = jax.random.uniform(jax.random.PRNGKey(1), (4, 8, 8, 3))
+    high = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)
+    leaf = jax.tree_util.tree_leaves(params)[0]
+    step(params, opt_state, low, high)
+    assert not leaf.is_deleted()
+
+
+# ----------------------------------------------------------- TransferQueue
+
+def test_transfer_queue_depth_one_is_serial():
+    """depth=1 drains after every dispatch — the overlap probe's serial
+    lower bound: never more than zero batches left in flight."""
+    q = TransferQueue(lambda x: x, lambda h: h * 10, depth=1)
+    assert list(q.submit(1)) == [10]
+    assert len(q) == 0
+    assert list(q.submit(2)) == [20]
+    assert list(q.drain()) == []
+    assert (q.submitted, q.drained) == (2, 2)
+
+
+def test_transfer_queue_depth_two_double_buffers():
+    """depth=2 keeps one batch in flight: submit N yields N-1's result."""
+    events = []
+    q = TransferQueue(lambda x: events.append(("dispatch", x)) or x,
+                      lambda h: events.append(("fetch", h)) or h,
+                      depth=2)
+    assert list(q.submit("a")) == []          # first batch stays in flight
+    assert len(q) == 1
+    assert list(q.submit("b")) == ["a"]       # b dispatched BEFORE a fetched
+    assert events == [("dispatch", "a"), ("dispatch", "b"), ("fetch", "a")]
+    assert list(q.drain()) == ["b"]
+    assert (q.submitted, q.drained) == (2, 2)
+
+
+def test_transfer_queue_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        TransferQueue(lambda x: x, lambda h: h, depth=0)
+
+
+# ------------------------------------------------------ HopSink + billing
+
+def test_hop_sink_unbound_drops_samples():
+    sink = HopSink()
+    sink.note("h2d", 100, 0.5)  # must not raise
+
+
+def test_hop_sink_bound_forwards_and_restores():
+    sink = HopSink()
+    got = []
+    with sink.bound(lambda hop, n, s: got.append((hop, n))):
+        sink.note("h2d", 7, 0.1)
+        with sink.bound(lambda hop, n, s: got.append(("inner", n))):
+            sink.note("compute", 8, 0.1)
+        sink.note("d2h", 9, 0.1)  # outer sink restored after inner exits
+    sink.note("d2h", 10, 0.1)     # unbound again: dropped
+    assert got == [("h2d", 7), ("inner", 8), ("d2h", 9)]
+
+
+def test_hop_sink_is_thread_local():
+    import threading
+
+    sink = HopSink()
+    got = []
+    with sink.bound(lambda hop, n, s: got.append(hop)):
+        t = threading.Thread(target=lambda: sink.note("h2d", 1, 0.1))
+        t.start()
+        t.join()
+    assert got == []  # the other thread saw no binding
+
+
+def test_timed_hop_bills_wall_time():
+    import time
+
+    sink = HopSink()
+    got = []
+    with sink.bound(lambda hop, n, s: got.append((hop, n, s))):
+        with timed_hop(sink, "compute", 1024):
+            time.sleep(0.02)
+    (hop, nbytes, seconds), = got
+    assert (hop, nbytes) == ("compute", 1024)
+    assert seconds >= 0.02
+
+
+# ------------------------------------------- engine wiring (end to end)
+
+def test_engine_bills_three_hops_and_caches_decisions():
+    from downloader_tpu.compute.pipeline import FrameUpscaler
+
+    engine = FrameUpscaler(config=UpscalerConfig(features=8, depth=2),
+                           batch=8)
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 256, (8, 16, 16), dtype=np.uint8)
+    cb = rng.integers(0, 256, (8, 8, 8), dtype=np.uint8)
+    cr = rng.integers(0, 256, (8, 8, 8), dtype=np.uint8)
+
+    billed = {}
+
+    def _note(hop, nbytes, seconds):
+        total = billed.setdefault(hop, [0, 0.0])
+        total[0] += nbytes
+        total[1] += seconds
+
+    with engine.hop_sink.bound(_note):
+        engine.upscale_batch(y, cb, cr, 2, 2)
+
+    assert "compute" in billed and "d2h" in billed
+    if engine.n_devices > 1:
+        assert "h2d" in billed
+        assert billed["h2d"][0] > 0  # bytes staged onto the mesh
+    assert billed["d2h"][0] > 0
+    # the chooser's verdict for this (sub_h, sub_w) is cached on the engine
+    assert engine.compile_decisions
+    assert all(isinstance(d, Decision)
+               for d in engine.compile_decisions.values())

@@ -760,3 +760,89 @@ async def test_overload_pressure_sheds_bulk_never_high(
                                     for r in shed_records)
     finally:
         await orchestrator.shutdown(grace_seconds=5)
+
+
+# ---------------------------------------------------------------------------
+# Compute seam: the upscale stage's breaker + SLO class under chaos
+# ---------------------------------------------------------------------------
+
+async def test_compute_seam_fault_opens_compute_breaker(tmp_path):
+    """Faulting the ``compute.upscale`` seam must open the COMPUTE
+    breaker — visible with failure attribution on /readyz
+    (``breakerReasons``) and /metrics — while the replica stays ready
+    (compute is a per-job dependency, not an admission one), and the
+    upscale job must ride its own UPSCALE SLO class to completion once
+    the seam heals."""
+    from test_upscale import make_y4m
+
+    y4m = make_y4m(16, 12, frames=2)
+    runner, base = await start_media_server(y4m, path="/clip.y4m")
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    config = ConfigNode({
+        "instance": {
+            "download_path": str(tmp_path / "downloads"),
+            "upscale": {"enabled": True, "features": 8, "depth": 2,
+                        "batch": 4},
+        },
+        "retry": {
+            "default": {"attempts": 3, "base": 0.01, "cap": 0.05},
+            # one try per delivery -> each delivery records exactly one
+            # compute-breaker failure; threshold 2 opens on the second
+            "compute": {"attempts": 1, "base": 0.01, "cap": 0.02},
+            "redelivery": {"base": 0.02, "cap": 0.1},
+        },
+        "breakers": {
+            "default": {"threshold": 50, "reset": 0.5},
+            "compute": {"threshold": 2, "reset": 0.4},
+        },
+        "faults": {"plan": [
+            {"seam": "compute.upscale", "kind": "error", "count": 2},
+        ]},
+    })
+    orchestrator = await make_orchestrator(
+        tmp_path, broker, store, config,
+        stages=["download", "process", "upscale", "upload"])
+    session, api, api_cleanup = await serve_admin(orchestrator)
+    try:
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(f"{base}/clip.y4m",
+                                         job_id="job-cmp"))
+
+        breaker = orchestrator.breakers.get("compute")
+        await wait_for(lambda: breaker.state == "open", timeout=30)
+        assert breaker.open_reason == "failure"
+
+        # compute is NOT an admission dependency: the replica stays in
+        # rotation (200), but the open breaker and its attribution ride
+        # the body for triage
+        async with session.get(f"{api}/readyz") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["breakers"]["compute"] == "open"
+            assert body["breakerReasons"]["compute"] == "failure"
+            # upscale work is its own SLO objective class on the probe
+            assert "UPSCALE" in body["slo"]["objectives"]
+        async with session.get(f"{api}/metrics") as resp:
+            text = await resp.text()
+        assert 'breaker_state{dependency="compute"} 1.0' in text
+        assert ('breaker_opened_total{dependency="compute",'
+                'reason="failure"}') in text
+        assert 'slo_burn_rate{class="UPSCALE",window="fast"}' in text
+
+        # plan exhausted -> reset window elapses -> half-open probe
+        # succeeds -> job completes, breaker closes, no operator action
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=30)
+        record = orchestrator.registry.get("job-cmp")
+        assert record.state == "DONE"
+        assert record.workload == "UPSCALE"
+        assert breaker.state == "closed"
+
+        # the upscale step billed its own hops on the job's ledger
+        if record.hops is not None:
+            assert "compute" in record.hops.summary()
+            assert "d2h" in record.hops.summary()
+    finally:
+        await api_cleanup()
+        await orchestrator.shutdown(grace_seconds=5)
+        await runner.cleanup()

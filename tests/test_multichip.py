@@ -1,0 +1,108 @@
+"""Mesh-reshape parity on the virtual 8-device CPU mesh (tier-1).
+
+The MULTICHIP dry-run (__graft_entry__.dryrun_multichip) proves the two
+mesh shapes a pod resize moves between — ``{'data': 4, 'model': 2}`` and
+``{'data': 2, 'model': 4}`` — but as a slow, subprocess-shaped artifact.
+This suite pins the same parity claim fast and in-process: one training
+step on identical inputs must produce the same loss and the same updated
+parameters regardless of which way the 8 devices are factored, because
+the mesh only changes WHERE the math runs, never WHAT it computes.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from downloader_tpu.compute.models.upscaler import UpscalerConfig  # noqa: E402
+from downloader_tpu.compute.parallel.mesh import (  # noqa: E402
+    make_mesh,
+    shard_batch,
+    shard_params,
+)
+from downloader_tpu.compute.train import make_train_step  # noqa: E402
+
+# features must divide by the widest model axis (4)
+TINY = UpscalerConfig(features=16, depth=2, scale=2)
+
+
+def _one_step(plan, params, opt_state, low, high):
+    """One sharded training step on ``plan``'s mesh; returns host values."""
+    params = shard_params(plan, params)
+    opt_state = shard_params(plan, opt_state)
+    low = shard_batch(plan, low)
+    high = shard_batch(plan, high)
+    train_step, _ = make_train_step(TINY)
+    with plan.mesh:
+        new_params, _, loss = jax.jit(train_step)(
+            params, opt_state, low, high
+        )
+        loss.block_until_ready()
+    host = jax.tree_util.tree_map(np.asarray, new_params)
+    return float(loss), host
+
+
+def _checksum(tree) -> float:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(sum(np.abs(np.asarray(l, np.float64)).sum() for l in leaves))
+
+
+@pytest.fixture(scope="module")
+def step_inputs():
+    rng = jax.random.PRNGKey(7)
+    _, init_state = make_train_step(TINY)
+    params, opt_state = init_state(rng, sample_shape=(1, 8, 8, 3))
+    low = jax.random.uniform(rng, (8, 8, 8, 3))
+    high = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)
+    return params, opt_state, low, high
+
+
+def test_mesh_reshape_loss_parity(step_inputs):
+    """data=4/model=2 and data=2/model=4 agree on the loss."""
+    params, opt_state, low, high = step_inputs
+    plan_a = make_mesh(8, model_axis=2)
+    plan_b = make_mesh(8, model_axis=4)
+    assert dict(plan_a.mesh.shape) == {"data": 4, "model": 2}
+    assert dict(plan_b.mesh.shape) == {"data": 2, "model": 4}
+
+    loss_a, _ = _one_step(plan_a, params, opt_state, low, high)
+    loss_b, _ = _one_step(plan_b, params, opt_state, low, high)
+    assert np.isfinite(loss_a) and np.isfinite(loss_b)
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-4)
+
+
+def test_mesh_reshape_param_checksum_parity(step_inputs):
+    """The UPDATED parameters agree across the reshape — the resize moved
+    where the math runs, not what it computes."""
+    params, opt_state, low, high = step_inputs
+    _, updated_a = _one_step(make_mesh(8, model_axis=2),
+                             params, opt_state, low, high)
+    _, updated_b = _one_step(make_mesh(8, model_axis=4),
+                             params, opt_state, low, high)
+
+    np.testing.assert_allclose(
+        _checksum(updated_a), _checksum(updated_b), rtol=1e-4
+    )
+    # stronger than the scalar checksum: every leaf agrees elementwise
+    flat_a = jax.tree_util.tree_leaves_with_path(updated_a)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(updated_b))
+    for path, leaf_a in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(leaf_a, np.float32),
+            np.asarray(flat_b[path], np.float32),
+            rtol=5e-3, atol=1e-5,
+            err_msg=f"mesh reshape diverged at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_mesh_reshape_matches_single_device(step_inputs):
+    """Both mesh factorizations agree with the unsharded single-device
+    step, so the parity above is anchored to ground truth."""
+    params, opt_state, low, high = step_inputs
+    train_step, _ = make_train_step(TINY)
+    _, _, ref_loss = jax.jit(train_step)(params, opt_state, low, high)
+    for model_axis in (2, 4):
+        loss, _ = _one_step(make_mesh(8, model_axis=model_axis),
+                            params, opt_state, low, high)
+        np.testing.assert_allclose(float(ref_loss), loss, rtol=2e-2)

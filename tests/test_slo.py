@@ -448,3 +448,50 @@ async def test_slo_disabled_keeps_surfaces_silent(tmp_path):
         if runner is not None:
             await runner.cleanup()
         await orchestrator.shutdown(grace_seconds=5)
+
+
+# ---------------------------------------------------------------------------
+# UPSCALE workload class (ISSUE 16: compute is a first-class worker class)
+# ---------------------------------------------------------------------------
+
+def test_workload_objective_from_config_defaults_and_overrides():
+    from downloader_tpu.control.slo import DEFAULT_WORKLOAD_OBJECTIVES
+
+    tracker = SloTracker.from_config(ConfigNode({"slo": {}}))
+    p99, avail = DEFAULT_WORKLOAD_OBJECTIVES["UPSCALE"]
+    assert tracker.workload_objectives["UPSCALE"].p99_ms == p99
+    assert tracker.workload_objectives["UPSCALE"].availability == avail
+    assert "UPSCALE" in tracker.objective_names()
+
+    tuned = SloTracker.from_config(ConfigNode({"slo": {"objectives": {
+        "UPSCALE": {"p99_ms": 5000, "availability": 0.9},
+    }}}))
+    assert tuned.workload_objectives["UPSCALE"].p99_ms == 5000
+    assert tuned.workload_objectives["UPSCALE"].availability == 0.9
+    # the workload key is NOT a typo'd priority class
+    assert "UPSCALE" not in tuned.objectives
+
+
+def test_workload_objective_tracks_alongside_class():
+    """A settle whose record is stamped ``workload = "UPSCALE"`` burns
+    the workload budget AND its priority-class budget; an unstamped one
+    leaves the workload series untouched."""
+    clock = FakeClock()
+    tracker = SloTracker(
+        {"NORMAL": Objective("NORMAL", 60000.0, 0.999)},
+        workload_objectives={"UPSCALE": Objective("UPSCALE", 100.0, 0.99)},
+        clock=clock)
+
+    plain = Settled(clock, age_s=0.5)
+    tracker.note_settle(plain, "ack", "done")
+    upscale_series = tracker._series["UPSCALE"]
+    assert upscale_series.good_total == 0
+    assert upscale_series.bad_total == 0
+
+    upscaled = Settled(clock, age_s=0.5)  # 500 ms: past the 100 ms target
+    upscaled.workload = "UPSCALE"
+    tracker.note_settle(upscaled, "ack", "done")
+    assert tracker._series["NORMAL"].good_total == 2
+    assert upscale_series.bad_total == 1
+    assert "UPSCALE" in tracker.snapshot()["objectives"]
+    assert tracker.burn_rate("UPSCALE", tracker.fast_window) > 0
