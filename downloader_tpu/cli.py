@@ -216,8 +216,10 @@ def _build_parser() -> argparse.ArgumentParser:
                             help="service base URL")
     fleet_top = fleet_sub.add_parser(
         "top", help="live-refreshing fleet overview console (GET "
-                    "/v1/fleet/overview): members, burn rates, open "
-                    "breakers, tenant queue shares, top hops"
+                    "/v1/fleet/overview): members, queue depths, burn "
+                    "rates, open breakers, routing decisions, tenant "
+                    "queue shares, top hops, and the placement "
+                    "controller's plan"
     )
     fleet_top.add_argument("--url", default="http://127.0.0.1:3401",
                            help="service base URL (any worker serves "
@@ -632,13 +634,24 @@ def render_overview(body: dict) -> list:
     import time as _time
 
     now = _time.time()
+    plan = body.get("plan")
     lines.append("WORKER            QUEUE ACTIVE LEASES  "
-                 "BURN fast/slow (worst)   BREAKERS          BEAT")
+                 "BURN fast/slow (worst)   BREAKERS     "
+                 "DECISION      BEAT")
     for member in members:
         signals = member.get("signals") or {}
         digest = member.get("digest")
         burn = "-"
         breakers = "-"
+        decision = "-"
+        if isinstance(digest, dict):
+            last = digest.get("lastDecision")
+            if isinstance(last, dict) and last.get("outcome"):
+                decision = str(last["outcome"])
+            if (isinstance(plan, dict)
+                    and member.get("workerId") in (plan.get("drain")
+                                                   or [])):
+                decision = "drain"
         if isinstance(digest, dict):
             rates = digest.get("burn") or {}
             if rates:
@@ -664,7 +677,7 @@ def render_overview(body: dict) -> list:
             f"{signals.get('queue_depth', '-'):>5} "
             f"{signals.get('active_jobs', '-'):>6} "
             f"{str(member.get('leases', '-')):>6}  "
-            f"{burn:<24} {breakers:<17} {beat_s}")
+            f"{burn:<24} {breakers:<12} {decision:<13} {beat_s}")
     shares = totals.get("tenantShares") or {}
     if shares:
         lines.append("tenant queue shares: " + "  ".join(
@@ -677,6 +690,19 @@ def render_overview(body: dict) -> list:
     ratio = totals.get("hopReconcileRatioMixed")
     if ratio is not None:
         lines.append(f"hop/stage reconcile (mixed, unguarded): {ratio}")
+    if isinstance(plan, dict):
+        admission = plan.get("admission") or {}
+        shed = ("SHED BULK (" + str(admission.get("reason") or "") + ")"
+                if admission.get("shedBulk") else "admit all")
+        drain = ",".join(plan.get("drain") or []) or "none"
+        tail = plan.get("decisions") or []
+        last = (f"  last: {tail[-1].get('kind')} ({tail[-1].get('why')})"
+                if tail else "")
+        lines.append(
+            f"plan[{plan.get('epoch')}] by {plan.get('updatedBy')}: "
+            f"{shed}  drain={drain}  "
+            f"desired={plan.get('desiredWorkers')} "
+            f"({plan.get('scale')}){last}")
     return lines
 
 

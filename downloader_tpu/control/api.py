@@ -25,6 +25,12 @@ serves probes, metrics, and operations:
                                     aggregator folds each heartbeat;
                                     coord trouble degrades to the local
                                     view (degraded: true), never a 5xx
+    GET  /v1/fleet/plan             the placement controller's plan doc
+                                    (admission shed, drain set, desired
+                                    workers, bounded decision tail) as
+                                    this worker's watch-fed cache holds
+                                    it; always 200 — absent/stale plan
+                                    just reads as plan: null/fresh:false
     GET  /v1/fleet/{id}             one worker's latest heartbeat doc
     GET  /v1/tenants                tenancy + overload posture: per-
                                     tenant weight/caps/quotas, live queue
@@ -51,6 +57,7 @@ from __future__ import annotations
 import asyncio
 import hmac
 import os
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -236,6 +243,46 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
             age = plane.overview_age()
             if age is not None:
                 payload["overviewAgeSeconds"] = round(age, 3)
+        # the controller's current plan rides along (watch-fed cache,
+        # no extra round trip) so `cli fleet top` shows admission/
+        # drain/scale posture in the same frame
+        plan = plane.current_plan()
+        if plan is not None:
+            payload["plan"] = plan
+        return web.json_response(payload)
+
+    async def fleet_plan(_request: web.Request) -> web.Response:
+        """The placement controller's plan (ISSUE 17): served from THIS
+        worker's watch-fed cache — the exact document admission acts on
+        here, zero coordination round trips, so the endpoint stays up
+        (and honest) through coord brownout.  ``fresh`` is the router's
+        own staleness gate: false means admission is running
+        uncontrolled even though a (stale) plan body is shown."""
+        plane = getattr(orchestrator, "fleet", None)
+        controller = getattr(orchestrator, "controller", None)
+        payload: dict = {
+            "enabled": plane is not None,
+            "workerId": getattr(orchestrator, "worker_id", None),
+            "plan": None,
+            "fresh": False,
+            "controller": None,
+        }
+        if controller is not None:
+            payload["controller"] = {
+                "running": controller._task is not None,
+                "ticks": controller.ticks,
+                "plansPublished": controller.plans_published,
+            }
+        if plane is None:
+            return web.json_response(payload)
+        fresh = plane.current_plan()
+        doc = fresh if fresh is not None else plane._plan_doc
+        if doc is not None:
+            payload["plan"] = doc
+            payload["fresh"] = fresh is not None
+            payload["planAgeSeconds"] = round(
+                max(time.time() - float(doc.get("updatedAt", 0) or 0),
+                    0.0), 3)
         return web.json_response(payload)
 
     async def fleet_show(request: web.Request) -> web.Response:
@@ -381,9 +428,11 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     app.router.add_get("/v1/trace/{id}", trace_show)
     # fleet plane: membership, leases, per-worker heartbeat payloads
     app.router.add_get("/v1/fleet", fleet_list)
-    # the aggregated overview must register BEFORE the {id} route or
-    # "overview" would be captured as a worker id
+    # the aggregated overview + the controller's plan must register
+    # BEFORE the {id} route or "overview"/"plan" would be captured as
+    # worker ids
     app.router.add_get("/v1/fleet/overview", fleet_overview)
+    app.router.add_get("/v1/fleet/plan", fleet_plan)
     app.router.add_get("/v1/fleet/{id}", fleet_show)
     # tenancy + overload: per-tenant weights/caps/quotas, live queue
     # depth and slot occupancy, and the saturation snapshot

@@ -21,6 +21,7 @@ from .coord import (
     ABSENT,
     ANY,
     BucketCoordStore,
+    CasBucketCoordStore,
     CoordError,
     CoordStore,
     MemoryCoordStore,
@@ -35,6 +36,7 @@ from .plane import (
 
 __all__ = [
     "ABSENT", "ANY", "LED", "SHARED", "UNCOORDINATED",
-    "BucketCoordStore", "CoordError", "CoordStore", "FleetPlane",
-    "MemoryCoordStore", "resolve_worker_id",
+    "BucketCoordStore", "CasBucketCoordStore", "CoordError",
+    "CoordStore", "FleetPlane", "MemoryCoordStore",
+    "resolve_worker_id",
 ]

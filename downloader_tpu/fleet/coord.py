@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import dataclasses
 import itertools
 import json
 import os
@@ -60,6 +61,103 @@ class CoordError(RuntimeError):
     degrades to uncoordinated operation, jobs never fail on this)."""
 
     fault_class = TRANSIENT
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    """One observed change under a watched prefix.
+
+    ``data is None`` means the key went away (deleted / tombstoned);
+    otherwise ``data``/``token`` are the entry's new value and write
+    token, exactly what a ``get`` at that instant would have returned.
+    """
+
+    key: str
+    data: Optional[dict]
+    token: Optional[str]
+
+
+class CoordWatch:
+    """Subscription handle returned by :meth:`CoordStore.watch`.
+
+    Etcd-shaped semantics scaled down to this substrate: ``next(
+    timeout)`` blocks until something under the prefix changes and
+    returns the batched events, or ``[]`` when the timeout lapses with
+    nothing new — a *bounded* long-poll, never an unbounded hang, so
+    callers' wait budgets stay enforceable.  ``next(0)`` is a
+    non-blocking drain.  Store trouble surfaces as :class:`CoordError`
+    exactly like the reads a watch replaces; per the degradation
+    contract callers fall back to their sleep-poll loop and keep
+    working.  ``close()`` detaches the watch; a closed watch returns
+    ``[]`` forever.
+    """
+
+    prefix: str = ""
+
+    async def next(self, timeout: float) -> List[WatchEvent]:
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+class _PollWatch(CoordWatch):
+    """Snapshot-diff bounded long-poll: the universal fallback watch.
+
+    Works against any :class:`CoordStore` (one ``list_keys`` + one
+    ``get`` per live key per lap), which makes it the degraded path the
+    event-driven backends fall back to — and the only path on backends
+    with no native change feed (the bucket stores).  The first ``next``
+    seeds the snapshot silently: a watch reports *changes after it was
+    opened*, not pre-existing state (callers read current state with
+    ``get`` before watching, the standard read-then-watch pattern).
+    """
+
+    def __init__(self, store: "CoordStore", prefix: str,
+                 interval: float = 0.25):
+        self.store = store
+        self.prefix = prefix
+        self.interval = float(interval)
+        self._snapshot: Optional[Dict[str, str]] = None
+        self._closed = False
+
+    async def _scan(self) -> Dict[str, Tuple[dict, str]]:
+        live: Dict[str, Tuple[dict, str]] = {}
+        for key in await self.store.list_keys(self.prefix):
+            entry = await self.store.get(key)
+            if entry is not None:
+                live[key] = entry
+        return live
+
+    async def next(self, timeout: float) -> List[WatchEvent]:
+        if self._closed:
+            return []
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        if self._snapshot is None:
+            self._snapshot = {
+                key: entry[1]
+                for key, entry in (await self._scan()).items()
+            }
+        while not self._closed:
+            live = await self._scan()
+            events: List[WatchEvent] = []
+            for key, (data, token) in live.items():
+                if self._snapshot.get(key) != token:
+                    events.append(WatchEvent(key, data, token))
+            for key in self._snapshot:
+                if key not in live:
+                    events.append(WatchEvent(key, None, None))
+            if events:
+                self._snapshot = {k: e[1] for k, e in live.items()}
+                return events
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            await asyncio.sleep(min(self.interval, remaining))
+        return []
+
+    def close(self) -> None:
+        self._closed = True
 
 
 class CoordStore(abc.ABC):
@@ -92,6 +190,63 @@ class CoordStore(abc.ABC):
     async def list_keys(self, prefix: str) -> List[str]:
         """Keys with a live entry under ``prefix``."""
 
+    def watch(self, prefix: str, *,
+              poll_interval: float = 0.25) -> CoordWatch:
+        """Subscribe to changes under ``prefix`` (see :class:`CoordWatch`).
+
+        The default is the snapshot-diff bounded long-poll — correct on
+        every backend, paying one scan per ``poll_interval``.  Backends
+        with a cheaper change feed (the in-memory store's version bump)
+        override this with a true event-driven watch; callers cannot
+        tell the difference except in wake-up latency.
+        """
+        return _PollWatch(self, prefix, poll_interval)
+
+
+class _MemoryWatch(CoordWatch):
+    """Event-driven watch: pushed by the store's mutations, no polling."""
+
+    #: buffered-event cap — a watcher that stops draining must not
+    #: grow without bound; overflow drops the OLDEST events, which is
+    #: safe because every consumer re-reads current state on wake
+    MAX_BUFFER = 256
+
+    def __init__(self, store: "MemoryCoordStore", prefix: str):
+        self.store = store
+        self.prefix = prefix
+        self._buffer: List[WatchEvent] = []
+        self._wake = asyncio.Event()
+        self._closed = False
+
+    def _push(self, event: WatchEvent) -> None:
+        self._buffer.append(event)
+        if len(self._buffer) > self.MAX_BUFFER:
+            del self._buffer[: len(self._buffer) - self.MAX_BUFFER]
+        self._wake.set()
+
+    async def next(self, timeout: float) -> List[WatchEvent]:
+        if self._closed:
+            return []
+        if faults.enabled():
+            # same seam a poll lap would hit: a coord brownout slows /
+            # breaks watch wake-ups too, so chaos plans can rehearse
+            # the watch-to-poll fallback
+            await faults.fire("coord.get", key=self.prefix)
+        if not self._buffer and timeout > 0:
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       max(float(timeout), 0.0))
+            except asyncio.TimeoutError:
+                pass
+        events, self._buffer = self._buffer, []
+        self._wake.clear()
+        return events
+
+    def close(self) -> None:
+        self._closed = True
+        self.store._watchers.discard(self)
+        self._wake.set()
+
 
 class MemoryCoordStore(CoordStore):
     """Atomic in-process backend; share ONE instance across workers."""
@@ -100,6 +255,19 @@ class MemoryCoordStore(CoordStore):
         self._entries: Dict[str, Tuple[dict, str]] = {}
         self._lock = asyncio.Lock()
         self._seq = itertools.count(1)
+        self._watchers: set = set()
+
+    def watch(self, prefix: str, *,
+              poll_interval: float = 0.25) -> CoordWatch:
+        handle = _MemoryWatch(self, prefix)
+        self._watchers.add(handle)
+        return handle
+
+    def _notify(self, key: str, data: Optional[dict],
+                token: Optional[str]) -> None:
+        for handle in list(self._watchers):
+            if key.startswith(handle.prefix):
+                handle._push(WatchEvent(key, data, token))
 
     async def get(self, key: str) -> Optional[Tuple[dict, str]]:
         if faults.enabled():
@@ -121,6 +289,7 @@ class MemoryCoordStore(CoordStore):
                 return None
             token = f"m{next(self._seq)}"
             self._entries[key] = (dict(data), token)
+            self._notify(key, dict(data), token)
             return token
 
     async def delete(self, key: str, expect: str = ANY) -> bool:
@@ -133,6 +302,7 @@ class MemoryCoordStore(CoordStore):
             if expect != ANY and current[1] != expect:
                 return False
             del self._entries[key]
+            self._notify(key, None, None)
             return True
 
     async def list_keys(self, prefix: str) -> List[str]:
@@ -342,3 +512,119 @@ class BucketCoordStore(CoordStore):
             except Exception as err:
                 raise CoordError(f"coord sweep {key}: {err}") from err
         return removed
+
+
+class CasBucketCoordStore(BucketCoordStore):
+    """Truly-conditional bucket coordination via S3 conditional writes.
+
+    Same document shape, prefix, and tombstone discipline as
+    :class:`BucketCoordStore`, but the write token is the object's
+    **ETag** and every put is an ``If-Match`` / ``If-None-Match``
+    conditional PUT that the *server* arbitrates — no nonce race, no
+    settle delay, no read-back window: a lost race is a 412, atomically
+    (AWS S3 since 2024-08, MinIO, R2 all implement it; the in-memory
+    fake and MiniS3 mirror the semantics).  Select with
+    ``fleet.backend: cas``; a store without ``put_object_cas`` raises
+    NotImplementedError on first write, surfaced as CoordError, and the
+    operator falls back to ``bucket``.
+    """
+
+    def __init__(self, store, bucket: str = STAGING_BUCKET,
+                 prefix: str = ".fleet/"):
+        super().__init__(store, bucket, prefix, settle_delay=0.0)
+
+    #: read-CAS laps for ``expect=ANY`` writes before conceding — ANY
+    #: writers are per-key owners (heartbeats, telemetry) in practice,
+    #: so one lap is the overwhelmingly common case
+    ANY_RETRIES = 8
+
+    def _body(self, data: Optional[dict]) -> bytes:
+        # keep the embedded nonce "token" field so documents stay
+        # readable by BucketCoordStore peers (mixed fleets) and
+        # sweep_tombstones' revival check stays meaningful; the
+        # authoritative write token is the etag, not this nonce
+        return json.dumps({
+            "data": data, "token": self._nonce(),
+            "at": round(time.time(), 3),
+        }).encode("utf-8")
+
+    async def _read_versioned(
+            self, key: str) -> Optional[Tuple[Optional[dict], str]]:
+        """``(data|None, etag)`` including tombstones; None = no object."""
+        try:
+            raw, etag = await self.store.get_object_versioned(
+                self.bucket, self._object(key))
+        except ObjectNotFound:
+            return None
+        except Exception as err:
+            raise CoordError(f"coord get {key}: {err}") from err
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            return doc["data"], str(etag)
+        except (ValueError, KeyError, UnicodeDecodeError) as err:
+            raise CoordError(f"corrupt coordination entry {key}: {err}")
+
+    async def get(self, key: str) -> Optional[Tuple[dict, str]]:
+        if faults.enabled():
+            await faults.fire("coord.get", key=key)
+        entry = await self._read_versioned(key)
+        if entry is None or entry[0] is None:
+            return None
+        return entry[0], entry[1]
+
+    async def _cas_put(self, key: str, body: bytes, *,
+                       if_match: Optional[str] = None,
+                       if_none_match: bool = False) -> Optional[str]:
+        try:
+            await self._ensure_bucket()
+            return await self.store.put_object_cas(
+                self.bucket, self._object(key), body,
+                if_match=if_match, if_none_match=if_none_match)
+        except Exception as err:
+            raise CoordError(f"coord put {key}: {err}") from err
+
+    async def put(self, key: str, data: dict,
+                  expect: str = ANY) -> Optional[str]:
+        if faults.enabled():
+            await faults.fire("coord.put", key=key)
+        body = self._body(data)
+        if expect == ABSENT:
+            token = await self._cas_put(key, body, if_none_match=True)
+            if token is not None:
+                return token
+            # an object exists — but a *tombstone* still counts as
+            # absent: retake it by CAS-replacing that exact version
+            entry = await self._read_versioned(key)
+            if entry is None:
+                # removed between attempts (GC sweep): one more create
+                return await self._cas_put(key, body, if_none_match=True)
+            if entry[0] is not None:
+                return None  # genuinely live: lost the race
+            return await self._cas_put(key, body, if_match=entry[1])
+        if expect != ANY:
+            return await self._cas_put(key, body, if_match=expect)
+        for _ in range(self.ANY_RETRIES):
+            entry = await self._read_versioned(key)
+            if entry is None:
+                token = await self._cas_put(key, body, if_none_match=True)
+            else:
+                token = await self._cas_put(key, body, if_match=entry[1])
+            if token is not None:
+                return token
+        return None
+
+    async def delete(self, key: str, expect: str = ANY) -> bool:
+        if faults.enabled():
+            await faults.fire("coord.delete", key=key)
+        body = self._body(None)
+        for _ in range(self.ANY_RETRIES):
+            entry = await self._read_versioned(key)
+            if entry is None or entry[0] is None:
+                return True
+            if expect != ANY and entry[1] != expect:
+                return False
+            if await self._cas_put(key, body, if_match=entry[1]) is not None:
+                return True
+            if expect != ANY:
+                return False  # our exact version was replaced: lost
+        return False

@@ -68,8 +68,8 @@ from ..platform.config import cfg_get
 from ..platform.tracing import parse_traceparent
 from ..stages.upload import STAGING_BUCKET
 from ..store.base import ObjectNotFound
-from .coord import (ABSENT, ANY, BucketCoordStore, CoordError, CoordStore,
-                    MemoryCoordStore)
+from .coord import (ABSENT, ANY, BucketCoordStore, CasBucketCoordStore,
+                    CoordError, CoordStore, CoordWatch, MemoryCoordStore)
 
 # coordination-store key namespaces
 WORKERS_PREFIX = "workers/"
@@ -82,6 +82,16 @@ TELEMETRY_PREFIX = "telemetry/"
 # burn rates, breakers, tenant queue shares — any worker can serve)
 OVERVIEW_PREFIX = "overview/"
 OVERVIEW_KEY = OVERVIEW_PREFIX + "fleet"
+# the one placement/autoscale plan document the elected controller
+# (fleet/controller.py) publishes each heartbeat; every worker watches
+# it and consults the cached copy at admission (ISSUE 17)
+PLAN_PREFIX = "plan/"
+PLAN_KEY = PLAN_PREFIX + "fleet"
+# the fleet-shared origin-health table: per-origin throughput EWMAs
+# merged from every worker, seeded into each worker's OriginHealth at
+# boot (a worker that watched an origin die spares its peers the probe)
+ORIGINS_PREFIX = "origins/"
+ORIGIN_HEALTH_KEY = ORIGINS_PREFIX + "health"
 # shared-tier object layout in the staging bucket
 SHARED_PREFIX = ".fleet-cache/"
 MANIFEST_NAME = "manifest.json"
@@ -101,6 +111,12 @@ DEFAULT_SHARED_MAX_BYTES = 0  # 0 = no size budget (age bound only)
 # per-job trace digests published at settle live this long before the
 # fleet GC reclaims them (0 disables publishing entirely)
 DEFAULT_TELEMETRY_TTL = 1800.0
+# seconds between merges of this worker's per-origin EWMAs into the
+# fleet-shared origin-health table (0 disables sharing)
+DEFAULT_ORIGIN_SHARE_INTERVAL = 60.0
+# a fleet-shared origin-health row older than this is stale history,
+# not a head start: boot seeding skips it
+ORIGIN_HEALTH_MAX_AGE = 6 * 3600.0
 # events kept in one digest: enough for the lifecycle + failure tail,
 # bounded so a digest document stays a few KB
 DIGEST_EVENT_LIMIT = 48
@@ -147,16 +163,23 @@ class _GcLeaseViewUnavailable(Exception):
 class _Lease:
     """One held lease: its CAS token and the renewal task keeping it."""
 
-    __slots__ = ("key", "token", "fence", "renewer", "trace")
+    __slots__ = ("key", "token", "fence", "renewer", "trace",
+                 "route_key")
 
     def __init__(self, key: str, token: str, fence: int,
-                 trace: Optional[dict] = None):
+                 trace: Optional[dict] = None,
+                 route_key: Optional[str] = None):
         self.key = key
         self.token = token
         self.fence = fence
         # the leading job's W3C trace context, re-stamped on every
         # renewal so waiters always see which trace their wait joins
         self.trace = trace
+        # the admission-edge routing identity (cache_key over the
+        # source URI) — stamped into the lease doc so every worker's
+        # watch-fed lease view can steer same-content deliveries to
+        # this holder (ISSUE 17 content-aware routing)
+        self.route_key = route_key
         self.renewer: Optional[asyncio.Task] = None
 
 
@@ -181,11 +204,14 @@ class FleetPlane:
         shared_max_bytes: int = DEFAULT_SHARED_MAX_BYTES,
         telemetry_ttl: float = DEFAULT_TELEMETRY_TTL,
         advertise_url: Optional[str] = None,
+        watch_enabled: bool = True,
+        origin_share_interval: float = DEFAULT_ORIGIN_SHARE_INTERVAL,
         metrics=None,
         logger=None,
         retrier=None,
         payload_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         digest_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        origin_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         if liveness_ttl <= heartbeat_interval:
             raise ValueError(
@@ -226,6 +252,26 @@ class FleetPlane:
         # contract: a pre-PR-15 worker's heartbeat simply has no
         # digest, and build_overview lists it with ``digest: null``.
         self.digest_fn = digest_fn
+        # per-origin throughput snapshot for the fleet-shared
+        # origin-health table (orchestrator wires OriginHealth.snapshot;
+        # None = this worker does not share)
+        self.origin_fn = origin_fn
+        self.origin_share_interval = float(origin_share_interval)
+        self._origin_shared_mono = 0.0
+        # watch/subscribe plane (ISSUE 17): event-driven on backends
+        # that can, snapshot-diff long-poll otherwise, and OFF entirely
+        # (pure sleep-poll, the PR 9 degraded path) when disabled
+        self.watch_enabled = bool(watch_enabled)
+        self._overview_watch: Optional[CoordWatch] = None
+        self._overview_doc: Optional[dict] = None
+        self._plan_watch: Optional[CoordWatch] = None
+        self._plan_doc: Optional[dict] = None
+        self._lease_watch: Optional[CoordWatch] = None
+        # lease-doc cache fed by the lease watch (content key -> doc):
+        # the content router's holder lookups must not cost a store RTT
+        # per delivery
+        self._lease_view: Dict[str, dict] = {}
+        self._lease_view_ready = False
         # wall-clock ``updatedAt`` of the overview doc this worker last
         # published or read (None until either happens) — the
         # ``fleet_overview_age_seconds`` gauge's source
@@ -259,26 +305,28 @@ class FleetPlane:
             "gcSharedEvicted": 0, "gcTombstonesCompacted": 0,
             "gcBytesReclaimed": 0,
             "telemetryPublished": 0, "gcTelemetryEvicted": 0,
-            "fencedWrites": 0,
+            "fencedWrites": 0, "originHealthShared": 0,
         }
 
     # -- config ---------------------------------------------------------
     @classmethod
     def from_config(cls, config, *, worker_id: str, store=None, coord=None,
                     metrics=None, logger=None, retrier=None,
-                    payload_fn=None, digest_fn=None
+                    payload_fn=None, digest_fn=None, origin_fn=None
                     ) -> Optional["FleetPlane"]:
         """Build from ``fleet.*`` / env; None when the fleet is disabled
         (the default — a lone worker pays nothing for this subsystem).
 
         Knobs: ``FLEET_ENABLED``/``fleet.enabled``, ``fleet.backend``
-        (``bucket`` default | ``memory``), ``fleet.heartbeat_interval``,
-        ``fleet.liveness_ttl``, ``fleet.lease_ttl``,
-        ``fleet.poll_interval``, ``fleet.max_wait``,
+        (``bucket`` default | ``cas`` | ``memory``),
+        ``fleet.heartbeat_interval``, ``fleet.liveness_ttl``,
+        ``fleet.lease_ttl``, ``fleet.poll_interval``, ``fleet.max_wait``,
         ``fleet.shared_tier`` (false keeps leases but skips the spill),
         ``fleet.gc_interval`` (0 disables the GC sweep),
         ``fleet.shared_max_age`` / ``fleet.shared_max_bytes`` (shared-
-        tier eviction bounds).
+        tier eviction bounds), ``fleet.watch_enabled`` (false pins the
+        degraded sleep-poll path), ``fleet.origin_share_interval``
+        (0 disables the shared origin-health table).
         """
         enabled = os.environ.get("FLEET_ENABLED")
         if enabled is None:
@@ -302,9 +350,18 @@ class FleetPlane:
                         "fleet.backend: bucket needs an object store"
                     )
                 coord = BucketCoordStore(store)
+            elif backend == "cas":
+                # real conditional puts (S3 If-Match / If-None-Match):
+                # server-arbitrated CAS, no settle delay, no read-back
+                if store is None:
+                    raise ValueError(
+                        "fleet.backend: cas needs an object store"
+                    )
+                coord = CasBucketCoordStore(store)
             else:
                 raise ValueError(
-                    f"fleet.backend must be bucket|memory, got {backend!r}"
+                    f"fleet.backend must be bucket|cas|memory, "
+                    f"got {backend!r}"
                 )
         shared = bool(cfg_get(config, "fleet.shared_tier", True))
         return cls(
@@ -331,8 +388,14 @@ class FleetPlane:
             telemetry_ttl=float(cfg_get(
                 config, "fleet.telemetry_ttl", DEFAULT_TELEMETRY_TTL)),
             advertise_url=cfg_get(config, "fleet.advertise_url", None),
+            watch_enabled=bool(cfg_get(
+                config, "fleet.watch_enabled", True)),
+            origin_share_interval=float(cfg_get(
+                config, "fleet.origin_share_interval",
+                DEFAULT_ORIGIN_SHARE_INTERVAL)),
             metrics=metrics, logger=logger, retrier=retrier,
             payload_fn=payload_fn, digest_fn=digest_fn,
+            origin_fn=origin_fn,
         )
 
     # -- plumbing -------------------------------------------------------
@@ -352,6 +415,79 @@ class FleetPlane:
             return await factory()
         return await self.retrier.run(seam, factory, cancel=cancel,
                                       logger=self.logger)
+
+    # -- watch/subscribe plumbing ---------------------------------------
+    def _note_watch_wakeup(self, mode: str) -> None:
+        """Count one watch-plane wake-up: ``event`` (the watch
+        delivered changes), ``timeout`` (bounded long-poll lapsed), or
+        ``poll`` (degraded to sleep-poll — watch unavailable/broken)."""
+        if self.metrics is not None:
+            self.metrics.fleet_watch_wakeups.labels(mode=mode).inc()
+
+    def _open_watch(self, prefix: str) -> Optional[CoordWatch]:
+        """A watch on ``prefix``, or None when the watch plane is off
+        or the store refused — the caller's poll loop is the fallback."""
+        if not self.watch_enabled:
+            return None
+        try:
+            return self.coord.watch(prefix,
+                                    poll_interval=self.poll_interval)
+        except Exception as err:
+            self._note_coord_error("watch_open", err)
+            return None
+
+    def telemetry_watch(self) -> Optional[CoordWatch]:
+        """A watch over the fleet's per-job telemetry digests — every
+        settle publishes one, so a wake here is 'a peer just finished
+        something'.  The staged-probe loop (orchestrator) rides this to
+        retire recovery placeholders promptly instead of waiting out
+        its fallback interval.  None = watch plane off/refused."""
+        return self._open_watch(TELEMETRY_PREFIX)
+
+    async def _drain_watch(self, watch: Optional[CoordWatch]
+                           ) -> Optional[list]:
+        """Non-blocking drain of one maintained watch; None = watch
+        unusable this lap (closed/broken), [] = open but quiet."""
+        if watch is None:
+            return None
+        try:
+            return await watch.next(0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("watch", err)
+            return None
+
+    async def _waiter_wait(self, watch: Optional[CoordWatch],
+                           deadline: float) -> Optional[CoordWatch]:
+        """One parked-waiter lap: block until the watched lease doc
+        changes (the leader released/renewed, a takeover rewrote it),
+        a bounded long-poll lapses, or — no watch — one poll-interval
+        sleep, the PR 9 degraded path.  Returns the watch to keep
+        using; None once it broke (sleep-poll from there on)."""
+        if watch is None:
+            self._note_watch_wakeup("poll")
+            await asyncio.sleep(self.poll_interval)
+            return None
+        # bounded lap: a missed event (brownout, watch races) must not
+        # outwait lease EXPIRY — cap at the takeover grace so a dead
+        # leader is still noticed promptly; floor at poll_interval so
+        # a nearly-due deadline cannot busy-spin the watch
+        timeout = max(self.poll_interval,
+                      min(self.lease_ttl * TAKEOVER_GRACE_FRAC,
+                          deadline - time.monotonic()))
+        try:
+            events = await watch.next(timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("watch", err)
+            watch.close()
+            self._note_watch_wakeup("poll")
+            await asyncio.sleep(self.poll_interval)
+            return None
+        self._note_watch_wakeup("event" if events else "timeout")
+        return watch
 
     # -- fencing --------------------------------------------------------
     def _observe_fence(self, key: str, fence) -> None:
@@ -498,6 +634,21 @@ class FleetPlane:
                 raise
             except Exception as err:
                 self._note_coord_error("overview", err)
+            try:
+                # refresh the watch-fed lease/plan caches the content
+                # router consults at admission (same posture: cache
+                # trouble degrades routing, never the beat)
+                await self._refresh_views()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("views", err)
+            try:
+                await self._origin_health_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self._note_coord_error("origin_health", err)
             await asyncio.sleep(self.heartbeat_interval)
 
     async def start(self) -> None:
@@ -534,6 +685,13 @@ class FleetPlane:
             except (asyncio.CancelledError, Exception):
                 pass
             self._heartbeat_task = None
+        for watch in (self._overview_watch, self._plan_watch,
+                      self._lease_watch):
+            if watch is not None:
+                watch.close()
+        self._overview_watch = None
+        self._plan_watch = None
+        self._lease_watch = None
         for key in list(self._held):
             await self.release_lease(key)
         try:
@@ -601,7 +759,8 @@ class FleetPlane:
             "worker": self.worker_id,
         }
 
-    def _lease_doc(self, fence: int, trace: Optional[dict] = None) -> dict:
+    def _lease_doc(self, fence: int, trace: Optional[dict] = None,
+                   route_key: Optional[str] = None) -> dict:
         now = time.time()
         doc = {
             "owner": self.worker_id,
@@ -614,10 +773,16 @@ class FleetPlane:
             # parked on this key knows exactly which trace (and which
             # worker's fetch) it is waiting on
             doc["trace"] = dict(trace)
+        if route_key:
+            # the content router's lookup identity: peers consult their
+            # watch-fed lease view for this and hand same-content
+            # deliveries to the holder instead of parking N-1 workers
+            doc["routeKey"] = route_key
         return doc
 
     async def try_acquire_lease(self, key: str,
-                                trace: Optional[dict] = None
+                                trace: Optional[dict] = None,
+                                route_key: Optional[str] = None
                                 ) -> Optional[_Lease]:
         """One conditional-put attempt on ``leases/<key>``.
 
@@ -638,7 +803,8 @@ class FleetPlane:
             # a stale writer's horizon is one job lifetime.)
             fence = self.observed_fence(key) + 1
             token = await self.coord.put(
-                lease_key, self._lease_doc(fence, trace), expect=ABSENT
+                lease_key, self._lease_doc(fence, trace, route_key),
+                expect=ABSENT
             )
             takeover = False
         else:
@@ -660,13 +826,15 @@ class FleetPlane:
             fence = max(int(doc.get("fence", 0)),
                         self.observed_fence(key)) + 1
             token = await self.coord.put(
-                lease_key, self._lease_doc(fence, trace), expect=old_token
+                lease_key, self._lease_doc(fence, trace, route_key),
+                expect=old_token
             )
             takeover = True
         if token is None:
             return None  # lost the race: someone else just took it
         self._observe_fence(key, fence)
-        lease = _Lease(key, token, fence, trace=trace)
+        lease = _Lease(key, token, fence, trace=trace,
+                       route_key=route_key)
         self._held[key] = lease
         lease.renewer = asyncio.create_task(
             self._renew_loop(lease), name=f"fleet-lease-{key[:12]}"
@@ -694,7 +862,8 @@ class FleetPlane:
             try:
                 token = await self.coord.put(
                     LEASES_PREFIX + lease.key,
-                    self._lease_doc(lease.fence, lease.trace),
+                    self._lease_doc(lease.fence, lease.trace,
+                                    lease.route_key),
                     expect=lease.token,
                 )
             except asyncio.CancelledError:
@@ -1078,8 +1247,7 @@ class FleetPlane:
         every survivor then runs the election, the oldest wins, the
         rest settle back to one GET per beat.
         """
-        entry = await self.coord.get(OVERVIEW_KEY)
-        doc = entry[0] if entry is not None else None
+        doc = await self._overview_read_cached()
         self._note_overview(doc)
         if doc is not None and doc.get("updatedBy") != self.worker_id:
             age = time.time() - float(doc.get("updatedAt", 0) or 0)
@@ -1096,21 +1264,338 @@ class FleetPlane:
             return
         fresh = build_overview(self.worker_id, workers)
         await self.coord.put(OVERVIEW_KEY, fresh, expect=ANY)
+        self._overview_doc = fresh
         self._note_overview(fresh)
+
+    async def _overview_read_cached(self) -> Optional[dict]:
+        """The overview doc via the watch plane.
+
+        Drains pending change events into the local cache (free on the
+        event-driven backend, one bounded scan on the poll-watch one)
+        instead of a fresh GET per read; falls back to the direct GET —
+        the degraded poll path, counted on
+        ``fleet_watch_wakeups_total{mode="poll"}`` — whenever the watch
+        is unavailable or broke.  Store trouble on that fallback RAISES
+        exactly like the read this replaced.
+        """
+        if self._overview_watch is None and self.watch_enabled:
+            watch = self._open_watch(OVERVIEW_KEY)
+            if watch is not None:
+                self._overview_watch = watch
+                try:
+                    # read-then-watch: arm the snapshot, seed the cache
+                    # once, then live on change events alone
+                    await watch.next(0)
+                    entry = await self.coord.get(OVERVIEW_KEY)
+                    self._overview_doc = (entry[0] if entry is not None
+                                          else None)
+                    return self._overview_doc
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    self._note_coord_error("watch", err)
+                    watch.close()
+                    self._overview_watch = None
+        events = await self._drain_watch(self._overview_watch)
+        if events is None:
+            if self._overview_watch is not None:
+                self._overview_watch.close()
+                self._overview_watch = None
+            self._note_watch_wakeup("poll")
+            entry = await self.coord.get(OVERVIEW_KEY)
+            self._overview_doc = entry[0] if entry is not None else None
+            return self._overview_doc
+        for event in events:
+            if event.key == OVERVIEW_KEY:
+                self._overview_doc = event.data
+        if events:
+            self._note_watch_wakeup("event")
+        return self._overview_doc
 
     async def fetch_overview(self) -> Optional[dict]:
         """The current fleet-overview doc (None when absent), bounded
         by :data:`OVERVIEW_FETCH_BUDGET` — a browned-out coordination
-        store costs one bounded wait, never a hung admin read.  Raises
-        on coordination trouble (incl. the budget expiring): the
-        endpoint downgrades to its local view and says so, the
-        trace-assembly degradation contract."""
+        store costs one bounded wait, never a hung admin read.  Served
+        through the watch plane's cache (a quiet watch costs zero store
+        round trips on the event-driven backend); raises on
+        coordination trouble when the degraded read path has to run
+        (incl. the budget expiring): the endpoint downgrades to its
+        local view and says so, the trace-assembly degradation
+        contract."""
         async with asyncio.timeout(OVERVIEW_FETCH_BUDGET):
-            entry = await self.coord.get(OVERVIEW_KEY)
-        if entry is None:
+            doc = await self._overview_read_cached()
+        self._note_overview(doc)
+        return doc
+
+    def cached_overview(self, max_age: Optional[float] = None
+                        ) -> Optional[dict]:
+        """The watch-cached overview doc when fresh enough (default
+        bound: 4x the heartbeat interval), else None — the router's
+        zero-RTT read; staleness degrades to 'no fleet view', never to
+        acting on history."""
+        doc = self._overview_doc
+        if doc is None:
             return None
-        self._note_overview(entry[0])
-        return entry[0]
+        if max_age is None:
+            max_age = 4.0 * self.heartbeat_interval
+        try:
+            age = time.time() - float(doc.get("updatedAt", 0) or 0)
+        except (TypeError, ValueError):
+            return None
+        return doc if age <= max_age else None
+
+    # -- watch-fed views (the router/controller's zero-RTT reads) -------
+    async def _refresh_views(self) -> None:
+        """One heartbeat's refresh of the lease and plan caches.
+
+        The content router consults both at ADMISSION — once per
+        delivery — so they must never cost a store round trip there.
+        Instead the heartbeat drains each watch non-blockingly (free on
+        the event-driven backend, one bounded scan on the poll-watch
+        one) and admission reads plain dicts.  No watch — disabled,
+        refused, or broken — degrades to one listing/GET per beat: the
+        poll path, counted, never a routing failure.
+        """
+        await self._refresh_lease_view()
+        await self._refresh_plan_view()
+
+    async def _refresh_lease_view(self) -> None:
+        opened = False
+        if self._lease_watch is None and self.watch_enabled:
+            self._lease_watch = self._open_watch(LEASES_PREFIX)
+            if self._lease_watch is not None:
+                opened = True
+                try:
+                    await self._lease_watch.next(0)  # arm the snapshot
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    self._note_coord_error("watch", err)
+                    self._lease_watch.close()
+                    self._lease_watch = None
+                    opened = False
+        if not opened and self._lease_watch is not None:
+            events = await self._drain_watch(self._lease_watch)
+            if events is None:
+                self._lease_watch.close()
+                self._lease_watch = None
+            elif events:
+                for event in events:
+                    ckey = event.key[len(LEASES_PREFIX):]
+                    if event.data is None:
+                        self._lease_view.pop(ckey, None)
+                    else:
+                        self._lease_view[ckey] = event.data
+                self._lease_view_ready = True
+                self._note_watch_wakeup("event")
+                return
+            elif self._lease_view_ready:
+                return  # watch alive and quiet: the cache is current
+        # (re)seed: no watch, a broken one, or one just opened — one
+        # listing rebuilds the whole view (read-then-watch / poll path)
+        self._lease_view = {
+            key[len(LEASES_PREFIX):]: doc
+            for key, doc in await self._get_all(LEASES_PREFIX)
+        }
+        self._lease_view_ready = True
+        if self._lease_watch is None:
+            self._note_watch_wakeup("poll")
+
+    async def _refresh_plan_view(self) -> None:
+        opened = False
+        if self._plan_watch is None and self.watch_enabled:
+            self._plan_watch = self._open_watch(PLAN_KEY)
+            if self._plan_watch is not None:
+                opened = True
+                try:
+                    await self._plan_watch.next(0)  # arm the snapshot
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    self._note_coord_error("watch", err)
+                    self._plan_watch.close()
+                    self._plan_watch = None
+                    opened = False
+        if not opened and self._plan_watch is not None:
+            events = await self._drain_watch(self._plan_watch)
+            if events is None:
+                self._plan_watch.close()
+                self._plan_watch = None
+            else:
+                for event in events:
+                    if event.key == PLAN_KEY:
+                        self._plan_doc = event.data
+                if events:
+                    self._note_watch_wakeup("event")
+                self._note_plan_age()
+                return
+        entry = await self.coord.get(PLAN_KEY)
+        self._plan_doc = entry[0] if entry is not None else None
+        if self._plan_watch is None:
+            self._note_watch_wakeup("poll")
+        self._note_plan_age()
+
+    def _note_plan_age(self) -> None:
+        if self.metrics is None:
+            return
+        doc = self._plan_doc
+        if doc is None:
+            self.metrics.fleet_plan_age.set(-1.0)
+            return
+        try:
+            age = max(time.time() - float(doc.get("updatedAt", 0) or 0),
+                      0.0)
+        except (TypeError, ValueError):
+            return
+        self.metrics.fleet_plan_age.set(age)
+
+    def current_plan(self, max_age: Optional[float] = None
+                     ) -> Optional[dict]:
+        """The controller's latest plan doc from the watch-fed cache —
+        None when absent or older than ``max_age`` (default 4x the
+        heartbeat interval): a controller that stopped planning must
+        not steer admission forever on history."""
+        doc = self._plan_doc
+        if doc is None:
+            return None
+        if max_age is None:
+            max_age = 4.0 * self.heartbeat_interval
+        try:
+            age = time.time() - float(doc.get("updatedAt", 0) or 0)
+        except (TypeError, ValueError):
+            return None
+        return doc if age <= max_age else None
+
+    def route_holder(self, route_key: str) -> Optional[dict]:
+        """The live lease doc whose ``routeKey`` matches, served from
+        the watch-fed cache (zero store RTTs at admission); None when
+        no live holder is known — including before the first view
+        refresh, when deferring on a guess would be wrong both ways."""
+        if not route_key or not self._lease_view_ready:
+            return None
+        now = time.time()
+        grace = self.lease_ttl * TAKEOVER_GRACE_FRAC
+        for ckey, doc in self._lease_view.items():
+            if doc.get("routeKey") != route_key:
+                continue
+            if float(doc.get("expiresAt", 0) or 0) + grace < now:
+                continue  # expired: the holder is dead or done
+            out = dict(doc)
+            out["key"] = ckey
+            return out
+        return None
+
+    # -- fleet-shared origin-health table -------------------------------
+    async def _origin_health_tick(self) -> None:
+        """Merge this worker's per-origin EWMAs into the shared table
+        every ``fleet.origin_share_interval`` seconds (heartbeat-driven,
+        so the cadence floor is the heartbeat interval)."""
+        if self.origin_fn is None or self.origin_share_interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._origin_shared_mono < self.origin_share_interval:
+            return
+        self._origin_shared_mono = now
+        try:
+            snapshot = dict(self.origin_fn())
+        except Exception as err:  # a bad snapshot must not kill beats
+            self._note_coord_error("origin_snapshot", err)
+            return
+        if snapshot:
+            await self.publish_origin_health(snapshot)
+
+    async def publish_origin_health(self, snapshot: Dict[str, dict]
+                                    ) -> bool:
+        """CAS-merge per-origin throughput rows into ``origins/health``.
+
+        The table has no lease, so the doc's write token IS the fence:
+        read, merge newest-observation-wins per origin label, write
+        back conditional on the token read.  A lost race re-reads and
+        re-merges (bounded laps) — two workers merging concurrently
+        both land, neither clobbers.  Best-effort like all coordination:
+        False on trouble, never a raised error.
+        """
+        now = round(time.time(), 3)
+        rows: Dict[str, dict] = {}
+        for label, row in snapshot.items():
+            try:
+                rows[str(label)] = {
+                    "bps": float(row.get("bps", 0.0) or 0.0),
+                    "bytes": int(row.get("bytes", 0) or 0),
+                    "at": now,
+                    "by": self.worker_id,
+                }
+            except (TypeError, ValueError, AttributeError):
+                continue
+        if not rows:
+            return False
+        try:
+            for _ in range(4):
+                entry = await self.coord.get(ORIGIN_HEALTH_KEY)
+                merged: Dict[str, dict] = {}
+                if entry is not None:
+                    current = entry[0].get("labels")
+                    if isinstance(current, dict):
+                        merged.update(current)
+                for label, row in rows.items():
+                    have = merged.get(label)
+                    try:
+                        have_at = float((have or {}).get("at", 0) or 0)
+                    except (TypeError, ValueError):
+                        have_at = 0.0
+                    if have is None or have_at <= row["at"]:
+                        merged[label] = row
+                doc = {"labels": merged, "updatedAt": now,
+                       "updatedBy": self.worker_id}
+                expect = entry[1] if entry is not None else ABSENT
+                if await self.coord.put(ORIGIN_HEALTH_KEY, doc,
+                                        expect=expect) is not None:
+                    self.stats["originHealthShared"] += 1
+                    if self.metrics is not None:
+                        self.metrics.fleet_origin_health.labels(
+                            op="published").inc()
+                    return True
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("origin_health", err)
+            return False
+        self._note_coord_error(
+            "origin_health",
+            CoordError("origin-health CAS merge: retries exhausted"))
+        return False
+
+    async def fetch_origin_health(
+            self, max_age: float = ORIGIN_HEALTH_MAX_AGE
+    ) -> Dict[str, dict]:
+        """Fleet origin-health rows fresh enough to seed a booting
+        worker's OriginHealth ({} on any trouble — the seed is a
+        best-effort head start, never worth delaying boot)."""
+        try:
+            entry = await self.coord.get(ORIGIN_HEALTH_KEY)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            self._note_coord_error("origin_health", err)
+            return {}
+        if entry is None:
+            return {}
+        labels = entry[0].get("labels")
+        if not isinstance(labels, dict):
+            return {}
+        now = time.time()
+        out: Dict[str, dict] = {}
+        for label, row in labels.items():
+            try:
+                if (max_age > 0
+                        and now - float(row.get("at", 0) or 0) > max_age):
+                    continue  # stale history, not a head start
+                out[str(label)] = dict(row)
+            except (TypeError, ValueError, AttributeError):
+                continue
+        if out and self.metrics is not None:
+            self.metrics.fleet_origin_health.labels(op="seeded").inc()
+        return out
 
     # -- shared-tier / tombstone GC -------------------------------------
     async def _should_gc(self) -> bool:
@@ -1355,7 +1840,8 @@ class FleetPlane:
     # -- the cross-worker singleflight protocol -------------------------
     async def coordinate(self, key: str, cache, origin_fill, *,
                          cancel=None, record=None, registry=None,
-                         slot=None, logger=None) -> str:
+                         slot=None, logger=None,
+                         route_key: Optional[str] = None) -> str:
         """Fetch-or-wait for content ``key`` fleet-wide.
 
         ``origin_fill`` is the caller's fetch-and-fill-local-cache
@@ -1418,7 +1904,7 @@ class FleetPlane:
                 key, cache, origin_fill, cancel=cancel, record=record,
                 registry=registry, slot=slot, log=log,
                 deadline=deadline, trace=trace, billed=_billed,
-                bill=_bill)
+                bill=_bill, route_key=route_key)
         finally:
             if record is not None:
                 for hop, seconds in hop_seconds.items():
@@ -1427,10 +1913,11 @@ class FleetPlane:
 
     async def _coordinate(self, key, cache, origin_fill, *, cancel,
                           record, registry, slot, log, deadline, trace,
-                          billed, bill):
+                          billed, bill, route_key=None):
         parked = False
         waited = False
-        wait_started = None  # first poll-sleep: the aging clock starts
+        wait_started = None  # first parked wait: the aging clock starts
+        lease_watch: Optional[CoordWatch] = None
         try:
             while True:
                 try:
@@ -1451,7 +1938,8 @@ class FleetPlane:
                     # 2) contend for the content lease
                     lease = await billed(self._coord_op(
                         "coord.lease",
-                        lambda: self.try_acquire_lease(key, trace),
+                        lambda: self.try_acquire_lease(
+                            key, trace, route_key=route_key),
                         cancel=cancel,
                     ))
                 except (JobCancelled, asyncio.CancelledError):
@@ -1524,11 +2012,21 @@ class FleetPlane:
                     return UNCOORDINATED
                 if wait_started is None:
                     wait_started = time.monotonic()
+                    if self.watch_enabled and lease_watch is None:
+                        # subscribe to the ONE lease doc this wait is
+                        # parked on: the leader's release wakes the
+                        # waiter immediately instead of on the next
+                        # poll lap (None = watch refused: sleep-poll)
+                        lease_watch = self._open_watch(
+                            LEASES_PREFIX + key)
+                waiter = self._waiter_wait(lease_watch, deadline)
                 if cancel is not None:
-                    await cancel.guard(asyncio.sleep(self.poll_interval))
+                    lease_watch = await cancel.guard(waiter)
                 else:
-                    await asyncio.sleep(self.poll_interval)
+                    lease_watch = await waiter
         finally:
+            if lease_watch is not None:
+                lease_watch.close()
             if record is not None and wait_started is not None:
                 # age the per-job wait budget on EVERY exit — lease won,
                 # degraded to uncoordinated, timed out, cancelled — so
@@ -1705,6 +2203,7 @@ def _json_load(raw: bytes) -> dict:
 # re-exported for callers that build planes by hand (tests, bench)
 __all__ = [
     "FleetPlane", "resolve_worker_id", "MemoryCoordStore",
-    "BucketCoordStore", "CoordError", "LED", "SHARED", "UNCOORDINATED",
-    "build_overview", "OVERVIEW_KEY",
+    "BucketCoordStore", "CasBucketCoordStore", "CoordError",
+    "LED", "SHARED", "UNCOORDINATED",
+    "build_overview", "OVERVIEW_KEY", "PLAN_KEY", "ORIGIN_HEALTH_KEY",
 ]

@@ -43,7 +43,9 @@ from .control.scheduler import (PriorityScheduler, RunSlot,
                                 priority_name, priority_rank)
 from .control.slo import SloTracker
 from .control.tenancy import TenantTable
+from .fleet.controller import PlacementController
 from .fleet.plane import FleetPlane, resolve_worker_id
+from .fleet.router import ContentRouter
 from .mq.base import Delivery, MessageQueue
 from .platform import faults
 from .platform.config import cfg_get
@@ -336,11 +338,19 @@ class Orchestrator:
         # The download stage consults the plane through stage_resources
         # before any origin fetch; the registry handle lets it park a
         # lease-waiting job in the control plane's PARKED state.
+        # the shared per-origin throughput table, created eagerly so the
+        # fleet plane can share it and boot seeding has a target before
+        # the first download stage runs (origins/plan.py lazily shares
+        # the same instance through stage_resources)
+        from .origins.plan import OriginHealth
+        self.origin_health = OriginHealth.shared(self.stage_resources,
+                                                 config)
         self.fleet = fleet if fleet is not None else FleetPlane.from_config(
             config, worker_id=self.worker_id, store=store,
             metrics=metrics, logger=self.logger, retrier=self.retrier,
             payload_fn=self.autoscale_signals,
             digest_fn=self.slo_digest,
+            origin_fn=self.origin_health.snapshot,
         )
         if self.fleet is not None and self.fleet.payload_fn is None:
             # a plane built by hand (tests/bench) still heartbeats the
@@ -350,7 +360,22 @@ class Orchestrator:
             # same adoption for the SLO/health digest the fleet
             # overview aggregates
             self.fleet.digest_fn = self.slo_digest
+        if self.fleet is not None and self.fleet.origin_fn is None:
+            # and for the fleet-shared origin-health table
+            self.fleet.origin_fn = self.origin_health.snapshot
         self.stage_resources["fleet_plane"] = self.fleet
+        # fleet data plane v2 (ISSUE 17): the content router steers
+        # same-content deliveries to the current lease holder at
+        # admission, and the elected placement controller closes the
+        # overview->plan loop.  Both are None without a fleet — the
+        # lone-worker admission path is untouched.
+        self.router = ContentRouter.from_config(
+            config, self.fleet, self.tenants,
+            metrics=metrics, logger=self.logger,
+        )
+        self.controller = PlacementController.from_config(
+            config, self.fleet, metrics=metrics, logger=self.logger,
+        )
         self.stage_resources["job_registry"] = self.registry
         # the stages stack each job's per-tenant byte quota under the
         # service-wide rate limiter through this shared table
@@ -458,6 +483,28 @@ class Orchestrator:
             # toward this worker, it is actually consuming
             await self.fleet.start()
             self.logger.info("joined fleet", workerId=self.worker_id)
+            # cold-start head start (ISSUE 17): seed the local origin
+            # throughput table from the fleet-shared rows, so the first
+            # racing fetch starts from the fleet's observed rates
+            # instead of zero.  Best-effort: one bounded read, and any
+            # trouble boots cold exactly as before.
+            try:
+                rows = await self.fleet.fetch_origin_health()
+                if rows:
+                    seeded = self.origin_health.seed(rows)
+                    if seeded:
+                        self.logger.info(
+                            "seeded origin health from fleet",
+                            labels=seeded)
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:
+                self.logger.warn("origin-health boot seed failed",
+                                 error=str(err)[:200])
+            if self.controller is not None:
+                # the placement controller only ever acts when this
+                # worker wins the election, so every worker starts it
+                self.controller.start()
         self.logger.info("successfully connected to queue")
 
     # -- autoscale signals ----------------------------------------------
@@ -515,6 +562,11 @@ class Orchestrator:
         queued = self.registry.tenant_queue_depths()
         if queued:
             digest["tenantQueued"] = queued
+        router = getattr(self, "router", None)
+        if router is not None and router.last is not None:
+            # this worker's last routing action (defer/shed/fairness):
+            # the DECISION column on the overview doc / `fleet top`
+            digest["lastDecision"] = dict(router.last)
         return digest
 
     async def assemble_trace(self, trace_id: str,
@@ -795,19 +847,53 @@ class Orchestrator:
             self.metrics.jobs_recovered.labels(outcome="cancelled").inc()
 
     async def _staged_probe_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self._staged_probe_interval)
-            try:
-                if self._recovered:
-                    await self._probe_recovered_staged()
-                await self._sweep_peer_staged_workdirs()
-            except asyncio.CancelledError:
-                raise
-            except Exception as err:
-                # store trouble: the placeholders keep waiting, the next
-                # pass probes again — degradation, never a crash
-                self.logger.warn("recovered-placeholder probe failed",
-                                 error=str(err))
+        # a peer SETTLING a job publishes its telemetry digest — the
+        # exact moment a done marker may have appeared — so the probe
+        # rides the fleet's telemetry watch and wakes on peer activity;
+        # the configured interval survives as the bounded long-poll cap
+        # and as the whole cadence on the degraded path (no fleet,
+        # watch refused, coord brownout): the PR 9 contract.
+        watch = None
+        try:
+            while True:
+                if (watch is None and self.fleet is not None
+                        and self.fleet.watch_enabled):
+                    watch = self.fleet.telemetry_watch()
+                if watch is None:
+                    if self.fleet is not None:
+                        self.fleet._note_watch_wakeup("poll")
+                    await asyncio.sleep(self._staged_probe_interval)
+                else:
+                    try:
+                        events = await watch.next(
+                            self._staged_probe_interval)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        watch.close()
+                        watch = None
+                        self.fleet._note_watch_wakeup("poll")
+                        await asyncio.sleep(self._staged_probe_interval)
+                        events = []
+                    else:
+                        self.fleet._note_watch_wakeup(
+                            "event" if events else "timeout")
+                try:
+                    if self._recovered:
+                        await self._probe_recovered_staged()
+                    await self._sweep_peer_staged_workdirs()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    # store trouble: the placeholders keep waiting, the
+                    # next pass probes again — degradation, never a
+                    # crash
+                    self.logger.warn(
+                        "recovered-placeholder probe failed",
+                        error=str(err))
+        finally:
+            if watch is not None:
+                watch.close()
 
     async def _probe_recovered_staged(self) -> int:
         """Retire PARKED recovery placeholders whose content the fleet
@@ -978,6 +1064,10 @@ class Orchestrator:
         await self.loop_monitor.stop()
         if self.overload is not None:
             await self.overload.stop()
+        if self.controller is not None:
+            # stop planning before leaving the fleet: a departing
+            # worker must not publish a plan mid-deregistration
+            await self.controller.stop()
         if self.fleet is not None:
             # leave the fleet before the backends close: deregistration
             # and lease release still have a live store to write to
@@ -1150,6 +1240,26 @@ class Orchestrator:
                     await self._shed_delivery(delivery, child, record,
                                               token, shed_reason)
                     return
+            # content-aware routing (fleet/router.py): when a live peer
+            # already leads this content, or the placement controller's
+            # plan sheds/defers this class, hand the delivery back to
+            # the broker here — before admission, a run slot, or a
+            # parked fleet wait are spent on it.  Pure cached-view
+            # reads; "run" (the lone-worker default) costs nothing.
+            if self.router is not None:
+                decision = self.router.decide(
+                    getattr(msg.media, "source_uri", "") or "",
+                    priority=priority, tenant=tenant,
+                )
+                if decision.settles:
+                    await self._route_delivery(delivery, child, record,
+                                               token, decision)
+                    return
+                if decision.outcome != "run" and record is not None:
+                    # non-default decisions that still admit (own
+                    # lease, router error) are timeline-worthy too
+                    record.event("route", outcome=decision.outcome,
+                                 reason=decision.reason)
             # submitter deadline (Download.ttl_seconds): a redelivered
             # BULK job that already outlived its TTL is dropped before
             # it consumes anything
@@ -1470,6 +1580,43 @@ class Orchestrator:
         await delivery.nack()
         self.registry.transition(
             record, control.FAILED, reason=f"overload_shed: {reason}"
+        )
+
+    async def _route_delivery(self, delivery: Delivery, logger: Logger,
+                              record: JobRecord, token: CancelToken,
+                              decision) -> None:
+        """Settle one delivery the content router steered off this
+        worker (defer to the lease holder, fleet-fairness defer, or a
+        plan-driven BULK shed).
+
+        The PR 5 park-then-nack discipline: the unsettled delivery
+        parks for the router's backoff (so the redelivery lands after
+        the holder's publish / the next plan beat, not instantly), then
+        nacks for redelivery elsewhere.  Poison is NOT charged —
+        nothing about the job failed — and the record closes FAILED
+        with a ``routed`` reason, mirroring the overload shed.
+        """
+        logger.info("routing delivery off this worker",
+                    outcome=decision.outcome, reason=decision.reason,
+                    holder=decision.holder)
+        record.event("route", outcome=decision.outcome,
+                     reason=decision.reason, holder=decision.holder)
+        if decision.outcome == "shed" and self.metrics is not None:
+            # the controller's admission shed is an SLO-protective
+            # drop, accounted beside the overload layer's sheds
+            self.metrics.jobs_shed.labels(
+                reason="plan", tenant=record.tenant
+            ).inc()
+        await self._park(record, token, decision.backoff, None,
+                         reason=f"route:{decision.outcome}")
+        record.retry = None
+        record.event("settle", mode="nack", why="routed",
+                     outcome=decision.outcome)
+        self._journal_settle(record, "nack", "routed")
+        await delivery.nack()
+        self.registry.transition(
+            record, control.FAILED,
+            reason=f"routed: {decision.outcome}"
         )
 
     async def _enforce_deadline(self, delivery: Delivery, logger: Logger,

@@ -123,6 +123,30 @@ class OriginHealth:
             for label, entry in sorted(self._table.items())
         }
 
+    def seed(self, rows: Dict[str, dict]) -> int:
+        """Import fleet-shared rows (fleet/plane.py origin-health table)
+        for labels this process has NOT yet observed itself — a peer's
+        EWMA is a cold-start head start, never an override of local
+        evidence.  ``bytes`` stays 0: total_bytes accounts bytes THIS
+        worker moved.  Returns the number of labels seeded."""
+        seeded = 0
+        for label, row in rows.items():
+            if not isinstance(label, str) or label in self._table:
+                continue
+            if (label not in self._labels
+                    and len(self._labels) >= self.max_labels):
+                continue  # the bounded label table stays bounded
+            try:
+                bps = float(row.get("bps", 0.0) or 0.0)
+            except (TypeError, ValueError, AttributeError):
+                continue
+            if bps <= 0:
+                continue
+            self._table[label] = [bps, 0, time.monotonic()]
+            self._labels.add(label)
+            seeded += 1
+        return seeded
+
 
 def resolve_mirrors(primary_url: str, mirrors,
                     schemes=("http", "https")) -> List[str]:

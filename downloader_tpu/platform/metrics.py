@@ -364,6 +364,60 @@ class Metrics:
             ["op"],
             registry=self.registry,
         )
+        self.fleet_watch_wakeups = Counter(
+            f"{ns}_fleet_watch_wakeups_total",
+            "Watch-plane wake-ups, by mode (event = the watch delivered "
+            "changes, timeout = a bounded long-poll lapsed quiet, poll = "
+            "degraded to sleep-poll because the watch was unavailable or "
+            "broke).  A healthy fleet is event/timeout-dominated; a "
+            "poll-dominated worker is running the degraded path",
+            ["mode"],
+            registry=self.registry,
+        )
+        self.fleet_origin_health = Counter(
+            f"{ns}_fleet_origin_health_total",
+            "Fleet-shared origin-health table traffic, by op (published "
+            "= this worker CAS-merged its per-origin EWMAs, seeded = a "
+            "boot imported fresh fleet rows into its local OriginHealth)",
+            ["op"],
+            registry=self.registry,
+        )
+        self.fleet_router_decisions = Counter(
+            f"{ns}_fleet_router_decisions_total",
+            "Content-router admission decisions, by outcome (run = no "
+            "routing concern, defer = handed to the current lease "
+            "holder via park+nack, fairness_defer = BULK deferred for "
+            "fleet-wide tenant fairness, shed = BULK shed on the "
+            "controller's plan, local = routing skipped because the "
+            "holder is this worker)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.fleet_controller_decisions = Counter(
+            f"{ns}_fleet_controller_decisions_total",
+            "Placement/autoscale controller decisions published on the "
+            "fleet plan, by kind (shed_bulk = burn-rate-driven BULK "
+            "admission shed, drain = a browning-out worker steered away "
+            "from new leases, scale_up/scale_down = queue-depth scale "
+            "signal edges, plan = a plan document published)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.fleet_plan_age = Gauge(
+            f"{ns}_fleet_plan_age_seconds",
+            "Age of the placement-controller plan document this worker "
+            "last read (steady state: under 2x fleet.heartbeat_interval;"
+            " climbing = the elected controller stopped planning).  -1 "
+            "until a plan has been seen",
+            registry=self.registry,
+        )
+        self.fleet_desired_workers = Gauge(
+            f"{ns}_fleet_desired_workers",
+            "Worker count the placement controller's plan currently "
+            "asks for (the queue-depth autoscale signal, exported for "
+            "external autoscalers; -1 until a plan has been seen)",
+            registry=self.registry,
+        )
         # -- SLO plane (control/slo.py) --------------------------------
         # "class" is bounded by the priority-class enum plus the
         # config-bounded tenant-objective names; "window" is the

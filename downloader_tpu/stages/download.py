@@ -1890,11 +1890,18 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                          key=key[:16]):
                         await origin_fill(report)
 
+                # the admission-edge routing identity rides the lease
+                # doc (fleet/router.py computes the identical hash from
+                # the message alone), so every peer's watch-fed lease
+                # view can steer same-content deliveries here while
+                # this fetch leads
+                from ..fleet.router import route_key_for
                 outcome = await fleet.coordinate(
                     key, cache, _led_fill,
                     cancel=cancel, record=ctx.record,
                     registry=ctx.resources.get("job_registry"),
                     slot=ctx.slot, logger=logger,
+                    route_key=route_key_for(url),
                 )
                 if outcome == "led":
                     return  # origin_fill ran under our lease

@@ -110,3 +110,38 @@ class ObjectStore(abc.ABC):
             if info.name == name:
                 return info
         raise ObjectNotFound(bucket, name)
+
+    async def get_object_versioned(self, bucket: str, name: str):
+        """Fetch ``(bytes, etag)`` atomically; raises ObjectNotFound.
+
+        The etag is the token ``put_object_cas`` accepts as ``if_match``
+        — together they are the read half of an S3 conditional-write
+        (compare-and-swap) loop.  Backends without a native combined
+        read fall back to get + stat, which is only best-effort.
+        """
+        data = await self.get_object(bucket, name)
+        try:
+            info = await self.stat_object(bucket, name)
+            etag = info.etag
+        except ObjectNotFound:
+            etag = ""
+        return data, etag
+
+    async def put_object_cas(self, bucket: str, name: str, data: bytes, *,
+                             if_match: "str | None" = None,
+                             if_none_match: bool = False) -> "str | None":
+        """Conditional put (S3 ``If-Match`` / ``If-None-Match: *``).
+
+        Exactly one of the preconditions must be armed: ``if_none_match=
+        True`` succeeds only when the object does NOT exist (create),
+        ``if_match=<etag>`` only when the live object's etag still equals
+        the one read earlier (replace).  Returns the NEW object's etag on
+        success or ``None`` when the precondition failed (someone else
+        won the race) — precondition failure is an expected outcome, not
+        an error.  Backends that cannot do server-side conditions raise
+        NotImplementedError and callers degrade to the best-effort
+        nonce-verify discipline (fleet/coord.py BucketCoordStore).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support conditional writes"
+        )

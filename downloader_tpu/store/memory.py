@@ -68,6 +68,33 @@ class InMemoryObjectStore(ObjectStore):
         async with self._lock:
             self._buckets.get(bucket, {}).pop(name, None)
 
+    async def get_object_versioned(self, bucket: str, name: str):
+        async with self._lock:
+            objects = self._bucket(bucket, name)
+            try:
+                data = objects[name]
+            except KeyError:
+                raise ObjectNotFound(bucket, name) from None
+            return data, hashlib.md5(data).hexdigest()
+
+    async def put_object_cas(self, bucket: str, name: str, data: bytes, *,
+                             if_match=None, if_none_match=False):
+        # the whole compare+swap under one lock: this fake is the
+        # reference semantics the MiniS3 412 path must agree with
+        async with self._lock:
+            objects = self._buckets.setdefault(bucket, {})
+            current = objects.get(name)
+            if if_none_match:
+                if current is not None:
+                    return None
+            elif if_match is not None:
+                if current is None:
+                    return None
+                if hashlib.md5(current).hexdigest() != if_match:
+                    return None
+            objects[name] = bytes(data)
+            return hashlib.md5(objects[name]).hexdigest()
+
 
 def _write_file(path: str, data: bytes) -> None:
     with open(path, "wb") as fh:

@@ -217,12 +217,18 @@ class S3ObjectStore(ObjectStore):
         path: str,
         query: Optional[Dict[str, str]] = None,
         data: bytes = b"",
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> aiohttp.ClientResponse:
         query = query or {}
         payload_hash = (
             _EMPTY_SHA256 if not data else hashlib.sha256(data).hexdigest()
         )
         headers = self._signer.sign(method, self._host, path, query, payload_hash)
+        if extra_headers:
+            # merged AFTER signing: conditional headers (If-Match /
+            # If-None-Match) are not part of the canonical request, so
+            # the signature stays valid with or without them
+            headers = {**headers, **extra_headers}
         session = await self._ensure_session()
         url = f"{self.endpoint}{path}"
         if query:
@@ -266,6 +272,45 @@ class S3ObjectStore(ObjectStore):
         body = await resp.read()
         if resp.status not in (200, 204):
             raise _status_error("put_object", resp.status, body)
+
+    async def get_object_versioned(self, bucket: str, name: str):
+        resp = await self._request("GET", self._object_path(bucket, name))
+        body = await resp.read()
+        if resp.status == 404:
+            raise ObjectNotFound(bucket, name)
+        if resp.status != 200:
+            raise _status_error("get_object_versioned", resp.status, body)
+        return body, resp.headers.get("ETag", "").strip('"')
+
+    async def put_object_cas(self, bucket: str, name: str, data: bytes, *,
+                             if_match: Optional[str] = None,
+                             if_none_match: bool = False) -> Optional[str]:
+        """S3 conditional write (AWS since 2024-08, MinIO, R2): 412 /
+        409 = precondition failed = lost the race, reported as ``None``
+        rather than raised — losing a CAS is the caller's normal flow."""
+        headers: Dict[str, str] = {}
+        if if_none_match:
+            headers["If-None-Match"] = "*"
+        elif if_match is not None:
+            headers["If-Match"] = f'"{if_match}"'
+        resp = await self._request(
+            "PUT", self._object_path(bucket, name), data=data,
+            extra_headers=headers,
+        )
+        body = await resp.read()
+        if resp.status in (409, 412):
+            return None
+        if resp.status not in (200, 204):
+            raise _status_error("put_object_cas", resp.status, body)
+        etag = resp.headers.get("ETag", "").strip('"')
+        if not etag:
+            # a backend that accepted the write but returned no ETag:
+            # recover the token with a stat so the caller can CAS again
+            try:
+                etag = (await self.stat_object(bucket, name)).etag
+            except ObjectNotFound:
+                etag = ""
+        return etag
 
     async def remove_object(self, bucket: str, name: str) -> None:
         resp = await self._request(

@@ -183,8 +183,6 @@ class MiniS3:
                 self.fail_parts.discard(part_number)  # fail once, then heal
                 return web.Response(status=500, text="InternalError")
             upload["parts"][part_number] = body
-            import hashlib
-
             return web.Response(
                 status=200,
                 headers={"ETag": f'"{hashlib.md5(body).hexdigest()}"'},
@@ -195,8 +193,6 @@ class MiniS3:
             )
             if upload is None:
                 return web.Response(status=404, text="NoSuchUpload")
-            import hashlib
-
             ordered = [data for _n, data in sorted(upload["parts"].items())]
             assembled = b"".join(ordered)
             self.buckets.setdefault(bucket, {})[key] = assembled
@@ -218,10 +214,28 @@ class MiniS3:
             return web.Response(status=204 if existed else 404)
 
         if request.method == "PUT":
+            # conditional writes (AWS S3 2024-08 semantics): If-None-Match: *
+            # = create-only, If-Match: <etag> = replace-only-if-unchanged;
+            # either failing is 412 Precondition Failed and NO write happens
+            current = self.buckets.get(bucket, {}).get(key)
+            if request.headers.get("If-None-Match") == "*" and current is not None:
+                return web.Response(status=412, text="PreconditionFailed")
+            if_match = request.headers.get("If-Match")
+            if if_match is not None:
+                if current is None:
+                    return web.Response(status=412, text="PreconditionFailed")
+                have = self.etags.get(bucket, {}).get(
+                    key, hashlib.md5(current).hexdigest()
+                )
+                if if_match.strip('"') != have:
+                    return web.Response(status=412, text="PreconditionFailed")
             self.buckets.setdefault(bucket, {})[key] = body
             # single PUT overwrites any earlier multipart identity
             self.etags.get(bucket, {}).pop(key, None)
-            return web.Response(status=200)
+            return web.Response(
+                status=200,
+                headers={"ETag": f'"{hashlib.md5(body).hexdigest()}"'},
+            )
         if request.method == "DELETE":
             # object delete (fleet GC): idempotent 204, like real S3
             self.buckets.get(bucket, {}).pop(key, None)
@@ -234,8 +248,6 @@ class MiniS3:
             if request.method == "HEAD":
                 # like real S3: metadata-only; multipart objects report
                 # their md5-of-part-md5s etag, others the content MD5
-                import hashlib
-
                 etag = self.etags.get(bucket, {}).get(
                     key, hashlib.md5(data).hexdigest()
                 )
@@ -246,7 +258,10 @@ class MiniS3:
                         "ETag": f'"{etag}"',
                     },
                 )
-            return web.Response(body=data)
+            etag = self.etags.get(bucket, {}).get(
+                key, hashlib.md5(data).hexdigest()
+            )
+            return web.Response(body=data, headers={"ETag": f'"{etag}"'})
         return web.Response(status=405)
 
     # -- lifecycle ------------------------------------------------------

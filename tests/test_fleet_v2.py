@@ -1,0 +1,614 @@
+"""Fleet data plane v2 (ISSUE 17): truly-conditional CAS coordination,
+watch/subscribe, content-aware routing, the closed-loop placement
+controller, and the fleet-shared origin-health table.
+
+The acceptance bar is the routed multi-worker scenario: 3 workers on a
+same-content-heavy workload must route follow-up deliveries to the
+current lease holder (park-then-nack at admission, not N-1 parked run
+slots), complete every job off ONE origin fetch, and land zero stale
+fenced writes — while watch wake-ups replace the poll loops everywhere
+the coordination store is healthy and degrade back to polling when it
+is not (the PR 9 contract).
+"""
+
+import asyncio
+import time
+
+import pytest
+from test_fleet import ETAG, PAYLOAD, make_download_msg, make_worker
+
+from downloader_tpu import schemas
+from downloader_tpu.fleet import (ABSENT, CasBucketCoordStore,
+                                  MemoryCoordStore)
+from downloader_tpu.fleet.controller import PlacementController
+from downloader_tpu.fleet.plane import (ORIGIN_HEALTH_KEY, PLAN_KEY,
+                                        FleetPlane)
+from downloader_tpu.fleet.router import (DEFER, FAIRNESS_DEFER, LOCAL,
+                                         RUN, SHED, ContentRouter,
+                                         route_key_for)
+from downloader_tpu.mq import InMemoryBroker
+from downloader_tpu.origins.plan import OriginHealth
+from downloader_tpu.platform import faults
+from downloader_tpu.platform.faults import FaultInjector, FaultRule
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.stages.upload import STAGING_BUCKET, object_name
+from downloader_tpu.store import InMemoryObjectStore
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# CAS coordination: server-arbitrated conditional puts
+# ---------------------------------------------------------------------------
+
+async def test_cas_bucket_conditional_put_and_tombstone():
+    """The `cas` backend: ETag-token conditional writes with the same
+    observable semantics as the memory store — atomically, no settle
+    delay, including create-with-ABSENT over a tombstone."""
+    store = InMemoryObjectStore()
+    coord = CasBucketCoordStore(store, bucket="triton-staging")
+    token = await coord.put("leases/k", {"owner": "a"}, expect=ABSENT)
+    assert token is not None
+    # create-if-absent loses against a live entry, server-side
+    assert await coord.put("leases/k", {"owner": "b"},
+                           expect=ABSENT) is None
+    # CAS with the current token wins and rotates the token
+    token2 = await coord.put("leases/k", {"owner": "a2"}, expect=token)
+    assert token2 is not None and token2 != token
+    # ... and the stale token now loses (If-Match 412 -> None)
+    assert await coord.put("leases/k", {"owner": "x"},
+                           expect=token) is None
+    data, _ = await coord.get("leases/k")
+    assert data["owner"] == "a2"
+    assert "leases/k" in await coord.list_keys("leases/")
+    # conditional delete honors the token
+    assert not await coord.delete("leases/k", expect=token)
+    assert await coord.delete("leases/k", expect=token2)
+    assert await coord.get("leases/k") is None
+    # the tombstone reads as absent AND loses to expect=ABSENT creates
+    assert await coord.put("leases/k", {"owner": "c"},
+                           expect=ABSENT) is not None
+    assert (await coord.get("leases/k"))[0] == {"owner": "c"}
+
+
+async def test_cas_bucket_two_writers_one_winner():
+    """Two racing expect=ABSENT creates: exactly one token comes back —
+    the read-back/double-win window of the nonce backend is gone."""
+    store = InMemoryObjectStore()
+    a = CasBucketCoordStore(store, bucket="triton-staging")
+    b = CasBucketCoordStore(store, bucket="triton-staging")
+    tokens = await asyncio.gather(
+        a.put("leases/race", {"owner": "a"}, expect=ABSENT),
+        b.put("leases/race", {"owner": "b"}, expect=ABSENT),
+    )
+    assert sum(1 for t in tokens if t is not None) == 1
+
+
+# ---------------------------------------------------------------------------
+# Watch/subscribe: event wake-ups, poll fallback, brownout equivalence
+# ---------------------------------------------------------------------------
+
+async def test_memory_watch_event_wakeup():
+    coord = MemoryCoordStore()
+    watch = coord.watch("leases/")
+    assert await watch.next(0) == []  # armed, quiet
+    token = await coord.put("leases/a", {"owner": "w1"})
+    events = await watch.next(1.0)
+    assert [(e.key, e.data, e.token) for e in events] == [
+        ("leases/a", {"owner": "w1"}, token)]
+    # a change OUTSIDE the prefix does not wake the watch
+    await coord.put("workers/w1", {"hi": 1})
+    assert await watch.next(0) == []
+    # deletion surfaces as data=None
+    await coord.delete("leases/a")
+    events = await watch.next(1.0)
+    assert [(e.key, e.data) for e in events] == [("leases/a", None)]
+    # bounded long-poll: a quiet prefix returns [] at the deadline
+    start = time.monotonic()
+    assert await watch.next(0.05) == []
+    assert time.monotonic() - start < 1.0
+    watch.close()
+    await coord.put("leases/b", {"owner": "w2"})
+    assert await watch.next(0) == []  # closed watches stay silent
+
+
+async def test_poll_watch_sees_same_sequence_as_event_watch():
+    """Watch-vs-poll equivalence: the snapshot-diff fallback (bucket
+    backends, degraded path) reports the same put/update/delete
+    sequence the event-driven watch does."""
+    store = InMemoryObjectStore()
+    coord = CasBucketCoordStore(store, bucket="triton-staging")
+    watch = coord.watch("plan/", poll_interval=0.02)
+    assert await watch.next(0) == []  # seed the snapshot
+    await coord.put("plan/fleet", {"epoch": 1})
+    events = await watch.next(2.0)
+    assert [(e.key, e.data) for e in events] == [
+        ("plan/fleet", {"epoch": 1})]
+    await coord.put("plan/fleet", {"epoch": 2})
+    events = await watch.next(2.0)
+    assert [(e.key, e.data) for e in events] == [
+        ("plan/fleet", {"epoch": 2})]
+    await coord.delete("plan/fleet")
+    events = await watch.next(2.0)
+    assert [(e.key, e.data) for e in events] == [("plan/fleet", None)]
+    watch.close()
+
+
+@pytest.mark.parametrize("watch_enabled", [True, False])
+async def test_lease_waiters_complete_under_coord_brownout(
+        tmp_path, watch_enabled):
+    """Watch-vs-poll equivalence under brownout: the same two-worker
+    hot-content race completes with identical outcomes whether the
+    waiters ride watch wake-ups or the degraded sleep-poll loop, while
+    every coord op (watch laps included — the _MemoryWatch fires the
+    ``coord.get`` seam) pays brownout latency."""
+    from helpers import start_http_server
+
+    gets = [0]
+
+    async def serve(request):
+        from aiohttp import web
+
+        if request.method == "GET":
+            gets[0] += 1
+            await asyncio.sleep(0.25)
+        return web.Response(body=PAYLOAD, headers={"ETag": ETAG})
+
+    runner, base = await start_http_server(serve, path="/show.mkv")
+    uri = f"{base}/show.mkv"
+    broker = InMemoryBroker(max_redeliveries=5)
+    coord = MemoryCoordStore()
+    store = InMemoryObjectStore()
+    injector = faults.install(FaultInjector([
+        FaultRule(seam="coord.*", kind="brownout", latency_ms=20.0,
+                  window_s=0.0),
+    ]))
+    workers = []
+    try:
+        for i in range(2):
+            workers.append(await make_worker(
+                tmp_path, broker, store, f"bw{i}", coord,
+                fleet_kwargs={"watch_enabled": watch_enabled}))
+        for i in range(2):
+            broker.publish(schemas.DOWNLOAD_QUEUE,
+                           make_download_msg(uri, f"brown-{i}"))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=60)
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == 2
+        assert broker.dropped == []
+        assert gets[0] == 1  # brownout slows coordination, never breaks it
+        for i in range(2):
+            staged = await store.get_object(
+                STAGING_BUCKET, object_name(f"brown-{i}", "show.mkv"))
+            assert staged == PAYLOAD
+    finally:
+        faults.uninstall(injector)
+        for worker in workers:
+            await worker.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Content router: the decision table, hand-computed
+# ---------------------------------------------------------------------------
+
+class _StubPlane:
+    """route_holder/current_plan/cached_overview as plain data."""
+
+    worker_id = "w-self"
+
+    def __init__(self, plan=None, holders=None, overview=None):
+        self.plan = plan
+        self.holders = holders or {}
+        self.overview = overview
+
+    def current_plan(self, max_age=None):
+        return self.plan
+
+    def route_holder(self, route_key):
+        return self.holders.get(route_key)
+
+    def cached_overview(self, max_age=None):
+        return self.overview
+
+
+URI = "http://origin.example/show.mkv"
+RK = route_key_for(URI)
+
+
+def test_route_key_is_pure_and_stable():
+    assert RK is not None and RK == route_key_for(URI)
+    assert route_key_for("http://origin.example/other.mkv") != RK
+    assert route_key_for("") is None
+
+
+def test_router_decision_table():
+    lease = {"owner": "w-peer", "routeKey": RK,
+             "expiresAt": time.time() + 30}
+    cases = [
+        # (plan, holders, overview, priority, expected outcome)
+        (None, {}, None, "NORMAL", RUN),
+        # 1) plan sheds BULK at the edge; never user-facing priorities
+        ({"admission": {"shedBulk": True, "reason": "burn"}},
+         {}, None, "BULK", SHED),
+        ({"admission": {"shedBulk": True, "reason": "burn"}},
+         {}, None, "HIGH", RUN),
+        ({"admission": {"shedBulk": False}}, {}, None, "BULK", RUN),
+        # 2) a live peer leads the content -> defer to the holder
+        (None, {RK: lease}, None, "NORMAL", DEFER),
+        # ... unless the holder is this worker (local singleflight)
+        (None, {RK: dict(lease, owner="w-self")}, None, "NORMAL", LOCAL),
+        # ... or the plan drains the holder (steer away: run here)
+        ({"drain": ["w-peer"]}, {RK: lease}, None, "NORMAL", RUN),
+        # 3) fleet-wide fairness: 8/10 queued with fair share 1/3 and
+        #    factor 2 -> 0.8 > 0.667 -> defer the hog's BULK
+        (None, {}, {"totals": {"tenantQueued":
+                               {"hog": 8, "b": 1, "c": 1}}},
+         "BULK", FAIRNESS_DEFER),
+        # the same shares never defer user-facing work
+        (None, {}, {"totals": {"tenantQueued":
+                               {"hog": 8, "b": 1, "c": 1}}},
+         "HIGH", RUN),
+        # a near-empty backlog has nothing to apportion
+        (None, {}, {"totals": {"tenantQueued": {"hog": 2, "b": 1}}},
+         "BULK", RUN),
+    ]
+    for plan, holders, overview, priority, expected in cases:
+        router = ContentRouter(
+            _StubPlane(plan=plan, holders=holders, overview=overview))
+        decision = router.decide(URI, priority=priority, tenant="hog")
+        assert decision.outcome == expected, (
+            f"plan={plan} holders={bool(holders)} priority={priority}: "
+            f"expected {expected}, got {decision.outcome} "
+            f"({decision.reason})")
+    # the defer carries the holder id for the flight recorder
+    router = ContentRouter(_StubPlane(holders={RK: lease}))
+    decision = router.decide(URI, priority="NORMAL")
+    assert decision.holder == "w-peer" and decision.settles
+
+
+def test_router_expired_holder_and_errors_admit():
+    stale = {"owner": "w-peer", "routeKey": RK,
+             "expiresAt": time.time() - 60}
+
+    class _Boom(_StubPlane):
+        def route_holder(self, route_key):
+            raise RuntimeError("view exploded")
+
+    # a dead holder's lease doc must not attract deliveries... but the
+    # stub serves it; the REAL plane filters by expiry (route_holder),
+    # so here we assert the router's own failure posture instead:
+    assert ContentRouter(_Boom()).decide(
+        URI, priority="NORMAL").outcome == RUN
+    plane = FleetPlane(MemoryCoordStore(), "w-x", lease_ttl=1.0,
+                       logger=NullLogger())
+    plane._lease_view_ready = True
+    plane._lease_view = {"k": stale}
+    assert plane.route_holder(RK) is None
+    with pytest.raises(ValueError):
+        ContentRouter(_StubPlane(), fairness_factor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Placement controller: the decision table, hand-computed
+# ---------------------------------------------------------------------------
+
+def _controller(**kwargs):
+    plane = _StubPlane()
+    plane.heartbeat_interval = 0.1
+    return PlacementController(plane, **kwargs)
+
+
+def _workers(*ids):
+    return [{"workerId": wid} for wid in ids]
+
+
+def test_controller_admission_decision():
+    ctl = _controller()  # shed_burn 2.0, budget_floor 0.25
+    # hot on ONE window only: the fast spike may be noise — no shed
+    plan = ctl.build_plan(
+        {"totals": {"burn": {"availability":
+                             {"fast": 6.0, "slow": 0.4}}}},
+        _workers("w-self"))
+    assert plan["admission"]["shedBulk"] is False
+    # hot on BOTH windows: shed, with the objective in the reason
+    ctl = _controller()
+    plan = ctl.build_plan(
+        {"totals": {"burn": {"availability":
+                             {"fast": 2.5, "slow": 2.1}}}},
+        _workers("w-self"))
+    assert plan["admission"]["shedBulk"] is True
+    assert "availability" in plan["admission"]["reason"]
+    # budget at/under the floor sheds BEFORE exhaustion
+    ctl = _controller()
+    plan = ctl.build_plan(
+        {"totals": {"budget": {"latency_staged": 0.2}}},
+        _workers("w-self"))
+    assert plan["admission"]["shedBulk"] is True
+    assert "budget" in plan["admission"]["reason"]
+    # healthy budget above the floor: admit
+    ctl = _controller()
+    plan = ctl.build_plan(
+        {"totals": {"budget": {"latency_staged": 0.9}}},
+        _workers("w-self"))
+    assert plan["admission"]["shedBulk"] is False
+
+
+def test_controller_drain_decision():
+    ctl = _controller()
+    live = _workers("w-self", "w-b", "w-c")
+    plan = ctl.build_plan(
+        {"totals": {"openBreakers": {"w-b": {"store.put": {}}}}}, live)
+    assert plan["drain"] == ["w-b"]
+    # a worker that already left the fleet is not worth draining
+    ctl = _controller()
+    plan = ctl.build_plan(
+        {"totals": {"openBreakers": {"w-gone": {}}}}, live)
+    assert plan["drain"] == []
+    # every worker browning out: nowhere better to steer -> nobody drains
+    ctl = _controller()
+    plan = ctl.build_plan(
+        {"totals": {"openBreakers": {"w-self": {}, "w-b": {},
+                                     "w-c": {}}}}, live)
+    assert plan["drain"] == []
+
+
+def test_controller_scale_hysteresis():
+    ctl = _controller(target_depth=8, scale_hold_ticks=3)
+    live = _workers("w-self", "w-b", "w-c")
+    overview = {"totals": {"queueDepth": 30, "activeJobs": 3}}
+    # ceil(33/8) = 5, but the move must hold for 3 consecutive ticks
+    plan = ctl.build_plan(overview, live)
+    assert plan["desiredWorkers"] == 3 and plan["scale"] == "hold"
+    plan = ctl.build_plan(overview, live)
+    assert plan["desiredWorkers"] == 3
+    plan = ctl.build_plan(overview, live)
+    assert plan["desiredWorkers"] == 5 and plan["scale"] == "up"
+    # a one-beat dip resets the hold; the adopted value sticks
+    plan = ctl.build_plan({"totals": {"queueDepth": 0}}, live)
+    assert plan["desiredWorkers"] == 5
+    plan = ctl.build_plan(overview, live)
+    assert plan["desiredWorkers"] == 5
+    # the decision tail recorded the scale edge with the why
+    kinds = [d["kind"] for d in plan["decisions"]]
+    assert "scale_up" in kinds
+
+
+def test_controller_epoch_and_decision_edges():
+    ctl = _controller()
+    # takeover from a dead controller: epoch bumps
+    plan = ctl.build_plan(
+        {"totals": {}}, _workers("w-self"),
+        previous={"epoch": 4, "updatedBy": "w-dead"})
+    assert plan["epoch"] == 5
+    # steady-state republish by the same controller: epoch holds
+    plan = ctl.build_plan(
+        {"totals": {}}, _workers("w-self"),
+        previous={"epoch": 5, "updatedBy": "w-self"})
+    assert plan["epoch"] == 5
+    # shed edges are recorded once per flip, not once per tick
+    ctl = _controller()
+    hot = {"totals": {"burn": {"o": {"fast": 3.0, "slow": 3.0}}}}
+    ctl.build_plan(hot, _workers("w-self"))
+    ctl.build_plan(hot, _workers("w-self"))
+    plan = ctl.build_plan({"totals": {}}, _workers("w-self"))
+    kinds = [d["kind"] for d in plan["decisions"]]
+    assert kinds.count("shed_bulk") == 1
+    assert kinds.count("shed_clear") == 1
+
+
+async def test_controller_tick_elects_and_cas_publishes():
+    """End-to-end tick over a real plane: the oldest live worker
+    publishes ``plan/fleet`` with token-CAS; a younger worker's tick
+    defers to the fresh foreign plan (stand-down, no clobber)."""
+    coord = MemoryCoordStore()
+    old = FleetPlane(coord, "w-old", heartbeat_interval=0.05,
+                     liveness_ttl=2.0, logger=NullLogger())
+    await old.start()
+    await asyncio.sleep(0.02)  # startedAt strictly older
+    young = FleetPlane(coord, "w-young", heartbeat_interval=0.05,
+                       liveness_ttl=2.0, logger=NullLogger())
+    await young.start()
+    try:
+        overview = {"updatedAt": time.time(),
+                    "totals": {"queueDepth": 4}}
+        old._overview_doc = dict(overview)
+        young._overview_doc = dict(overview)
+        young_ctl = PlacementController(young)
+        assert await young_ctl.tick() is False  # not the oldest
+        old_ctl = PlacementController(old)
+        assert await old_ctl.tick() is True
+        entry = await coord.get(PLAN_KEY)
+        assert entry is not None
+        plan, _token = entry
+        assert plan["updatedBy"] == "w-old" and plan["epoch"] == 1
+        # the young worker's tick now sees a FRESH foreign plan: free
+        assert await young_ctl.tick() is False
+        # the publisher serves its own plan without waiting for a watch
+        assert old.current_plan()["updatedBy"] == "w-old"
+    finally:
+        await young.stop()
+        await old.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-shared origin health: the cold-start win
+# ---------------------------------------------------------------------------
+
+def test_origin_health_seed_cold_start_win():
+    """A freshly booted worker knows a peer-observed origin's landing
+    rate BEFORE its own first byte — the cold-start win — without ever
+    letting the shared row override local evidence."""
+    veteran = OriginHealth()
+    veteran.feed("fast-cdn", 64 << 20, 1.0)   # ~64 MB/s observed
+    veteran.feed("slow-mirror", 1 << 20, 1.0)
+    rows = veteran.snapshot()
+
+    rookie = OriginHealth()
+    assert rookie.bps("fast-cdn") == 0.0      # the cold start
+    assert rookie.seed(rows) == 2
+    assert rookie.bps("fast-cdn") == pytest.approx(64 << 20, rel=0.01)
+    assert rookie.bps("fast-cdn") > rookie.bps("slow-mirror")
+    # seeded bytes stay 0: total_bytes accounts THIS worker's traffic
+    assert rookie.total_bytes("fast-cdn") == 0
+    # local observation is never overridden by a (re)seed
+    local = OriginHealth()
+    local.feed("fast-cdn", 1 << 20, 1.0)
+    assert local.seed(rows) == 1              # only slow-mirror lands
+    assert local.bps("fast-cdn") == pytest.approx(1 << 20, rel=0.01)
+    # the bounded label table stays bounded
+    tiny = OriginHealth(max_labels=1)
+    assert tiny.seed(rows) == 1
+
+
+async def test_origin_health_shared_table_round_trip():
+    """publish -> CAS-merge -> fetch -> seed across two planes, with
+    newest-wins per label and the staleness bound enforced."""
+    coord = MemoryCoordStore()
+    a = FleetPlane(coord, "w-a", logger=NullLogger())
+    b = FleetPlane(coord, "w-b", logger=NullLogger())
+    assert await a.publish_origin_health(
+        {"cdn": {"bps": 1000.0, "bytes": 10}})
+    # b's newer observation of the same label wins the merge ...
+    assert await b.publish_origin_health(
+        {"cdn": {"bps": 2000.0, "bytes": 20},
+         "mirror": {"bps": 50.0, "bytes": 5}})
+    rows = await a.fetch_origin_health()
+    assert rows["cdn"]["bps"] == 2000.0
+    assert rows["mirror"]["bps"] == 50.0
+    # ... and a's label survives alongside (merge, not overwrite)
+    entry = await coord.get(ORIGIN_HEALTH_KEY)
+    assert set(entry[0]["labels"]) == {"cdn", "mirror"}
+    # a row older than the staleness bound is not seeded (yesterday's
+    # throughput is not a head start)
+    await coord.put(ORIGIN_HEALTH_KEY, {
+        "labels": {"ancient": {"bps": 9.9, "bytes": 1,
+                               "at": time.time() - 7 * 24 * 3600}},
+        "updatedAt": time.time(), "updatedBy": "w-old",
+    })
+    assert await a.fetch_origin_health() == {}
+    health = OriginHealth()
+    assert health.seed(rows) == 2
+    assert health.bps("cdn") == 2000.0
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 3 workers, same-content-heavy workload, routed
+# ---------------------------------------------------------------------------
+
+async def test_three_workers_routed_same_content(tmp_path):
+    """Same-content-heavy workload across 3 workers: follow-up
+    deliveries route to the current lease holder at ADMISSION
+    (defer/local decisions observed), every job completes off one
+    origin fetch, and zero stale fenced writes land (every staged body
+    byte-exact, fenced-write rejections 0)."""
+    from helpers import start_http_server
+
+    gets = [0]
+
+    async def serve(request):
+        from aiohttp import web
+
+        if request.method == "GET":
+            gets[0] += 1
+            await asyncio.sleep(0.4)  # hold so routing is observable
+        return web.Response(body=PAYLOAD, headers={"ETag": ETAG})
+
+    runner, base = await start_http_server(serve, path="/show.mkv")
+    uri = f"{base}/show.mkv"
+    broker = InMemoryBroker(max_redeliveries=200)
+    coord = MemoryCoordStore()
+    store = InMemoryObjectStore()
+    workers = []
+    jobs = 6
+    try:
+        for i in range(3):
+            workers.append(await make_worker(
+                tmp_path, broker, store, f"rt{i}", coord,
+                config_extra={"fleet": {"router":
+                                        {"defer_backoff": 0.05}}}))
+        # wave 1: one delivery takes the content lease; a heartbeat
+        # later every worker's watch-fed lease view knows the holder
+        broker.publish(schemas.DOWNLOAD_QUEUE,
+                       make_download_msg(uri, "rt-0"))
+        await asyncio.sleep(0.3)
+        # wave 2: the same-content burst arrives mid-download
+        for i in range(1, jobs):
+            broker.publish(schemas.DOWNLOAD_QUEUE,
+                           make_download_msg(uri, f"rt-{i}"))
+        await broker.join(schemas.DOWNLOAD_QUEUE, timeout=60)
+
+        assert gets[0] == 1, f"expected 1 origin fetch, saw {gets[0]}"
+        assert len(broker.published(schemas.CONVERT_QUEUE)) == jobs
+        assert broker.dropped == []
+        for i in range(jobs):
+            staged = await store.get_object(
+                STAGING_BUCKET, object_name(f"rt-{i}", "show.mkv"))
+            assert staged == PAYLOAD  # zero stale bytes landed
+        # the router saw the holder: the burst deferred/coalesced at
+        # admission instead of parking N-1 run slots
+        routed = sum(w.router.stats.get(DEFER, 0)
+                     + w.router.stats.get(LOCAL, 0) for w in workers)
+        assert routed >= 1, (
+            f"no routed decisions: "
+            f"{[dict(w.router.stats) for w in workers]}")
+        # zero stale fenced writes: nothing even NEEDED fencing off
+        assert sum(w.fleet.stats["fencedWrites"] for w in workers) == 0
+        led = sum(w.fleet.stats["leasesLed"] for w in workers)
+        assert led == 1
+    finally:
+        for worker in workers:
+            await worker.shutdown(grace_seconds=2)
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# The plan API surface
+# ---------------------------------------------------------------------------
+
+async def test_fleet_plan_endpoint(tmp_path):
+    import aiohttp
+    from aiohttp import web
+
+    from downloader_tpu.health import build_app
+
+    broker = InMemoryBroker()
+    store = InMemoryObjectStore()
+    worker = await make_worker(tmp_path, broker, store, "plan",
+                               MemoryCoordStore())
+    app = build_app(worker, worker.metrics)
+    app_runner = web.AppRunner(app)
+    await app_runner.setup()
+    site = web.TCPSite(app_runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # before any controller tick: enabled, plan absent, 200
+            async with session.get(f"{base}/v1/fleet/plan") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            assert body["enabled"] is True and body["plan"] is None
+            assert body["fresh"] is False
+            assert body["controller"]["running"] is True
+            # the first tick publishes (single worker = oldest = leader
+            # once the overview cache is primed)
+            worker.fleet._overview_doc = {
+                "updatedAt": time.time(),
+                "totals": {"queueDepth": 2},
+            }
+            assert await worker.controller.tick() is True
+            async with session.get(f"{base}/v1/fleet/plan") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            assert body["fresh"] is True
+            assert body["plan"]["updatedBy"] == "worker-plan"
+            assert body["plan"]["desiredWorkers"] >= 1
+            assert body["controller"]["plansPublished"] == 1
+            # the plan also rides the overview frame for `fleet top`
+            async with session.get(f"{base}/v1/fleet/overview") as resp:
+                overview_body = await resp.json()
+            assert overview_body["plan"]["updatedBy"] == "worker-plan"
+    finally:
+        await app_runner.cleanup()
+        await worker.shutdown(grace_seconds=2)
