@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip compute-shard chaos crash degraded fleet fleet-v2 obs origins slo soak soak-smoke soak-full proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip bench-incident compute-shard chaos crash degraded fleet fleet-v2 incident fuzz-scenarios obs origins slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -89,6 +89,25 @@ soak-full:
 	SOAK_MAX_WALL=7200 SOAK_KILLS=20 SOAK_KILL_INTERVAL=120 \
 	python -m pytest tests/test_soak.py::test_soak_full -v -m slow
 
+# incident plane suite (ISSUE 18): bundle-schema freeze (fields never
+# renumbered/retyped; the checked-in v1 fixture must keep loading and
+# compiling), compile_bundle purity + window re-anchoring (no sleeps,
+# per the window_active/flap_on discipline), breach-signature diffing,
+# the auto-export ring, the /v1/incidents degradation contract, and
+# the fuzzer's determinism
+incident:
+	python -m pytest tests/test_incident.py -v
+
+# seeded incident-scenario fuzzer (ISSUE 18 stretch): mutates the
+# fixture bundle's compiled plan (shift windows, swap fault kinds,
+# scale job counts) and replays each variant on a fresh SoakRig fleet
+# hunting for NEW breach signatures — minutes per variant, opt-in,
+# deliberately NOT a CI job (like soak-full).  Re-run any campaign
+# with the same --seed to reproduce it; drop --execute (edit below)
+# to just print the bred variants.
+fuzz-scenarios:
+	python -m downloader_tpu.incident.fuzz --seed 1818 --variants 4 --execute
+
 # SLO plane suite (ISSUE 15): burn-rate/budget math against
 # hand-computed windows, settle classification, the /readyz slo block,
 # heartbeat digests + the aggregated fleet overview (mixed-shape
@@ -173,6 +192,14 @@ bench-degraded:
 # BASELINE_HOPS.json budget, failures name the guilty hop)
 bench-slo:
 	python bench.py --slo
+
+# standalone incident round-trip bench (one JSON line:
+# incident_replay_signature_match = a degraded-world breach bundle,
+# compiled and replayed on 2 consecutive fresh fleets, reproduced its
+# breach signature with zero stale split-brain writes — the ISSUE 18
+# acceptance guard)
+bench-incident:
+	python bench.py --incident
 
 # standalone sharded-compute bench (one JSON line:
 # multichip_scaling_efficiency = single-device wall / data=4-sharded

@@ -201,7 +201,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  rate, guard >= 0.8 (ROADMAP item 3: >= 0.8x linear);
 #  fleet_scaling_routed rides along (routed-decision count — proof the
 #  router, not just lease parking, carried the fan-out).
-HARNESS_VERSION = 22
+# v23 (r22): incident plane (ISSUE 18).  New ``--incident`` section
+#  (`make bench-incident`): the trace -> replay round-trip guard.  One
+#  degraded-profile soak run (the PR 14 stalled-leader drill) makes the
+#  workers auto-export breach bundles; the newest breach bundle is
+#  compiled (incident/compiler.py, pure) into a FAULT_PLAN + SoakProfile
+#  and replayed on TWO consecutive fresh fleets.
+#  incident_replay_signature_match = every replay reproduced the
+#  original breach signature (same breached objective classes, same
+#  open-breaker dependency+reason, same guilty hop/fencing verdict) AND
+#  zero stale split-brain writes landed in any replay — the ISSUE 18
+#  acceptance guard; incident_bundles_exported rides along (how many
+#  bundles the original fleet's rings actually held at drain).
+HARNESS_VERSION = 23
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2728,6 +2740,114 @@ def _bench_degraded_safe() -> dict:
         }
 
 
+
+
+async def bench_incident() -> dict:
+    """Incident round-trip guard (harness v23, ISSUE 18).
+
+    Original run: a degraded-world soak shaped so that every breach is
+    the SAME breach — a latency-only store brownout held BELOW the
+    slow-call threshold (no breaker opens), no stall chaos (no fenced
+    writes), no fan-in lanes (no coalesced waiters), zero jitter, and a
+    tight NORMAL latency objective so every in-window staging job burns
+    budget.  Every auto-exported bundle then carries one signature
+    (`NORMAL` / `latency` / no breaker / guilty hop `upload` / no
+    fencing), so the "newest breach bundle" pick is stable by
+    construction instead of by luck.  The fleet's own /v1/incidents
+    rings are collected at drain; the newest breach-carrying bundle is
+    compiled into a deterministic scenario and replayed on 2
+    consecutive fresh fleets; every replay must reproduce the original
+    breach signature and land zero stale bytes.
+    """
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.incident import (bundle_signature, compile_bundle,
+                                         diff_signatures, scenario_profile,
+                                         signature_from_incidents)
+    from downloader_tpu.soak import SoakProfile
+
+    profile = SoakProfile.degraded(
+        stalls=0,                      # no fenced writes: fenced=False
+        hot_fraction=0.0,              # no fan-in: every job uploads,
+        racing_fraction=0.0,           # so the guilty hop is `upload`
+        bulk_fraction=0.25,
+        slo={"objectives": {"NORMAL": {"p99_ms": 1500,
+                                       "availability": 0.999}}},
+        # threshold ABOVE the brownout latency: the breaker must stay
+        # closed so openBreakers is empty in every exported bundle
+        breakers={"store": {"slow_threshold_ms": 2500, "slow_ratio": 0.5,
+                            "slow_window": 8, "slow_min_calls": 4,
+                            "reset": 1.5}},
+        fault_plan=('[{"seam": "store.*", "kind": "brownout",'
+                    ' "start_s": 1.0, "window_s": 6.0,'
+                    ' "latency_ms": 700, "jitter_ms": 0}]'),
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        world = await SoakTestWorld.create(tmp, profile)
+        try:
+            await world.rig.run(world.workload)
+            bundles = world.rig.incidents
+        finally:
+            await world.close()
+
+    breach_bundles = [b for b in bundles if b.get("breaches")]
+    if not breach_bundles:
+        return {
+            "incident_replay_signature_match": False,
+            "incident_bundles_exported": len(bundles),
+            "incident_bench_error": "degraded run exported no breach "
+                                    "bundle (auto-export missed)",
+        }
+    original = breach_bundles[-1]  # newest: collect sorts oldest-first
+    original_sig = bundle_signature(original)
+    scenario = compile_bundle(original)
+
+    runs = []
+    stale_total = 0
+    for _run in range(2):
+        replay_profile = scenario_profile(scenario)
+        with tempfile.TemporaryDirectory() as tmp:
+            world = await SoakTestWorld.create(tmp, replay_profile)
+            try:
+                await world.rig.run(world.workload)
+                replay_sig = signature_from_incidents(world.rig.incidents)
+                stale_total += len(world.rig.world.byte_mismatches
+                                   if world.rig.world else [])
+            finally:
+                await world.close()
+        runs.append(diff_signatures(original_sig, replay_sig))
+
+    match = all(r["match"] for r in runs) and stale_total == 0
+    out = {
+        "incident_replay_signature_match": match,
+        "incident_bundles_exported": len(bundles),
+        "incident_breach_objectives": original_sig.get("objectives"),
+        "incident_replay_runs": len(runs),
+        "incident_replay_stale_writes": stale_total,
+    }
+    if not match:
+        out["incident_diverged_fields"] = sorted({
+            name for r in runs
+            for name, f in r["fields"].items() if not f["match"]})
+    return out
+
+
+def _bench_incident_safe() -> dict:
+    """An incident-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_incident())
+    except Exception as err:
+        return {
+            "incident_bench_error": f"{type(err).__name__}: {err}"[:200]
+        }
+
+
 BASELINE_HOPS_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BASELINE_HOPS.json")
 
@@ -3175,6 +3295,11 @@ HEADLINE_KEYS = [
                                   # the brownout window)
     "split_brain_stale_writes",   # r18 guard: == 0 (fencing held)
     "degraded_bench_error",       # present only on failure — visible
+    "incident_replay_signature_match",  # r22 guard: 2 consecutive
+                                        # replays reproduce the breach
+                                        # signature, zero stale writes
+    "incident_bundles_exported",  # r22: bundles the fleet rings held
+    "incident_bench_error",       # present only on failure — visible
     "slo_ok",                     # r19: overhead + overview age + hop
                                   # budgets all green
     "slo_overhead_ms",            # r19 guard: SLO tracker < 1 ms/job
@@ -3247,6 +3372,10 @@ def main() -> None:
         # standalone degraded-world soak run (`make bench-degraded`)
         print(json.dumps(_bench_degraded_safe()))
         return
+    if "--incident" in sys.argv:
+        # standalone incident round-trip run (`make bench-incident`)
+        print(json.dumps(_bench_incident_safe()))
+        return
     if "--slo" in sys.argv:
         # standalone SLO-plane run (`make bench-slo`)
         print(json.dumps(_bench_slo_safe()))
@@ -3286,6 +3415,7 @@ def main() -> None:
         **_bench_racing_safe(),
         **_bench_soak_safe(),
         **_bench_degraded_safe(),
+        **_bench_incident_safe(),
         **_bench_slo_safe(),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),

@@ -72,7 +72,7 @@ CONFIG_SECTIONS = frozenset({
     "instance", "minio", "rabbitmq", "services", "store", "tracing",
     "health", "control", "retry", "breakers", "faults", "tenants",
     "overload", "origins", "fleet", "journal", "integrity", "obs",
-    "wire_remap", "slo",
+    "wire_remap", "slo", "incident",
 })
 
 #: documented knobs that are deliberately not read via cfg_get /
@@ -326,6 +326,8 @@ BOUNDED_LABELS = frozenset({
                     # config-bounded tenant-objective keys
                     # (control/slo.py SloTracker.from_config)
     "window",       # the fast|slow burn-rate window pair (literals)
+    "trigger",      # the breach|manual export-trigger pair
+                    # (incident/bundle.py TRIGGER_* literals)
 })
 
 _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram", "Summary"})

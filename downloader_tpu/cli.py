@@ -266,6 +266,73 @@ def _build_parser() -> argparse.ArgumentParser:
     tenants.add_argument("--json", action="store_true",
                          help="raw JSON instead of the table view")
 
+    incident = sub.add_parser(
+        "incident", help="incident plane: export forensic bundles, "
+                         "replay them as deterministic chaos scenarios, "
+                         "diff breach signatures"
+    )
+    incident_sub = incident.add_subparsers(dest="incident_command",
+                                           required=True)
+
+    def _incident_common(p):
+        p.add_argument("--url", default="http://127.0.0.1:3401",
+                       help="service base URL (default local health port)")
+        p.add_argument("--token", default=None,
+                       help="bearer token for mutating endpoints "
+                            "(default: $CONTROL_TOKEN)")
+
+    incident_list = incident_sub.add_parser(
+        "list", help="exported bundle summaries (GET /v1/incidents)")
+    _incident_common(incident_list)
+    incident_list.add_argument("--json", action="store_true",
+                               help="raw JSON instead of the table view")
+
+    incident_show = incident_sub.add_parser(
+        "show", help="one full bundle by bundleId, job id, or trace id")
+    _incident_common(incident_show)
+    incident_show.add_argument("id", help="bundleId | job id | trace id")
+    incident_show.add_argument("--out", default=None,
+                               help="write the bundle JSON to a file "
+                                    "instead of stdout")
+
+    incident_export = incident_sub.add_parser(
+        "export", help="snapshot a live/recent job into the ring now "
+                       "(POST /v1/incidents/{id}/export, trigger=manual)")
+    _incident_common(incident_export)
+    incident_export.add_argument("id", help="job id | trace id")
+    incident_export.add_argument("--out", default=None,
+                                 help="also write the bundle JSON here")
+
+    incident_replay = incident_sub.add_parser(
+        "replay", help="compile a bundle into a deterministic chaos "
+                       "scenario and run it on a fresh SoakRig fleet, "
+                       "then diff breach signatures (same signature = "
+                       "the incident reproduces)")
+    _incident_common(incident_replay)
+    incident_replay.add_argument(
+        "id", nargs="?", default=None,
+        help="bundleId | job id | trace id to pull from --url "
+             "(or use --bundle)")
+    incident_replay.add_argument("--bundle", default=None,
+                                 help="read the bundle from a JSON file "
+                                      "instead of the admin API")
+    incident_replay.add_argument("--runs", type=int, default=1,
+                                 help="consecutive replays; ALL must "
+                                      "match (default 1; the bench's "
+                                      "round-trip guard uses 2)")
+    incident_replay.add_argument("--compile-only", action="store_true",
+                                 help="print the compiled scenario and "
+                                      "exit without running a fleet")
+    incident_replay.add_argument("--no-report", action="store_true",
+                                 help="skip POSTing the verdict back to "
+                                      "--url (/v1/incidents/verdict)")
+
+    incident_diff = incident_sub.add_parser(
+        "diff", help="compare the breach signatures of two bundle JSON "
+                     "files (exit 0 = same signature)")
+    incident_diff.add_argument("original", help="bundle JSON file")
+    incident_diff.add_argument("replay", help="bundle JSON file")
+
     debug = sub.add_parser(
         "debug", help="runtime introspection against a running service"
     )
@@ -910,6 +977,205 @@ async def _tenants(args) -> int:
     return 0
 
 
+async def _incident(args) -> int:
+    """Drive the incident plane (downloader_tpu/incident; ISSUE 18):
+    list/show/export bundles over the admin API, replay one on a fresh
+    SoakRig fleet, and diff breach signatures."""
+    import json
+
+    import aiohttp
+
+    if args.incident_command == "diff":
+        from .incident.replay import bundle_signature, diff_signatures
+
+        with open(args.original, encoding="utf-8") as fh:
+            original = json.load(fh)
+        with open(args.replay, encoding="utf-8") as fh:
+            replay = json.load(fh)
+        verdict = diff_signatures(bundle_signature(original),
+                                  bundle_signature(replay))
+        _print_signature_diff(verdict)
+        return 0 if verdict["match"] else 1
+
+    if args.incident_command == "replay":
+        return await _incident_replay(args)
+
+    base = args.url.rstrip("/")
+    token = args.token or os.environ.get("CONTROL_TOKEN")
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    timeout = aiohttp.ClientTimeout(total=30)
+    async with aiohttp.ClientSession(timeout=timeout,
+                                     headers=headers) as session:
+        try:
+            if args.incident_command == "list":
+                async with session.get(f"{base}/v1/incidents") as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+                if args.json:
+                    print(json.dumps(body, indent=2, sort_keys=True))
+                    return 0
+                if not body.get("enabled"):
+                    print("# incident plane disabled "
+                          "(incident.enabled: false)", file=sys.stderr)
+                verdict = body.get("lastVerdict")
+                if verdict is not None:
+                    print("# last replay verdict: "
+                          + ("MATCH" if verdict.get("match")
+                             else "DIVERGED"), file=sys.stderr)
+                for row in body.get("incidents", []):
+                    objectives = ",".join(row.get("objectives") or []) or "-"
+                    print(f"{row.get('bundleId')}\t{row.get('trigger')}"
+                          f"\t{row.get('jobId')}\t{row.get('state')}"
+                          f"\tbreaches={row.get('breaches')}"
+                          f"\tobjectives={objectives}"
+                          f"\t{row.get('exportedAt')}")
+                return 0
+
+            if args.incident_command == "show":
+                async with session.get(
+                        f"{base}/v1/incidents/{args.id}") as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+                return _emit_bundle(body, args.out)
+
+            if args.incident_command == "export":
+                async with session.post(
+                        f"{base}/v1/incidents/{args.id}/export") as resp:
+                    body = await resp.json()
+                    if resp.status not in (200, 201):
+                        print(json.dumps(body), file=sys.stderr)
+                        return 1
+                print(f"# exported {body.get('bundleId')} "
+                      f"(trigger={body.get('trigger')})", file=sys.stderr)
+                return _emit_bundle(body, args.out)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as err:
+            print(f"{base}: unreachable ({err})", file=sys.stderr)
+            return 2
+    raise AssertionError("unreachable")
+
+
+def _emit_bundle(bundle: dict, out) -> int:
+    import json
+
+    blob = json.dumps(bundle, indent=2, sort_keys=True)
+    if out:
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(blob + "\n")
+        print(f"# wrote {out}", file=sys.stderr)
+    else:
+        print(blob)
+    return 0
+
+
+def _print_signature_diff(verdict: dict) -> None:
+    import json
+
+    for name, field in verdict["fields"].items():
+        mark = "=" if field["match"] else "!"
+        print(f"{mark} {name}\toriginal={json.dumps(field['original'])}"
+              f"\treplay={json.dumps(field['replay'])}")
+    print("match" if verdict["match"] else "DIVERGED")
+
+
+async def _incident_replay(args) -> int:
+    """Pull (or read) a bundle, compile it, run the scenario on a fresh
+    SoakRig fleet --runs times, and require EVERY replay to reproduce
+    the original breach signature."""
+    import json
+    import tempfile
+
+    import aiohttp
+
+    from .incident.compiler import compile_bundle, scenario_profile
+    from .incident.replay import (diff_signatures,
+                                  signature_from_incidents)
+
+    base = args.url.rstrip("/")
+    if args.bundle:
+        with open(args.bundle, encoding="utf-8") as fh:
+            bundle = json.load(fh)
+    elif args.id:
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            try:
+                async with session.get(
+                        f"{base}/v1/incidents/{args.id}") as resp:
+                    bundle = await resp.json()
+                    if resp.status != 200:
+                        print(json.dumps(bundle), file=sys.stderr)
+                        return 1
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as err:
+                print(f"{base}: unreachable ({err})", file=sys.stderr)
+                return 2
+    else:
+        print("incident replay: give a bundle id or --bundle FILE",
+              file=sys.stderr)
+        return 1
+
+    scenario = compile_bundle(bundle)
+    if args.compile_only:
+        print(json.dumps(scenario, indent=2, sort_keys=True))
+        return 0
+
+    # the SoakTestWorld builder lives with the tests (it wires MiniAmqp
+    # + MiniS3 + loopback origins around the rig) — imported the same
+    # way the bench does
+    tests_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "tests")
+    tests_dir = os.path.abspath(tests_dir)
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_soak import SoakTestWorld
+
+    original_sig = scenario["signature"]
+    print(f"# replaying {scenario.get('source')}: "
+          f"{len(scenario['faultPlan'])} fault rule(s), "
+          f"{scenario['profile'].get('jobs')} jobs x{args.runs} run(s)",
+          file=sys.stderr)
+    all_match = True
+    last_verdict = None
+    for run in range(max(args.runs, 1)):
+        profile = scenario_profile(scenario)
+        with tempfile.TemporaryDirectory() as tmp:
+            world = await SoakTestWorld.create(tmp, profile)
+            try:
+                await world.rig.run(world.workload)
+                replay_sig = signature_from_incidents(world.rig.incidents)
+            finally:
+                await world.close()
+        verdict = diff_signatures(original_sig, replay_sig)
+        last_verdict = verdict
+        print(f"# run {run + 1}/{args.runs}: "
+              + ("signature MATCH" if verdict["match"] else "DIVERGED"),
+              file=sys.stderr)
+        _print_signature_diff(verdict)
+        all_match = all_match and verdict["match"]
+
+    if not args.no_report and last_verdict is not None:
+        # best-effort: land the verdict on the worker that exported the
+        # bundle (incident_replay_signature_match gauge)
+        token = args.token or os.environ.get("CONTROL_TOKEN")
+        headers = ({"Authorization": f"Bearer {token}"} if token else {})
+        try:
+            timeout = aiohttp.ClientTimeout(total=10)
+            async with aiohttp.ClientSession(timeout=timeout,
+                                             headers=headers) as session:
+                async with session.post(
+                        f"{base}/v1/incidents/verdict",
+                        json={"match": all_match,
+                              "bundleId": bundle.get("bundleId"),
+                              "fields": last_verdict["fields"]}) as resp:
+                    await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass
+    return 0 if all_match else 1
+
+
 async def _debug(args) -> int:
     """Drive the runtime-introspection endpoints (/debug/*)."""
     import json
@@ -1155,6 +1421,8 @@ def main(argv=None) -> int:
         return asyncio.run(_trace(args))
     if args.command == "tenants":
         return asyncio.run(_tenants(args))
+    if args.command == "incident":
+        return asyncio.run(_incident(args))
     if args.command == "debug":
         return asyncio.run(_debug(args))
     if args.command == "watch":

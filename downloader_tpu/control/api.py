@@ -36,6 +36,16 @@ serves probes, metrics, and operations:
                                     tenant weight/caps/quotas, live queue
                                     depth and slot occupancy, saturation
                                     snapshot
+    GET  /v1/incidents              exported incident-bundle summaries
+                                    (the bounded auto-export ring +
+                                    manual exports); disabled plane
+                                    reads as enabled:false, never a 5xx
+    GET  /v1/incidents/{id}         one full bundle by bundleId, job id,
+                                    or trace id
+    POST /v1/incidents/{id}/export  snapshot a live/recent job into the
+                                    ring now (trigger=manual)
+    POST /v1/incidents/verdict      record an incident-replay verdict
+                                    (sets incident_replay_signature_match)
     POST /v1/intake/pause           stop pulling deliveries (in-flight
                                     work keeps running; /readyz -> 503)
     POST /v1/intake/resume          start pulling again
@@ -62,6 +72,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..incident.bundle import TRIGGER_MANUAL, export_incident
 from ..platform.config import cfg_get
 from ..platform.obs import dump_stacks, dump_tasks
 from . import registry as reg
@@ -401,6 +412,89 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
         await resume()
         return web.json_response({"intakePaused": False})
 
+    async def incidents_list(_request: web.Request) -> web.Response:
+        """Exported incident bundles (ISSUE 18), summaries only — the
+        same degradation contract as the fleet surfaces: a disabled or
+        empty incident plane reads as an empty listing, never a 5xx."""
+        store = getattr(orchestrator, "incidents", None)
+        if store is None:
+            return web.json_response({"enabled": False, "incidents": []})
+        payload = {
+            "enabled": True,
+            "workerId": getattr(orchestrator, "worker_id", None),
+            "maxBundles": store.max_bundles,
+            "autoExport": store.auto_export,
+            "exportedTotal": store.exported_total,
+            "lastVerdict": store.last_verdict,
+            "incidents": [],
+        }
+        try:
+            payload["incidents"] = store.summaries()
+        except Exception:
+            pass  # a torn summary degrades to the empty list, not a 5xx
+        return web.json_response(payload)
+
+    async def incident_show(request: web.Request) -> web.Response:
+        """One full bundle, by bundleId, job id, or trace id."""
+        store = getattr(orchestrator, "incidents", None)
+        if store is None:
+            return web.json_response(
+                {"error": "incident plane disabled"}, status=404)
+        bundle = store.get(request.match_info["id"])
+        if bundle is None:
+            return web.json_response(
+                {"error": "unknown incident"}, status=404)
+        return web.json_response(bundle)
+
+    async def incident_export_route(request: web.Request) -> web.Response:
+        """Manual export: snapshot a live/recently-settled job into the
+        ring (trigger=manual) and return the full bundle."""
+        if not _authorized(request):
+            return _deny()
+        if getattr(orchestrator, "incidents", None) is None:
+            return web.json_response(
+                {"error": "incident plane disabled"}, status=409)
+        bundle = export_incident(
+            orchestrator, request.match_info["id"], trigger=TRIGGER_MANUAL)
+        if bundle is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response(bundle, status=201)
+
+    async def incident_verdict(request: web.Request) -> web.Response:
+        """Record a replay verdict against this worker's incidents:
+        `cli incident replay/diff` posts whether the replay reproduced
+        the original breach signature, which lands on the
+        incident_replay_signature_match gauge (so the worker that
+        exported the bundle alarms on a diverging replay)."""
+        if not _authorized(request):
+            return _deny()
+        store = getattr(orchestrator, "incidents", None)
+        if store is None:
+            return web.json_response(
+                {"error": "incident plane disabled"}, status=409)
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400)
+        if not isinstance(body, dict) or "match" not in body:
+            return web.json_response(
+                {"error": "body must carry match: bool"}, status=400)
+        verdict = {
+            "match": bool(body.get("match")),
+            "bundleId": body.get("bundleId"),
+            "fields": body.get("fields"),
+        }
+        store.last_verdict = verdict
+        metrics = getattr(orchestrator, "metrics", None)
+        if metrics is not None:
+            try:
+                metrics.incident_replay_signature_match.set(
+                    1.0 if verdict["match"] else 0.0)
+            except Exception:
+                pass
+        return web.json_response({"recorded": True, **verdict})
+
     async def drain(request: web.Request) -> web.Response:
         if not _authorized(request):
             return _deny()
@@ -440,6 +534,14 @@ def bind_control_routes(app: web.Application, orchestrator) -> None:
     # runtime introspection: reads, open like /metrics
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)
+    # incident plane: the bundle ring (reads open like /metrics;
+    # manual exports + replay verdicts token-gated).  The literal
+    # /verdict route registers before the {id} capture, like
+    # /v1/fleet/overview above
+    app.router.add_get("/v1/incidents", incidents_list)
+    app.router.add_get("/v1/incidents/{id}", incident_show)
+    app.router.add_post("/v1/incidents/verdict", incident_verdict)
+    app.router.add_post("/v1/incidents/{id}/export", incident_export_route)
     app.router.add_post("/v1/intake/pause", intake_pause)
     app.router.add_post("/v1/intake/resume", intake_resume)
     app.router.add_post("/v1/drain", drain)

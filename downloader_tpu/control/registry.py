@@ -135,6 +135,7 @@ class JobRecord:
         "worker_id", "tenant", "ttl_seconds", "deadline_mono",
         "recovered", "hops", "fleet_fence", "fleet_fence_key",
         "fleet_waited_s", "workload",
+        "route_key", "route_decision", "plan_epoch",
     )
 
     def __init__(self, uid: int, job_id: str, file_id: str, priority: str,
@@ -227,6 +228,14 @@ class JobRecord:
         # stage that ran a chip-bound subsystem (the upscale stage sets
         # "UPSCALE"), so the job ALSO burns that subsystem's SLO budget
         self.workload: Optional[str] = None
+        # placement context (fleet/router.py + the controller plan):
+        # the content route key, the router's admission outcome, and
+        # the plan epoch in force when this delivery was admitted —
+        # joined onto slo_breach events and incident bundles so a
+        # breach explains WHERE the job was when it burned
+        self.route_key: Optional[str] = None
+        self.route_decision: Optional[str] = None
+        self.plan_epoch: Optional[int] = None
 
     @property
     def terminal(self) -> bool:
@@ -299,6 +308,12 @@ class JobRecord:
             "hopLedger": (self.hops.summary()
                           if self.hops is not None and self.hops else None),
             "fleetFence": self.fleet_fence,
+            "placement": ({
+                "routeKey": self.route_key,
+                "routeDecision": self.route_decision,
+                "planEpoch": self.plan_epoch,
+            } if (self.route_key or self.route_decision
+                  or self.plan_epoch is not None) else None),
         }
 
 

@@ -295,7 +295,7 @@ class SloTracker:
     def resolve_class(self, priority: Optional[str]) -> str:
         return priority if priority in self.objectives else "NORMAL"
 
-    def note_settle(self, record, mode: str, why: str) -> None:
+    def note_settle(self, record, mode: str, why: str) -> bool:
         """Classify one settled delivery (the orchestrator calls this
         from its single settle funnel, for every ack AND nack).
 
@@ -303,9 +303,12 @@ class SloTracker:
         cancels are operator decisions; neither is a resolution.
         Everything else resolves good (acked done/staged inside the
         latency target) or bad (acked failure, or a latency breach).
+        Returns True when the resolution burned error budget (an
+        ``slo_breach`` was stamped) — the incident plane's auto-export
+        trigger (downloader_tpu/incident).
         """
         if mode != "ack" or why in _EXCLUDED_WHYS:
-            return
+            return False
         now = self.clock()
         latency_s = max(
             now - getattr(record, "_created_mono", now), 0.0)
@@ -334,14 +337,19 @@ class SloTracker:
         if not good:
             # the breach rides the job's own timeline (and from there
             # the debug bundle + the fleet trace digest) BEFORE the
-            # record retires
+            # record retires — with the placement context in force
+            # (route key, router decision, plan epoch: ISSUE 18), so a
+            # bundle explains WHERE the job was when it burned
             try:
                 record.event(
                     "slo_breach", objective=cls, why=why,
                     latency_ms=round(latency_s * 1000.0, 1),
                     target_ms=target.p99_ms,
                     breach=("availability" if not succeeded
-                            else "latency"))
+                            else "latency"),
+                    routeKey=getattr(record, "route_key", None),
+                    routeDecision=getattr(record, "route_decision", None),
+                    planEpoch=getattr(record, "plan_epoch", None))
             except Exception:
                 pass  # accounting must never fail a settle
         # hop/stage accumulation for the fleet digest (mixed-traffic
@@ -358,6 +366,7 @@ class SloTracker:
         stage_seconds = getattr(record, "stage_seconds", None)
         if stage_seconds:
             self._stage_seconds_total += sum(stage_seconds.values())
+        return not good
 
     # -- window math -----------------------------------------------------
     def burn_rate(self, name: str, window_s: float,

@@ -1466,6 +1466,26 @@ class FleetPlane:
             return None
         return doc if age <= max_age else None
 
+    def plan_in_force(self) -> Optional[dict]:
+        """The plan doc to attribute admissions to: the fresh plan when
+        the controller is live, else the last cached doc (a stale plan
+        no longer STEERS admission, but it is still the right answer to
+        "what plan was in force" for forensic stamping — incident
+        bundles and ``slo_breach`` placement context, ISSUE 18)."""
+        fresh = self.current_plan()
+        return fresh if fresh is not None else self._plan_doc
+
+    def plan_epoch(self) -> Optional[int]:
+        """The epoch of the plan in force, or None before any plan."""
+        doc = self.plan_in_force()
+        if doc is None:
+            return None
+        epoch = doc.get("epoch")
+        try:
+            return int(epoch)
+        except (TypeError, ValueError):
+            return None
+
     def route_holder(self, route_key: str) -> Optional[dict]:
         """The live lease doc whose ``routeKey`` matches, served from
         the watch-fed cache (zero store RTTs at admission); None when

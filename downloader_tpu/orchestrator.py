@@ -45,7 +45,8 @@ from .control.slo import SloTracker
 from .control.tenancy import TenantTable
 from .fleet.controller import PlacementController
 from .fleet.plane import FleetPlane, resolve_worker_id
-from .fleet.router import ContentRouter
+from .fleet.router import ContentRouter, route_key_for
+from .incident.bundle import TRIGGER_BREACH, IncidentStore, build_bundle
 from .mq.base import Delivery, MessageQueue
 from .platform import faults
 from .platform.config import cfg_get
@@ -375,6 +376,13 @@ class Orchestrator:
         )
         self.controller = PlacementController.from_config(
             config, self.fleet, metrics=metrics, logger=self.logger,
+        )
+        # incident plane (ISSUE 18): bounded ring of exported forensic
+        # bundles, fed by auto-export when a settle burns error budget
+        # and by the admin API/CLI on demand.  None (``incident.enabled:
+        # false``) keeps the settle path exactly as before.
+        self.incidents = IncidentStore.from_config(
+            config, metrics=metrics, logger=self.logger,
         )
         self.stage_resources["job_registry"] = self.registry
         # the stages stack each job's per-tenant byte quota under the
@@ -1247,10 +1255,19 @@ class Orchestrator:
             # parked fleet wait are spent on it.  Pure cached-view
             # reads; "run" (the lone-worker default) costs nothing.
             if self.router is not None:
+                source_uri = getattr(msg.media, "source_uri", "") or ""
                 decision = self.router.decide(
-                    getattr(msg.media, "source_uri", "") or "",
-                    priority=priority, tenant=tenant,
+                    source_uri, priority=priority, tenant=tenant,
                 )
+                if record is not None:
+                    # placement context, stamped BEFORE the settles
+                    # check so even a deferred/shed delivery's record
+                    # (and any later slo_breach / incident bundle)
+                    # carries where the router put it (ISSUE 18)
+                    record.route_key = route_key_for(source_uri)
+                    record.route_decision = decision.outcome
+                    if self.fleet is not None:
+                        record.plan_epoch = self.fleet.plan_epoch()
                 if decision.settles:
                     await self._route_delivery(delivery, child, record,
                                                token, decision)
@@ -1498,8 +1515,24 @@ class Orchestrator:
         if self.journal is not None:
             self.journal.append("settle", record.job_id, mode=mode,
                                 why=why)
+        breached = False
         if self.slo is not None:
-            self.slo.note_settle(record, mode, why)
+            breached = bool(self.slo.note_settle(record, mode, why))
+        if breached and self.incidents is not None \
+                and self.incidents.auto_export:
+            # auto-export (incident/bundle.py): the breach that was just
+            # stamped becomes a forensic bundle in the bounded ring —
+            # best-effort, because a full ring or a torn journal must
+            # never fail the settle itself
+            try:
+                bundle = build_bundle(self, record, trigger=TRIGGER_BREACH)
+                self.incidents.add(bundle, trigger=TRIGGER_BREACH)
+                record.event("incident_export",
+                             bundleId=bundle.get("bundleId"),
+                             trigger=TRIGGER_BREACH)
+            except Exception as err:
+                self.logger.warn("incident auto-export failed",
+                                 jobId=record.job_id, error=str(err))
 
     async def _remove_workdir(self, job_id: str, logger: Logger) -> None:
         """Best-effort workdir removal for settles after which no

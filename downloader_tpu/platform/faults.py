@@ -108,6 +108,14 @@ MODES = ("all", "writes", "reads")
 _WRITE_OPS = frozenset({"put", "delete", "remove", "bucket", "write",
                         "publish", "spill", "announce", "ack", "nack"})
 
+#: the declarative surface of a rule — exactly the keys from_dict
+#: accepts and to_dict emits.  The incident plane's bundle/compile
+#: round-trip (downloader_tpu/incident) leans on this: a serialized
+#: rule must re-load through from_dict on any later version.
+RULE_FIELDS = ("seam", "kind", "match", "count", "after", "fault",
+               "delay_s", "start_s", "window_s", "latency_ms",
+               "jitter_ms", "mode", "blackhole", "period_s", "duty")
+
 #: brownout jitter: a fixed sample sequence standing in for a latency
 #: distribution — deterministic across reruns (indexed by per-rule
 #: fire count), spread roughly uniform over [0, 1)
@@ -203,15 +211,19 @@ class FaultRule:
 
     @classmethod
     def from_dict(cls, raw: dict) -> "FaultRule":
-        unknown = set(raw) - {"seam", "kind", "match", "count", "after",
-                              "fault", "delay_s", "start_s", "window_s",
-                              "latency_ms", "jitter_ms", "mode",
-                              "blackhole", "period_s", "duty"}
+        unknown = set(raw) - set(RULE_FIELDS)
         if unknown:
             raise ValueError(f"unknown fault rule keys: {sorted(unknown)}")
         if "seam" not in raw:
             raise ValueError("fault rule needs a 'seam'")
         return cls(**raw)
+
+    def to_dict(self) -> dict:
+        """The rule's declarative config (RULE_FIELDS only — runtime
+        counters excluded), round-trippable through :meth:`from_dict`.
+        This is what an incident bundle ships as the fault plan in
+        force, so a compiled replay re-arms the exact same rules."""
+        return {name: getattr(self, name) for name in RULE_FIELDS}
 
     # -- windowed phase helpers (pure functions of elapsed time) --------
     def window_active(self, elapsed: float) -> bool:

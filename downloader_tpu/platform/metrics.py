@@ -439,6 +439,30 @@ class Metrics:
             ["class"],
             registry=self.registry,
         )
+        # -- incident plane (downloader_tpu/incident) ------------------
+        # "trigger" is bounded by the two code literals breach|manual
+        # (incident/bundle.py TRIGGER_BREACH / TRIGGER_MANUAL)
+        self.incident_bundles = Counter(
+            f"{ns}_incident_bundles_total",
+            "Incident bundles exported into the bounded ring, by "
+            "trigger (breach = auto-export at a budget-burning settle; "
+            "manual = admin API / CLI).  A breach-trigger rate above "
+            "the slo_burn_rate page condition means the ring "
+            "(incident.max_bundles) is evicting forensics — raise it "
+            "or pull bundles off the worker faster",
+            ["trigger"],
+            registry=self.registry,
+        )
+        self.incident_replay_signature_match = Gauge(
+            f"{ns}_incident_replay_signature_match",
+            "1 when the latest incident replay reproduced the original "
+            "breach signature (same objective classes, open-breaker "
+            "dependency+reason, guilty hop, fencing verdict), 0 when "
+            "it diverged; -1 until a replay has run.  Set by the bench "
+            "--incident arm and `cli incident replay`",
+            registry=self.registry,
+        )
+        self.incident_replay_signature_match.set(-1.0)
         self.fleet_overview_age = Gauge(
             f"{ns}_fleet_overview_age_seconds",
             "Age of the fleet-overview document this worker last "

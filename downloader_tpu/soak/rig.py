@@ -30,6 +30,7 @@ import yaml
 from .. import schemas
 from ..control.journal import JOURNAL_DIRNAME, JOURNAL_FILENAME, replay
 from ..fleet.coord import BucketCoordStore
+from ..incident.replay import collect_incidents
 from ..mq.amqp import AmqpQueue
 from ..stages.upload import (STAGING_BUCKET, done_marker_name,
                              object_name)
@@ -153,6 +154,10 @@ class SoakRig:
         #: the growth sampler's series, kept after run() for callers
         #: that inspect the raw timelines (tests, the bench)
         self.samples: List = []
+        #: the fleet's auto-exported incident bundles (ISSUE 18),
+        #: pulled from every live worker's /v1/incidents just before
+        #: drain — the replay diff's raw material
+        self.incidents: List[dict] = []
         self.slots = [self._make_slot(i) for i in range(profile.workers)]
         self._session: Optional[aiohttp.ClientSession] = None
 
@@ -668,6 +673,10 @@ class SoakRig:
                 await asyncio.sleep(
                     max(profile.telemetry_ttl,
                         2 * profile.gc_interval) + 0.5)
+                # incident bundles (ISSUE 18): pull every worker's
+                # auto-exported ring while the admin APIs still answer
+                self.incidents = await collect_incidents(
+                    [self._url(slot, "") for slot in self.live_workers()])
                 await self.drain_workers()
                 await sampler.sample_once()
                 world = await self.collect_world(sampler.scrape_failures)
