@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-multichip bench-incident compute-shard chaos crash degraded fleet fleet-v2 incident fuzz-scenarios obs origins slo soak soak-smoke soak-full proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-zerocopy bench-multichip bench-incident compute-shard chaos crash degraded fleet fleet-v2 incident fuzz-scenarios obs origins slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -192,6 +192,14 @@ bench-degraded:
 # BASELINE_HOPS.json budget, failures name the guilty hop)
 bench-slo:
 	python bench.py --slo
+
+# standalone zero-copy staging A/B (one JSON line:
+# zerocopy_cpu_ratio = buffered-path CPU per staged GB / zero-copy-path
+# CPU per staged GB on the same calibration job — > 1.0 means the
+# mmap/sendfile upload path is cheaper per byte; a ratio sliding to
+# 1.0 flags a quietly re-introduced buffered copy)
+bench-zerocopy:
+	python bench.py --zerocopy
 
 # standalone incident round-trip bench (one JSON line:
 # incident_replay_signature_match = a degraded-world breach bundle,

@@ -213,7 +213,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  zero stale split-brain writes landed in any replay — the ISSUE 18
 #  acceptance guard; incident_bundles_exported rides along (how many
 #  bundles the original fleet's rings actually held at drain).
-HARNESS_VERSION = 23
+#
+# v24 (ISSUE 19 zero-copy staging ratchet): the calibration workload now
+#  exercises hash-on-land (integrity defaults on -> a `hash` hop budget),
+#  the shared-tier arm measures peer materialization (`shared_fetch`
+#  budget: hardlink tier on a co-located fs store drives it toward
+#  zero), and `--zerocopy` A/Bs the whole staging pipeline's
+#  cpu_s_per_gb with the store's zero-copy upload path on vs off.
+HARNESS_VERSION = 24
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2853,7 +2860,8 @@ BASELINE_HOPS_PATH = os.path.join(
 
 
 async def _hop_calibration_job(tag: str, mib: int = 48,
-                               no_splice: bool = False) -> dict:
+                               no_splice: bool = False,
+                               zero_copy: bool = True) -> dict:
     """One calibration-shaped end-to-end job (the bench v16 coverage
     workload: barrier dispatch, loopback HTTP origin, real-wire MiniS3)
     — returns the settled job's ``{hop: seconds_per_gb}`` for every
@@ -2900,7 +2908,7 @@ async def _hop_calibration_job(tag: str, mib: int = 48,
     s3 = MiniS3()
     await s3.start()
     client = S3ObjectStore(f"http://127.0.0.1:{s3.port}", "AKIA",
-                           "SECRET")
+                           "SECRET", zero_copy=zero_copy)
     splice_env = os.environ.pop("HTTP_NO_SPLICE", None)
     if no_splice:
         os.environ["HTTP_NO_SPLICE"] = "1"
@@ -3080,16 +3088,54 @@ async def _hop_calibration_upscale_job(tag: str) -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+async def _hop_calibration_shared_job(tag: str, mib: int = 48) -> dict:
+    """Peer shared-tier arm: publish a cache entry from one plane and
+    materialize it from another against a CO-LOCATED filesystem store —
+    the regime the hardlink tier serves.  Returns
+    ``{"shared_fetch": seconds_per_gb}`` with the wall clock of the
+    peer materialization over the bytes it delivered, so the budget
+    asserts the zero-copy property itself: an inode link is ~free, and
+    a regression back to streamed copies shows up as s/GB."""
+    import tempfile
+
+    from downloader_tpu.fleet.plane import FleetPlane, MemoryCoordStore
+    from downloader_tpu.stages.upload import STAGING_BUCKET
+    from downloader_tpu.store import FilesystemObjectStore
+    from downloader_tpu.store.cache import ContentCache, cache_key
+
+    with tempfile.TemporaryDirectory() as work:
+        store = FilesystemObjectStore(os.path.join(work, "store"))
+        await store.make_bucket(STAGING_BUCKET)
+        src = os.path.join(work, "src")
+        os.makedirs(src)
+        with open(os.path.join(src, "m.mkv"), "wb") as fh:
+            fh.write(b"Z" * (mib << 20))
+        key = cache_key("http", f"http://cal/{tag}.mkv", '"cal"')
+        cache_a = ContentCache(os.path.join(work, "cache-a"))
+        cache_b = ContentCache(os.path.join(work, "cache-b"))
+        plane_a = FleetPlane(MemoryCoordStore(), f"{tag}-wa", store=store)
+        plane_b = FleetPlane(MemoryCoordStore(), f"{tag}-wb", store=store)
+        await cache_a.insert(key, src)
+        assert await plane_a.publish_entry(key, cache_a)
+        mark = time.monotonic()
+        assert await plane_b.fetch_entry(key, cache_b)
+        elapsed = time.monotonic() - mark
+    return {"shared_fetch": elapsed / ((mib << 20) / 1e9)}
+
+
 async def _hop_calibration_arms(tag: str) -> dict:
     """Every calibration regime's ``{hop: seconds_per_gb}``, merged (a
     hop measured by several arms keeps its WORST value — the
     conservative side of a budget guard): both barrier-HTTP ingress
-    regimes plus the seeded-upscale arm (h2d/compute/d2h/cache)."""
+    regimes (which carry the hash-on-land ``hash`` hop since v24), the
+    seeded-upscale arm (h2d/compute/d2h/cache), and the peer
+    shared-tier arm (``shared_fetch`` via the hardlink tier)."""
     spliced = await _hop_calibration_job(f"{tag}-splice")
     chunked = await _hop_calibration_job(f"{tag}-chunk", no_splice=True)
     upscaled = await _hop_calibration_upscale_job(f"{tag}-upscale")
+    shared = await _hop_calibration_shared_job(f"{tag}-shared")
     merged = dict(spliced)
-    for arm in (chunked, upscaled):
+    for arm in (chunked, upscaled, shared):
         for hop, value in arm.items():
             merged[hop] = max(merged.get(hop, 0.0), value)
     return merged
@@ -3210,6 +3256,53 @@ def _bench_slo_safe() -> dict:
         return {"slo_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
+async def bench_zerocopy(mib: int = 48, reps: int = 3) -> dict:
+    """``--zerocopy``: A/B the staging pipeline's CPU cost with the
+    store's zero-copy upload path (mmap-fed multipart / sendfile PUT)
+    on vs off — same calibration-shaped end-to-end job, same host,
+    back to back.
+
+    The headline is ``zerocopy_cpu_ratio`` = off / on in process-CPU
+    seconds per staged GB: > 1.0 means the zero-copy path is cheaper
+    per byte, and a ratio sliding toward 1.0 is the early-warning that
+    a code change quietly re-introduced a buffered copy."""
+    import statistics
+
+    cpu = {True: [], False: []}
+    gb = (mib << 20) / 1e9
+    for rep in range(reps):
+        # interleave the arms so slow host drift (thermal, neighbors)
+        # taxes both sides evenly instead of biasing one
+        for flag in (True, False):
+            mark = time.process_time()
+            await _hop_calibration_job(
+                f"zc-{'on' if flag else 'off'}{rep}", mib=mib,
+                zero_copy=flag)
+            cpu[flag].append((time.process_time() - mark) / gb)
+    on = statistics.median(cpu[True])
+    off = statistics.median(cpu[False])
+    return {
+        "zerocopy_on_cpu_s_per_gb": round(on, 3),
+        "zerocopy_off_cpu_s_per_gb": round(off, 3),
+        "zerocopy_cpu_ratio": round(off / on, 3) if on > 0 else None,
+        "zerocopy_reps": reps,
+        "zerocopy_mib_per_job": mib,
+    }
+
+
+def _bench_zerocopy_safe(reps: int = 3) -> dict:
+    """A zero-copy A/B failure must not discard the primary metric.
+
+    The full-run caller passes ``reps=1`` (a single interleaved pair:
+    visibility without a 3-minute tax on every headline run); the
+    standalone ``--zerocopy`` target keeps the careful 3-rep median."""
+    try:
+        return asyncio.run(bench_zerocopy(reps=reps))
+    except Exception as err:
+        return {"zerocopy_bench_error":
+                f"{type(err).__name__}: {err}"[:200]}
+
+
 def calibrate_hops(reps: int = 5, headroom: float = 4.0) -> dict:
     """``--calibrate-hops``: re-measure the calibration workload and
     rewrite BASELINE_HOPS.json (p50/p99/budget per hop).  Run on a
@@ -3231,7 +3324,7 @@ def calibrate_hops(reps: int = 5, headroom: float = 4.0) -> dict:
         f"python bench.py --calibrate-hops (harness v{HARNESS_VERSION},"
         f" {reps} reps, 48 MiB barrier HTTP->MiniS3 job + seeded y4m"
         f" upscale job on the 8-device dry-run mesh, cache-hit second"
-        f" pass)")
+        f" pass + co-located fleet shared-tier fetch)")
     with open(BASELINE_HOPS_PATH, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")
@@ -3307,6 +3400,9 @@ HEADLINE_KEYS = [
     "hop_budget_ok",              # r19 guard: every hop inside its
                                   # BASELINE_HOPS.json budget
     "slo_bench_error",            # present only on failure — visible
+    "zerocopy_cpu_ratio",         # r24: off/on CPU per staged GB, > 1.0
+    "zerocopy_on_cpu_s_per_gb",   # r24: the zero-copy arm's raw cost
+    "zerocopy_bench_error",       # present only on failure — visible
     "utp_vs_tcp",
     "mfu",
     "mfu_1080p",
@@ -3380,6 +3476,10 @@ def main() -> None:
         # standalone SLO-plane run (`make bench-slo`)
         print(json.dumps(_bench_slo_safe()))
         return
+    if "--zerocopy" in sys.argv:
+        # standalone zero-copy staging A/B (`make bench-zerocopy`)
+        print(json.dumps(_bench_zerocopy_safe()))
+        return
     if "--multichip" in sys.argv:
         # standalone sharded-compute run (`make bench-multichip`)
         print(json.dumps(_bench_multichip_safe()))
@@ -3417,6 +3517,7 @@ def main() -> None:
         **_bench_degraded_safe(),
         **_bench_incident_safe(),
         **_bench_slo_safe(),
+        **_bench_zerocopy_safe(reps=1),
         **_bench_stage_overlap_safe(),
         **_bench_torrent_safe(),
         **bench_compute(),

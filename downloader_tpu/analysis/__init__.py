@@ -17,7 +17,7 @@ Importing the checker modules registers their rules; keep the imports
 even though nothing references them by name.
 """
 
-from . import asynchrony, drift, generic, wire
+from . import asynchrony, drift, generic, staging, wire
 from .core import (
     DEFAULT_TARGETS,
     AnalysisResult,
@@ -51,5 +51,6 @@ __all__ = [
     "asynchrony",
     "drift",
     "generic",
+    "staging",
     "wire",
 ]

@@ -72,7 +72,7 @@ CONFIG_SECTIONS = frozenset({
     "instance", "minio", "rabbitmq", "services", "store", "tracing",
     "health", "control", "retry", "breakers", "faults", "tenants",
     "overload", "origins", "fleet", "journal", "integrity", "obs",
-    "wire_remap", "slo", "incident",
+    "wire_remap", "slo", "incident", "download",
 })
 
 #: documented knobs that are deliberately not read via cfg_get /

@@ -754,6 +754,11 @@ def render_overview(body: dict) -> list:
     if hops:
         lines.append("top hops (s/GB): " + "  ".join(
             f"{h.get('hop')}={h.get('secondsPerGb')}" for h in hops))
+    cpu_per_gb = totals.get("cpuSPerGb")
+    if cpu_per_gb is not None:
+        top = (f"  top offender: {hops[0].get('hop')}"
+               f"={hops[0].get('secondsPerGb')}" if hops else "")
+        lines.append(f"staging copy cost (cpu s/GB): {cpu_per_gb}{top}")
     ratio = totals.get("hopReconcileRatioMixed")
     if ratio is not None:
         lines.append(f"hop/stage reconcile (mixed, unguarded): {ratio}")

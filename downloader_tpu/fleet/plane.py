@@ -55,6 +55,7 @@ resumed stale leader must lose.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import json
 import os
 import posixpath
@@ -95,6 +96,16 @@ ORIGIN_HEALTH_KEY = ORIGINS_PREFIX + "health"
 # shared-tier object layout in the staging bucket
 SHARED_PREFIX = ".fleet-cache/"
 MANIFEST_NAME = "manifest.json"
+
+
+def _fput_supports(store, parameter: str) -> bool:
+    """Signature probe for optional fput_object capabilities (tests
+    monkeypatch fput freely, so probe per call, not at construction)."""
+    try:
+        return parameter in inspect.signature(
+            store.fput_object).parameters
+    except (TypeError, ValueError):
+        return False
 
 DEFAULT_HEARTBEAT_INTERVAL = 5.0
 DEFAULT_LIVENESS_TTL = 15.0
@@ -990,10 +1001,20 @@ class FleetPlane:
                 if entry is None:
                     return False
                 src_dir = cache.entry_path(key)
+                # consume=True where the store takes it: a sealed cache
+                # entry is immutable (aliasing is all the contract
+                # permits), so a co-located filesystem store ingests the
+                # spill by hardlink — O(1) instead of a byte copy per
+                # file.  Eviction later just unlinks the cache's name;
+                # the store's link keeps the inode alive.
+                spill_kwargs = (
+                    {"consume": True}
+                    if _fput_supports(self.store, "consume") else {})
                 for rel in entry.files:
                     await self.store.fput_object(
                         self.shared_bucket, self._shared_name(key, rel),
                         os.path.join(src_dir, *rel.split("/")),
+                        **spill_kwargs,
                     )
                 manifest = {
                     "key": key,
@@ -1085,16 +1106,41 @@ class FleetPlane:
             cache.staging_dir,
             f"{key}.{os.getpid()}.fleet{os.urandom(3).hex()}",
         )
+        # peer hardlink tier: a CO-LOCATED store (filesystem-backed,
+        # same host/volume fleet) exposes the object's on-disk path —
+        # materialize by hardlink, zero bucket round-trip and zero byte
+        # movement.  Anything else (remote store, cross-device cache
+        # volume, no-hardlink fs) streams a copy exactly as before.
+        local_path = getattr(self.store, "local_object_path", None)
+
+        def _materialize_linked(src: str, dst: str) -> bool:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            try:
+                os.link(src, dst)
+                return True
+            except OSError:
+                # EXDEV / EPERM / EMLINK: the streaming path below is
+                # the byte-exact fallback
+                return False
+
         try:
             size = 0
+            linked = 0
             for rel in files:
                 parts = [p for p in rel.split("/")
                          if p not in ("", ".", "..")]
                 if not parts:
                     continue
                 local = os.path.join(staging, *parts)
-                await self.store.fget_object(
-                    self.shared_bucket, self._shared_name(key, rel), local)
+                name = self._shared_name(key, rel)
+                src = local_path(self.shared_bucket, name) \
+                    if local_path is not None else None
+                if src is not None and await asyncio.to_thread(
+                        _materialize_linked, src, local):
+                    linked += 1
+                else:
+                    await self.store.fget_object(
+                        self.shared_bucket, name, local)
                 size += os.path.getsize(local)
             entry = await cache.insert(key, staging)
         except Exception as err:
@@ -1103,6 +1149,11 @@ class FleetPlane:
         finally:
             await asyncio.to_thread(shutil.rmtree, staging, True)
         got = entry.size if entry is not None else size
+        if record is not None:
+            # byte weight for the shared_fetch hop: coordinate() bills
+            # the seconds, this note carries the bytes, and together the
+            # ledger gets a real seconds-per-GB for peer materialization
+            record.note_hop("shared_fetch", got, 0.0)
         if record is not None:
             # provenance on the waiter's own timeline: whose origin
             # fetch (worker + trace) these bytes actually came from
@@ -1114,7 +1165,7 @@ class FleetPlane:
                 origin["originJobId"] = (manifest.get("trace")
                                          or {}).get("jobId")
             record.event("shared_origin", key=key[:16], bytes=got,
-                         **origin)
+                         linked=linked, **origin)
         self.stats["sharedHits"] += 1
         self.stats["sharedBytesIn"] += got
         if self.metrics is not None:
@@ -2108,6 +2159,9 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
     - ``openBreakers`` — per worker, with open reasons;
     - ``topHops`` — fleet seconds-per-GB per hop (summed seconds over
       summed bytes), worst three: where the fleet's gigabyte-time goes;
+    - ``cpuSPerGb`` — the fleet's staging copy cost (summed COPY_HOPS
+      seconds over the widest copy hop's bytes): the zero-copy
+      ratchet's live headline, null until enough bytes moved;
     - ``hopReconcileRatioMixed`` — summed hop seconds over summed
       stage seconds across the fleet (the soak's unguarded mixed-phase
       attribution stat, surfaced live so drift is at least visible).
@@ -2186,6 +2240,20 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
                                        or 0.0)
         except (TypeError, ValueError):
             pass
+    # fleet staging copy cost: same COPY_HOPS/widest-hop discipline as
+    # HopLedger.copy_seconds_per_gb, over the fleet-summed totals
+    from ..platform.obs import COPY_HOPS, HopLedger
+
+    copy_seconds = 0.0
+    copy_weight = 0
+    for hop, entry in hop_totals.items():
+        if hop in COPY_HOPS:
+            copy_seconds += entry["seconds"]
+            copy_weight = max(copy_weight, entry["bytes"])
+    cpu_s_per_gb = (
+        round(copy_seconds / (copy_weight / 1e9), 3)
+        if copy_weight >= HopLedger.MIN_OBSERVE_BYTES else None
+    )
     total_queued = sum(tenant_queued.values())
     tenant_shares = {
         tenant: round(depth / total_queued, 4)
@@ -2205,6 +2273,7 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
             "budget": budget,
             "openBreakers": open_breakers,
             "topHops": top_hops(hop_totals),
+            "cpuSPerGb": cpu_s_per_gb,
             "hopReconcileRatioMixed": round(
                 hop_seconds_sum / stage_seconds_sum, 4)
             if stage_seconds_sum > 0 else None,

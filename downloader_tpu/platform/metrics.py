@@ -170,6 +170,23 @@ class Metrics:
             ["hop"],
             registry=self.registry,
         )
+        self.staging_cpu_s_per_gb = Gauge(
+            f"{ns}_staging_cpu_s_per_gb",
+            "Copy-hop seconds per staged gigabyte for the most recently "
+            "settled job (summed COPY_HOPS seconds over the widest "
+            "hop's bytes) — the zero-copy staging ratchet's live "
+            "headline number",
+            registry=self.registry,
+        )
+        self.staging_hop_s_per_gb = Gauge(
+            f"{ns}_staging_hop_s_per_gb",
+            "Per-copy-hop seconds per gigabyte from the most recent "
+            "settled job that exercised the hop — max() over the hop "
+            "label is the current top offender the ratchet should "
+            "attack next",
+            ["hop"],
+            registry=self.registry,
+        )
         self.queue_wait_seconds = Histogram(
             f"{ns}_queue_wait_seconds",
             "Seconds from delivery receipt (RECEIVED) to admission "

@@ -61,6 +61,19 @@ DEFAULT_STALL_SAMPLES = 3
 PIPELINE_STAGE = "pipeline"
 
 
+# The hops that are byte-COPY work on the staging path — each staged
+# gigabyte pays each of these at most once, so their summed seconds
+# over the widest single hop's bytes is the job's staging copy cost
+# (``cpu_s_per_gb``), the number the zero-copy ratchet drives down.
+# Excluded on purpose: wait hops (``origin_wait`` — stalled, not
+# copying) and accelerator hops (``h2d``/``compute``/``d2h`` scale with
+# pixels, not staged bytes).
+COPY_HOPS = frozenset({
+    "socket_read", "splice", "disk_write", "hash", "filter",
+    "upload", "bucket_fetch", "shared_fetch", "cache",
+})
+
+
 class HopLedger:
     """Monotonic per-hop byte + time attribution for one job's transfer
     path (socket/splice read, disk write, hashing, filter, upload).
@@ -118,6 +131,32 @@ class HopLedger:
             out[hop] = entry
         return out
 
+    def copy_seconds_per_gb(self) -> "tuple[float, str] | tuple[None, None]":
+        """``(seconds_per_gb, top_hop)`` across the staging COPY_HOPS,
+        or ``(None, None)`` when too few bytes moved to mean anything.
+
+        Denominator: the WIDEST copy hop's bytes — the staged payload
+        crosses each hop once, so the widest hop is the payload size;
+        summing bytes across hops would count the same gigabyte at
+        every hop it crossed.  ``top_hop`` is the per-rate worst
+        offender among hops past the observation floor.
+        """
+        seconds = 0.0
+        weight = 0
+        top_hop, top_rate = None, -1.0
+        for hop, (nbytes, secs) in self._hops.items():
+            if hop not in COPY_HOPS:
+                continue
+            seconds += secs
+            weight = max(weight, nbytes)
+            if nbytes >= self.MIN_OBSERVE_BYTES:
+                rate = secs / (nbytes / 1e9)
+                if rate > top_rate:
+                    top_hop, top_rate = hop, rate
+        if weight < self.MIN_OBSERVE_BYTES:
+            return None, None
+        return seconds / (weight / 1e9), top_hop
+
     def observe(self, metrics) -> None:
         """Feed the job's totals into the fleet-wide hop metrics."""
         for hop, (nbytes, seconds) in self._hops.items():
@@ -129,6 +168,21 @@ class HopLedger:
                 metrics.hop_seconds_per_gb.labels(hop=hop).observe(
                     seconds / (nbytes / 1e9)
                 )
+            # per-hop copy-rate gauge (zero-copy ratchet): last settled
+            # job's s/GB per copy hop — max() over the ``hop`` label is
+            # the fleet's current top offender.  getattr-guarded so a
+            # caller wiring a pre-ratchet metrics object keeps working.
+            if (hop in COPY_HOPS and nbytes >= self.MIN_OBSERVE_BYTES
+                    and getattr(metrics, "staging_hop_s_per_gb", None)
+                    is not None):
+                metrics.staging_hop_s_per_gb.labels(hop=hop).set(
+                    seconds / (nbytes / 1e9)
+                )
+        per_gb, _top = self.copy_seconds_per_gb()
+        if (per_gb is not None
+                and getattr(metrics, "staging_cpu_s_per_gb", None)
+                is not None):
+            metrics.staging_cpu_s_per_gb.set(per_gb)
 
 
 class FlightRecorder:

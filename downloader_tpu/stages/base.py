@@ -140,6 +140,13 @@ class Job:
     # re-walking the directory tree.  None = not served from cache;
     # downstream walks as before.
     cache_files: Optional[list] = None
+    # hash-on-land (stages/download.py): ``{abspath: md5_hex}`` for files
+    # whose content digest was computed while their bytes were still hot
+    # in the page cache, at the landing/promote moment.  The upload stage
+    # passes these through to the store and the staged manifest so no
+    # later step has to re-read a staged file just to hash it.  Empty =
+    # no digest known; downstream falls back to stat-side hashing.
+    landed_digests: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import functools
+import hashlib
 import json
 import os
 import posixpath
@@ -42,6 +43,7 @@ from ..platform import faults
 from ..platform.errors import Retrier
 from ..store.cache import ContentCache, Singleflight, cache_key
 from ..utils.disk import ensure_disk_space as _ensure_disk_space
+from ..utils.hashing import md5_file_hex
 from ..utils.watchdog import STALL_TIMEOUT_SECONDS, StallWatchdog
 from .base import Job, StageContext, StageFn
 
@@ -53,6 +55,33 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 PROGRESS_INTERVAL_SECONDS = 30.0
 
 _CHUNK = 1 << 20  # 1 MiB read chunks for streaming HTTP
+
+
+class _LandHasher:
+    """Hash-on-land: inline md5 over bytes as the chunked write loop
+    lands them — integrity comes free with the copy, no second read
+    pass.  Each ``update`` is billed to the ``hash`` hop so the ledger
+    shows integrity's true CPU cost even when it rides the write loop
+    instead of a separate pass.  ``nbytes`` lets the caller prove the
+    hasher saw every byte of the final entity before trusting it (a
+    spliced or resumed landing bypasses userspace, so the hasher stays
+    short and the promote-time fallback read takes over)."""
+
+    def __init__(self, record=None):
+        self._md5 = hashlib.md5()
+        self._record = record
+        self.nbytes = 0
+
+    def update(self, data) -> None:
+        mark = time.monotonic()
+        self._md5.update(data)
+        if self._record is not None:
+            self._record.note_hop("hash", len(data),
+                                  time.monotonic() - mark)
+        self.nbytes += len(data)
+
+    def hexdigest(self) -> str:
+        return self._md5.hexdigest()
 
 # Zero-copy body landing (r5): plain-HTTP bodies with a known length
 # splice socket -> pipe -> file entirely in the kernel, skipping both
@@ -354,6 +383,22 @@ async def stage_factory(ctx: StageContext) -> StageFn:
     # shared with the orchestrator via ctx.resources
     retrier = Retrier.shared(ctx.resources, ctx.config,
                              metrics=ctx.metrics, logger=ctx.logger)
+
+    # hash-on-land (zero-copy staging ratchet): when staged-set integrity
+    # is on, the content digest is computed AT the landing moment —
+    # inline with the chunked write loop, or one hot page-cache read at
+    # promote — and carried on ``job.landed_digests`` so upload/manifest
+    # never burn a second full read pass per staged file.
+    from .manifest import integrity_enabled as _integrity_enabled
+    hash_on_land = _integrity_enabled(ctx.config)
+
+    # io_uring spike (zero-copy staging ratchet): opt-in landing of
+    # segmented chunks through a kernel submission ring instead of one
+    # pwrite syscall each.  The knob turns the probe on, the probe turns
+    # the ring on — an older kernel or seccomp-filtered container
+    # silently keeps the plain pwrite path.
+    from ..platform.config import cfg_get
+    use_io_uring = bool(cfg_get(ctx.config, "download.io_uring", False))
 
     # Parallel ranged HTTP: HTTP_SEGMENTS / instance.http_segments
     # connections per download (default 1 = the reference's single
@@ -685,6 +730,37 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             raise RuntimeError(f"unsupported Content-Encoding: {enc}")
 
         fetched = [0]  # cumulative across resume rounds, for the watchdog
+        # hash-on-land carrier: the inline hasher (if the chunked write
+        # loop ran start-to-finish) survives _fetch's return paths here
+        land_hasher: list = [None]
+
+        async def _settle_digest() -> None:
+            """Stamp ``job.landed_digests[output]`` at promote time, so
+            the upload stage and the staged manifest never re-read the
+            file just to hash it (the r3-r5 second pass).  An inline
+            hasher that provably saw every written byte is free;
+            otherwise one chunked read while the landing is still hot
+            in the page cache, billed to the ``hash`` hop."""
+            if not hash_on_land:
+                return
+            digests = getattr(job, "landed_digests", None)
+            if digests is None:
+                return  # job double without the carrier: nobody
+                # downstream could consume the digest, don't burn a pass
+            try:
+                size = os.path.getsize(output)
+            except OSError:
+                return
+            hasher = land_hasher[0]
+            if hasher is not None and hasher.nbytes == size:
+                digests[os.path.abspath(output)] = hasher.hexdigest()
+                return
+            mark = time.monotonic()
+            # graftlint: disable=second-pass-read -- the blessed landing-site hash: bytes are hot in cache and this digest retires every later re-read
+            digest = await asyncio.to_thread(md5_file_hex, output)
+            if record is not None:
+                record.note_hop("hash", size, time.monotonic() - mark)
+            digests[os.path.abspath(output)] = digest
 
         def _note_origin_wait(mark: float) -> None:
             # request -> response-headers latency: the origin's
@@ -858,7 +934,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     _cleanup()
             return total
 
-        async def _stream_body(resp, mode: str) -> int:
+        async def _stream_body(resp, mode: str, hasher=None) -> int:
             total = 0
             decoder = _decoder_for(resp)
             use_splice = decoder is None and _spliceable(resp)
@@ -896,12 +972,16 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         if record is not None:
                             record.note_hop("disk_write", len(data),
                                             time.monotonic() - write_mark)
+                        if hasher is not None:
+                            hasher.update(data)
                         total += len(data)
                     hop_mark = time.monotonic()
                 if decoder is not None:
                     tail = decoder.flush()
                     if tail:
                         fh.write(tail)
+                        if hasher is not None:
+                            hasher.update(tail)
                         total += len(tail)
             return total
 
@@ -1154,6 +1234,31 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             io_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
             loop = asyncio.get_running_loop()
 
+            # chunk landing primitive: io_uring ring when the knob AND
+            # the probe both say yes, else plain pwrite.  The ring is
+            # owned by (and only touched from) the single io_pool
+            # writer thread; any ring-side failure falls back to
+            # pwrite for that chunk (a real write error — ENOSPC,
+            # EBADF — fails identically on both paths and propagates).
+            uring_writer = None
+            if use_io_uring:
+                from ..utils import uring as _uring
+                if _uring.available():
+                    try:
+                        uring_writer = _uring.UringWriter()
+                    except (OSError, RuntimeError):
+                        uring_writer = None
+                if uring_writer is not None:
+                    logger.info("http: io_uring chunk landing engaged")
+            if uring_writer is not None:
+                def _land_chunk(fd, data, off, _w=uring_writer):
+                    try:
+                        return _w.pwrite(fd, data, off)
+                    except (OSError, RuntimeError):
+                        return os.pwrite(fd, data, off)
+            else:
+                _land_chunk = os.pwrite
+
             async def _save_state() -> None:
                 # snapshot on the loop thread (segment tasks mutate
                 # ``seg[1]`` between awaits), write in the worker
@@ -1253,7 +1358,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 data = raw[:seg[2] - seg[1]]
                                 write_mark = time.monotonic()
                                 await loop.run_in_executor(
-                                    io_pool, os.pwrite, fd, data, seg[1])
+                                    io_pool, _land_chunk, fd, data,
+                                    seg[1])
                                 if record is not None:
                                     record.note_hop(
                                         "disk_write", len(data),
@@ -1339,6 +1445,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     # is page-cache writes — the brief loop stall is
                     # confined to error teardown.
                     io_pool.shutdown(wait=True)
+                    if uring_writer is not None:
+                        uring_writer.close()
                     os.close(fd)
             os.replace(seg_partial, output)
             try:
@@ -1478,7 +1586,11 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 expected = 0
                             _ensure_disk_space(download_path, expected)
                             _write_validator(resp)
-                            await _stream_body(resp, "wb")
+                            land_hasher[0] = (
+                                _LandHasher(record) if hash_on_land
+                                else None)
+                            await _stream_body(resp, "wb",
+                                               hasher=land_hasher[0])
                             _promote()
                             return fetched[0]
                         if resp.status == 416:
@@ -1506,7 +1618,10 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         expected = 0
                     _ensure_disk_space(download_path, expected)
                     _write_validator(resp)
-                    await _stream_body(resp, "wb")
+                    land_hasher[0] = (
+                        _LandHasher(record) if hash_on_land else None)
+                    await _stream_body(resp, "wb",
+                                       hasher=land_hasher[0])
                     _promote()
                     return fetched[0]
 
@@ -1529,6 +1644,8 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         # promote time: every _fetch exit path leaves the complete entity
         # at ``output`` (fresh promote, resumed promote, or a previous
         # attempt's validated file), so this IS the file's durable moment
+        # — digest it while the bytes are hot, then announce
+        await _settle_digest()
         await _announce_file(job, output)
 
     async def manifest(resource_url: str, file_id: str,

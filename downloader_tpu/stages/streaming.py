@@ -250,7 +250,9 @@ async def run_streaming_job(ctx: StageContext, media, mirrors=(),
             if path is None:
                 return
             ctx.cancel.raise_if_cancelled()
-            await uploader.upload_file(media_id, path)
+            await uploader.upload_file(
+                media_id, path,
+                digest=job.landed_digests.get(path))
             staged[0] += 1
             await progress.note_staged(staged[0], total_known[0])
 

@@ -34,6 +34,7 @@ import os
 import posixpath
 import shutil
 import time
+from typing import Optional
 
 from .. import schemas
 from ..platform import faults
@@ -134,11 +135,13 @@ async def _already_staged(store, name: str, file_path: str, record=None,
         if not part_size:
             _bill(0)
             return None
+        # graftlint: disable=second-pass-read -- resume probe: a redelivered job has no landed digest (fresh process), so matching the store's multipart etag needs one local pass
         expected = await asyncio.to_thread(
             multipart_etag_hex, file_path, part_size
         )
         _bill(size)
         return info if info.etag == expected else None
+    # graftlint: disable=second-pass-read -- resume probe: no landed digest survives a redelivery, one pass decides skip-vs-reupload
     expected = await asyncio.to_thread(md5_file_hex, file_path)
     _bill(size)
     return info if info.etag == expected else None
@@ -249,14 +252,18 @@ class Uploader:
         """Whether the store's fput_object takes a per-part ``progress``
         callback (store/s3.py does; tests monkeypatch fput freely, so the
         probe runs per call, not at construction)."""
+        return self._put_supports("progress")
+
+    def _put_supports(self, parameter: str) -> bool:
         try:
-            return "progress" in inspect.signature(
+            return parameter in inspect.signature(
                 self.store.fput_object
             ).parameters
         except (TypeError, ValueError):
             return False
 
-    async def upload_file(self, media_id: str, file_path: str) -> int:
+    async def upload_file(self, media_id: str, file_path: str,
+                          *, digest: Optional[str] = None) -> int:
         """Stage one file; returns the bytes uploaded (0 = resume skip).
 
         Egress pacing is charged per multipart part when the store
@@ -265,6 +272,13 @@ class Uploader:
         and after the whole put otherwise.  Either way tokens are charged
         only for bytes that actually moved — no refunds on failure, and
         no up-front charge that a failed put would strand.
+
+        ``digest`` is the file's hash-on-land md5 (Job.landed_digests,
+        computed at the download landing moment).  When present it rides
+        the put as a ``content_md5`` hint for stores that take one, and —
+        for a single-part put, whose store etag IS that md5 — it settles
+        the content manifest directly, eliminating the post-put stat
+        that on a filesystem store was a full read pass per staged file.
         """
         ctx = self.ctx
         ctx.cancel.raise_if_cancelled()
@@ -326,17 +340,24 @@ class Uploader:
         # aliasing only — the path stays on disk, which the streaming
         # pipeline's post-download walk and the torrent serve path rely
         # on (store/base.py fput_object).
+        # hash-on-land hint: stores that take a ``content_md5`` seed
+        # their etag/stat path from the digest computed at the landing
+        # moment, so nothing downstream re-reads the object to hash it
+        extra = ({"content_md5": digest}
+                 if digest and self._put_supports("content_md5") else {})
+
         async def _put():
             if faults.enabled():
                 await faults.fire("store.put", key=name)
             if self._put_supports_progress():
                 await self.store.fput_object(
                     STAGING_BUCKET, name, file_path, consume=True,
-                    progress=_paced,
+                    progress=_paced, **extra,
                 )
             else:
                 await self.store.fput_object(
-                    STAGING_BUCKET, name, file_path, consume=True)
+                    STAGING_BUCKET, name, file_path, consume=True,
+                    **extra)
                 # charge AFTER the successful put: consume() deducts
                 # immediately and sleeps off the deficit, pacing the
                 # AVERAGE egress rate without hooks inside the store
@@ -355,19 +376,33 @@ class Uploader:
                                record=ctx.record, logger=self.logger)
         manifest = await self.manifest_for(media_id)
         if manifest is not None:
-            # capture the store-computed content hash of what just
-            # landed (one metadata round trip; the file itself is never
-            # re-read) — the pre-seal verification compares against THIS
-            try:
-                info = await self.store.stat_object(STAGING_BUCKET, name)
-                manifest.note(name, size=info.size, etag=info.etag,
+            threshold = getattr(self.store, "multipart_threshold", None)
+            if digest and (threshold is None or size <= threshold):
+                # hash-on-land settles the manifest directly: a
+                # single-part object's store etag IS the content md5 the
+                # download stage computed while the bytes were hot, so
+                # there is nothing left to round-trip (and on a
+                # filesystem store, nothing left to re-read)
+                manifest.note(name, size=size, etag=digest,
                               file=file_path)
-            except Exception as err:
-                # integrity is defense-in-depth: an unstattable backend
-                # degrades the verify for this file, never the upload
-                self.logger.warn("manifest stat after upload failed",
-                                 file=basename, error=str(err))
-                manifest.note(name, size=size, etag="", file=file_path)
+            else:
+                # capture the store-computed content hash of what just
+                # landed (one metadata round trip; the file itself is
+                # never re-read) — the pre-seal verification compares
+                # against THIS
+                try:
+                    info = await self.store.stat_object(STAGING_BUCKET,
+                                                        name)
+                    manifest.note(name, size=info.size, etag=info.etag,
+                                  file=file_path)
+                except Exception as err:
+                    # integrity is defense-in-depth: an unstattable
+                    # backend degrades the verify for this file, never
+                    # the upload
+                    self.logger.warn("manifest stat after upload failed",
+                                     file=basename, error=str(err))
+                    manifest.note(name, size=size, etag="",
+                                  file=file_path)
             await asyncio.to_thread(manifest.persist)
         if ctx.record is not None:
             # the put + manifest seal, as one egress hop (pacing sleeps
@@ -525,11 +560,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         with ctx.tracer.span("stage.upload", mediaId=media_id, files=len(files)):
             await uploader.ensure_bucket()
 
+            landed = getattr(job, "landed_digests", None) or {}
             for i, file_path in enumerate(files, start=1):
                 # cooperative cancellation at the per-file loop: already
                 # staged files stay staged (redelivery/resume semantics
                 # are unchanged), the current file simply never starts
-                await uploader.upload_file(media_id, file_path)
+                await uploader.upload_file(
+                    media_id, file_path,
+                    digest=landed.get(os.path.abspath(file_path)))
 
                 # upload occupies the 50-100% progress band
                 # (reference lib/upload.js:48)

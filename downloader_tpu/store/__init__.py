@@ -61,5 +61,9 @@ def new_client(config) -> ObjectStore:
             multipart_concurrency=cfg_get(
                 config, "store.multipart_concurrency", None
             ),
+            # zero-copy staging (ISSUE 19): mmap-fed multipart parts and
+            # sendfile single PUTs on plain http; off = byte-exact
+            # read() path everywhere
+            zero_copy=bool(cfg_get(config, "store.zero_copy", True)),
         )
     raise ValueError(f"unknown object-store backend {backend!r}")

@@ -9,12 +9,14 @@ a MinIO server, and for tests that want to inspect staged bytes on disk.
 from __future__ import annotations
 
 import asyncio
+import collections
+import hashlib
 import itertools
 import os
 import re
 import shutil
 import time
-from typing import AsyncIterator
+from typing import AsyncIterator, Optional
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
 from ..utils.stale import STALE_GRACE_S as _STALE_GRACE_S
@@ -99,6 +101,10 @@ class FilesystemObjectStore(ObjectStore):
     such keys from before this scheme should rename them before
     pointing this driver at it."""
 
+    # etag memo capacity: ~a day of staging churn; FIFO eviction (a miss
+    # just re-hashes, so the only cost of an eviction is one read pass)
+    _MEMO_CAP = 4096
+
     def __init__(self, root: str, link_puts: bool = True):
         self.root = os.path.abspath(root)
         self.link_puts = link_puts
@@ -107,7 +113,42 @@ class FilesystemObjectStore(ObjectStore):
         # rate-limited so a bulk ingest into one big directory pays
         # O(listdir) once per grace period, not per put (review r4)
         self._swept: dict = {}
+        # etag memo (hash-on-land): ``path -> ((size, mtime_ns, ino),
+        # md5_hex)``.  Objects are only ever replaced atomically, never
+        # edited in place, so a matching stat signature proves the bytes
+        # are the ones the memoized digest was computed over — stat_object
+        # answers without re-reading the whole object (the r3-r5 second
+        # pass).  Writers seed it: fput_object from the caller's landed
+        # digest (``content_md5``), put_object from the in-memory body.
+        self._md5_memo: "collections.OrderedDict" = collections.OrderedDict()
         os.makedirs(self.root, exist_ok=True)
+
+    def _memo_signature(self, path: str) -> Optional[tuple]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_size, st.st_mtime_ns, st.st_ino)
+
+    def _memo_store(self, path: str, md5_hex: str) -> None:
+        signature = self._memo_signature(path)
+        if signature is None:
+            return
+        self._md5_memo[path] = (signature, md5_hex)
+        self._md5_memo.move_to_end(path)
+        while len(self._md5_memo) > self._MEMO_CAP:
+            self._md5_memo.popitem(last=False)
+
+    def _memo_lookup(self, path: str) -> Optional[str]:
+        entry = self._md5_memo.get(path)
+        if entry is None:
+            return None
+        signature, md5_hex = entry
+        if signature != self._memo_signature(path):
+            # replaced since memoization (or gone): drop the stale digest
+            self._md5_memo.pop(path, None)
+            return None
+        return md5_hex
 
     def _should_sweep(self, path: str) -> bool:
         dirpath = os.path.dirname(path)
@@ -155,6 +196,9 @@ class FilesystemObjectStore(ObjectStore):
             f"{os.getpid()}.{next(self._tmp_seq)}",
             self._should_sweep(path),
         )
+        # the body is already in memory — hashing it here makes the
+        # later stat_object free instead of a full read pass
+        self._memo_store(path, hashlib.md5(data).hexdigest())
 
     async def fget_object(self, bucket: str, name: str, file_path: str,
                           *, progress=None) -> None:
@@ -168,7 +212,8 @@ class FilesystemObjectStore(ObjectStore):
                 await asyncio.to_thread(os.path.getsize, file_path))
 
     async def fput_object(self, bucket: str, name: str, file_path: str,
-                          *, consume: bool = False) -> None:
+                          *, consume: bool = False,
+                          content_md5: Optional[str] = None) -> None:
         dst = self._object_path(bucket, name)
         await asyncio.to_thread(
             _ingest_file_atomic, file_path, dst,
@@ -179,6 +224,12 @@ class FilesystemObjectStore(ObjectStore):
             f"{os.getpid()}.{next(self._tmp_seq)}",
             self._should_sweep(dst),
         )
+        if content_md5:
+            # hash-on-land hint: the caller digested these exact bytes
+            # at their landing moment (and a hardlinked ingest IS the
+            # same inode), so stat_object can answer without ever
+            # re-reading the object
+            self._memo_store(dst, content_md5)
 
     async def list_objects(self, bucket: str, prefix: str = "") -> AsyncIterator[ObjectInfo]:
         bucket_path = self._bucket_path(bucket)
@@ -215,14 +266,35 @@ class FilesystemObjectStore(ObjectStore):
 
     async def stat_object(self, bucket: str, name: str) -> ObjectInfo:
         path = self._object_path(bucket, name)
+        etag = self._memo_lookup(path)
+        if etag is not None:
+            try:
+                size = await asyncio.to_thread(os.path.getsize, path)
+            except OSError:
+                raise ObjectNotFound(bucket, name) from None
+            return ObjectInfo(name=name, size=size, etag=etag)
         try:
             size, etag = await asyncio.to_thread(_stat_with_md5, path)
         except OSError:
             raise ObjectNotFound(bucket, name) from None
+        # memoize the computed digest so the NEXT stat (manifest verify,
+        # fleet probe) is free — without this, every verify pass is a
+        # full read of every staged object
+        self._memo_store(path, etag)
         return ObjectInfo(name=name, size=size, etag=etag)
+
+    def local_object_path(self, bucket: str, name: str) -> Optional[str]:
+        """Peer hardlink tier: the object's on-disk path when it exists
+        locally, else None.  Co-located readers (fleet shared tier) may
+        hardlink/reflink it instead of streaming a copy — safe because
+        objects are only ever replaced atomically, never edited in
+        place, so an aliased inode can't see store-side writes."""
+        path = self._object_path(bucket, name)
+        return path if os.path.isfile(path) else None
 
     async def remove_object(self, bucket: str, name: str) -> None:
         path = self._object_path(bucket, name)
+        self._md5_memo.pop(path, None)
 
         def _remove() -> None:
             try:
@@ -248,6 +320,7 @@ class FilesystemObjectStore(ObjectStore):
 def _stat_with_md5(path: str) -> tuple:
     from ..utils.hashing import md5_file_hex
 
+    # graftlint: disable=second-pass-read -- the memo-miss fallback: no landed digest survived for this object (foreign writer, process restart), so one read pass re-derives it and re-seeds the memo
     return os.path.getsize(path), md5_file_hex(path)
 
 

@@ -15,11 +15,14 @@ Works against MinIO, AWS S3, GCS interop mode, or the in-repo test server
 from __future__ import annotations
 
 import asyncio
+import base64
 import datetime
 import hashlib
 import hmac
+import mmap
 import os
 import re
+import socket
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import AsyncIterator, Dict, Optional
@@ -31,6 +34,13 @@ from ..platform.errors import PERMANENT, TRANSIENT
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+# get_object / get_object_versioned are the CONTROL-plane fetch (done
+# markers, fleet manifests, coordination docs) and buffer the body in
+# memory; media-sized objects must go through the streaming
+# fget_object.  The cap turns "someone pointed the doc fetch at a
+# 40 GB object" into a loud, immediate error instead of an OOM.
+GET_OBJECT_MAX_BYTES = 64 << 20
 
 
 def _status_error(op: str, status: int, body: bytes = b"") -> RuntimeError:
@@ -152,6 +162,7 @@ class S3ObjectStore(ObjectStore):
         region: str = "us-east-1",
         multipart_part_size: Optional[int] = None,
         multipart_concurrency: Optional[int] = None,
+        zero_copy: bool = True,
     ) -> "S3ObjectStore":
         """Build from a host[:port] or full URL; an explicit scheme wins,
         otherwise ``ssl`` picks https/http."""
@@ -160,7 +171,8 @@ class S3ObjectStore(ObjectStore):
             endpoint = f"{scheme}://{endpoint}"
         return cls(endpoint, access_key, secret_key, region,
                    multipart_part_size=multipart_part_size,
-                   multipart_concurrency=multipart_concurrency)
+                   multipart_concurrency=multipart_concurrency,
+                   zero_copy=zero_copy)
 
     def __init__(
         self,
@@ -171,6 +183,7 @@ class S3ObjectStore(ObjectStore):
         session: Optional[aiohttp.ClientSession] = None,
         multipart_part_size: Optional[int] = None,
         multipart_concurrency: Optional[int] = None,
+        zero_copy: bool = True,
     ):
         self.endpoint = endpoint.rstrip("/")
         parsed = urllib.parse.urlparse(self.endpoint)
@@ -201,6 +214,15 @@ class S3ObjectStore(ObjectStore):
         self.multipart_threshold = part_size
         self.multipart_part_size = part_size
         self.multipart_concurrency = concurrency
+        # zero-copy staging (config: store.zero_copy, default on):
+        # multipart parts are fed from an mmap of the source file
+        # (UNSIGNED-PAYLOAD signing, so no hashing pass either) instead
+        # of being read into fresh userspace buffers, and — on a plain
+        # http endpoint, where the transport allows it — single PUTs and
+        # parts go out via os.sendfile so body bytes never transit
+        # userspace at all.  Off = the byte-exact read() path everywhere.
+        self.zero_copy = bool(zero_copy)
+        self._scheme = parsed.scheme or "https"
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -258,14 +280,47 @@ class S3ObjectStore(ObjectStore):
             urllib.parse.quote(part, safe="") for part in name.split("/")
         )
 
+    async def _read_capped(self, resp, op: str, bucket: str,
+                           name: str) -> bytes:
+        """Drain a GET body with a hard in-memory cap.
+
+        ``resp.read()`` buffers however much the server sends; pointing
+        the control-plane fetch at a media-sized object used to mean an
+        unbounded allocation.  Chunked accumulation up to
+        ``GET_OBJECT_MAX_BYTES`` keeps the failure mode a deterministic
+        PERMANENT error naming the streaming alternative."""
+        declared = int(resp.headers.get("Content-Length") or 0)
+        if declared > GET_OBJECT_MAX_BYTES:
+            resp.close()  # abort: draining the body is the very cost
+            err = RuntimeError(
+                f"{op}({bucket}/{name}): object is {declared} bytes, over "
+                f"the {GET_OBJECT_MAX_BYTES}-byte in-memory cap — stream "
+                "it with fget_object instead")
+            err.fault_class = PERMANENT
+            raise err
+        chunks, total = [], 0
+        async for chunk in resp.content.iter_chunked(1 << 20):
+            total += len(chunk)
+            if total > GET_OBJECT_MAX_BYTES:
+                resp.close()  # abort: draining the body is the very cost
+                err = RuntimeError(
+                    f"{op}({bucket}/{name}): body exceeded the "
+                    f"{GET_OBJECT_MAX_BYTES}-byte in-memory cap — stream "
+                    "it with fget_object instead")
+                err.fault_class = PERMANENT
+                raise err
+            chunks.append(chunk)
+        return b"".join(chunks)
+
     async def get_object(self, bucket: str, name: str) -> bytes:
         resp = await self._request("GET", self._object_path(bucket, name))
-        body = await resp.read()
         if resp.status == 404:
+            resp.release()
             raise ObjectNotFound(bucket, name)
         if resp.status != 200:
-            raise _status_error("get_object", resp.status, body)
-        return body
+            raise _status_error("get_object", resp.status,
+                                await resp.read())
+        return await self._read_capped(resp, "get_object", bucket, name)
 
     async def put_object(self, bucket: str, name: str, data: bytes) -> None:
         resp = await self._request("PUT", self._object_path(bucket, name), data=data)
@@ -275,11 +330,14 @@ class S3ObjectStore(ObjectStore):
 
     async def get_object_versioned(self, bucket: str, name: str):
         resp = await self._request("GET", self._object_path(bucket, name))
-        body = await resp.read()
         if resp.status == 404:
+            resp.release()
             raise ObjectNotFound(bucket, name)
         if resp.status != 200:
-            raise _status_error("get_object_versioned", resp.status, body)
+            raise _status_error("get_object_versioned", resp.status,
+                                await resp.read())
+        body = await self._read_capped(resp, "get_object_versioned",
+                                       bucket, name)
         return body, resp.headers.get("ETag", "").strip('"')
 
     async def put_object_cas(self, bucket: str, name: str, data: bytes, *,
@@ -350,8 +408,97 @@ class S3ObjectStore(ObjectStore):
         finally:
             resp.release()
 
+    # -- zero-copy upload transport ------------------------------------
+    def _sendfile_eligible(self) -> bool:
+        """True when PUT bodies can ride ``os.sendfile`` straight from
+        the page cache into the socket: the zero-copy knob is on, the
+        endpoint is plain http (TLS encrypts in userspace, so there is
+        nothing to splice), and the platform has sendfile at all."""
+        return (self.zero_copy and self._scheme == "http"
+                and hasattr(os, "sendfile"))
+
+    def _signed_url(self, path: str, query: Dict[str, str]) -> yarl.URL:
+        url = f"{self.endpoint}{path}"
+        if query:
+            # identical encoding to the canonical query string (and to
+            # _request): pre-encoded so yarl can't rewrite what was signed
+            url += "?" + "&".join(
+                f"{_uri_encode(k)}={_uri_encode(v)}"
+                for k, v in sorted(query.items())
+            )
+        return yarl.URL(url, encoded=True)
+
+    async def _sendfile_put(self, path: str, query: Dict[str, str],
+                            file_path: str, offset: int,
+                            count: int, extra_headers=None):
+        """One plain-HTTP PUT whose body is fed by ``os.sendfile`` —
+        file bytes go page cache -> socket without ever entering
+        userspace (the kernel half of the zero-copy upload path).
+
+        Speaks just enough HTTP/1.1 for the S3 PUT surface: one
+        request, ``Connection: close``, a status line + headers +
+        Content-Length (or EOF) delimited body back.  Returns
+        ``(status, headers_dict, body)``.  Any transport error
+        propagates — the caller falls back to the byte-exact
+        buffered path."""
+        loop = asyncio.get_running_loop()
+        headers = self._signer.sign("PUT", self._host, path, query,
+                                    "UNSIGNED-PAYLOAD")
+        # aiohttp adds these implicitly; raw HTTP must spell them out
+        # (Host is part of the signed canonical headers)
+        headers["Host"] = self._host
+        headers["Content-Length"] = str(count)
+        headers["Connection"] = "close"
+        if extra_headers:
+            headers = {**headers, **extra_headers}
+        request_uri = path
+        if query:
+            request_uri += "?" + "&".join(
+                f"{_uri_encode(k)}={_uri_encode(v)}"
+                for k, v in sorted(query.items()))
+        head = (f"PUT {request_uri} HTTP/1.1\r\n"
+                + "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+                + "\r\n").encode("ascii")
+
+        host, _, port = self._host.partition(":")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setblocking(False)
+            await loop.sock_connect(sock, (host, int(port or 80)))
+            await loop.sock_sendall(sock, head)
+            if count:
+                # graftlint: disable=blocking-call-in-async -- one open(2); the body transfer below is awaited sendfile work
+                with open(file_path, "rb") as fh:
+                    fh.seek(offset)
+                    await loop.sock_sendfile(sock, fh, offset, count,
+                                             fallback=True)
+            raw = b""
+            while b"\r\n\r\n" not in raw:
+                chunk = await loop.sock_recv(sock, 65536)
+                if not chunk:
+                    raise ConnectionError(
+                        "connection closed before response headers")
+                raw += chunk
+            head_blob, _, body = raw.partition(b"\r\n\r\n")
+            lines = head_blob.decode("latin-1").split("\r\n")
+            status = int(lines[0].split(" ", 2)[1])
+            resp_headers: Dict[str, str] = {}
+            for line in lines[1:]:
+                key, _, value = line.partition(":")
+                resp_headers[key.strip().lower()] = value.strip()
+            want = int(resp_headers.get("content-length", -1))
+            while want < 0 or len(body) < want:
+                chunk = await loop.sock_recv(sock, 65536)
+                if not chunk:
+                    break
+                body += chunk
+            return status, resp_headers, body
+        finally:
+            sock.close()
+
     async def fput_object(self, bucket: str, name: str, file_path: str,
-                          *, consume: bool = False, progress=None) -> None:
+                          *, consume: bool = False, progress=None,
+                          content_md5: Optional[str] = None) -> None:
         """Upload a file from disk.
 
         Small files go up as one streaming PUT with an UNSIGNED-PAYLOAD
@@ -361,22 +508,51 @@ class S3ObjectStore(ObjectStore):
         a media file costs one part, not the whole transfer; failures abort
         the upload server-side so no orphaned parts accrue storage.
 
+        With ``zero_copy`` on, a plain-http single PUT rides
+        ``os.sendfile`` (body bytes never enter userspace); any
+        transport hiccup falls back to the byte-exact aiohttp path.
+
         ``progress`` is an optional ``async (bytes_moved)`` callback fired
         after each part lands (once with the full size on the single-PUT
         path).  The upload stage charges its egress token bucket there, so
         pacing engages at part granularity instead of only after a whole
         multi-GB object — and only for bytes that actually moved (a part
-        charged once on success; failed attempts charge nothing)."""
+        charged once on success; failed attempts charge nothing).
+
+        ``content_md5`` (hex) is the caller's hash-on-land digest; it
+        rides the single PUT as a ``Content-MD5`` header so the server
+        verifies the body against the digest computed when the bytes
+        landed — end-to-end integrity with zero extra local reads."""
         size = os.path.getsize(file_path)
         if size > self.multipart_threshold:
             await self._multipart_upload(bucket, name, file_path, size,
                                          progress=progress)
             return
         path = self._object_path(bucket, name)
+        extra: Dict[str, str] = {}
+        if content_md5:
+            # merged after signing, like the CAS conditionals: not part
+            # of the canonical request, so the signature stays valid
+            extra["Content-MD5"] = base64.b64encode(
+                bytes.fromhex(content_md5)).decode("ascii")
+        if self._sendfile_eligible():
+            try:
+                status, _resp_headers, body = await self._sendfile_put(
+                    path, {}, file_path, 0, size, extra_headers=extra)
+                if status not in (200, 204):
+                    raise _status_error("fput_object", status, body)
+                if progress is not None:
+                    await progress(size)
+                return
+            except (OSError, ConnectionError, ValueError, IndexError):
+                # raw transport hiccup (proxy, IPv6-only host, odd
+                # server framing): the buffered path below is byte-exact
+                pass
         headers = self._signer.sign(
             "PUT", self._host, path, {}, "UNSIGNED-PAYLOAD"
         )
         headers["Content-Length"] = str(size)
+        headers.update(extra)
         session = await self._ensure_session()
 
         # graftlint: disable=blocking-call-in-async -- one open(2); aiohttp streams the fh body without slurping
@@ -439,43 +615,96 @@ class S3ObjectStore(ObjectStore):
                 pass
             raise
 
+    async def _put_part_streamed(self, path: str, query: Dict[str, str],
+                                 payload, length: int):
+        """One part PUT with UNSIGNED-PAYLOAD signing: the body (an mmap
+        memoryview slice) goes to the transport without being hashed or
+        copied into a fresh buffer first — the userspace half of the
+        zero-copy upload path."""
+        headers = self._signer.sign("PUT", self._host, path, query,
+                                    "UNSIGNED-PAYLOAD")
+        headers["Content-Length"] = str(length)
+        session = await self._ensure_session()
+        return await session.request(
+            "PUT", self._signed_url(path, query), headers=headers,
+            data=payload,
+        )
+
     async def _upload_parts(self, path: str, upload_id: str,
                             file_path: str, size: int, progress=None):
         """Upload fixed-size parts with bounded concurrency + per-part
-        retry; returns [(part_number, etag)] in order."""
+        retry; returns [(part_number, etag)] in order.
+
+        With ``zero_copy`` on, part bodies are fed from ONE shared mmap
+        of the source file — page-cache-backed slices, no per-part
+        read() into a fresh buffer, and UNSIGNED-PAYLOAD signing so no
+        per-part sha256 pass either (upload CPU stops scaling with
+        payload size).  On a plain-http endpoint each part instead rides
+        ``os.sendfile`` end to end.  Any zero-copy failure falls back to
+        the byte-exact buffered read() path for that attempt."""
         part_size = self.multipart_part_size
         part_count = (size + part_size - 1) // part_size
         sem = asyncio.Semaphore(self.multipart_concurrency)
+        use_sendfile = self._sendfile_eligible()
+
+        source_map = None
+        if self.zero_copy and not use_sendfile and size:
+            try:
+                # graftlint: disable=blocking-call-in-async -- one open(2) to seed the mmap; the part bodies stream without further reads
+                with open(file_path, "rb") as fh:
+                    # the map holds its own fd reference; pages are
+                    # clean/page-cache-backed, so queued parts pin
+                    # nothing the kernel can't reclaim
+                    source_map = mmap.mmap(fh.fileno(), 0,
+                                           access=mmap.ACCESS_READ)
+            except (OSError, ValueError):
+                source_map = None  # exotic fs: buffered fallback below
 
         def _read_region(offset: int, length: int) -> bytes:
             with open(file_path, "rb") as fh:
                 fh.seek(offset)
                 return fh.read(length)
 
+        async def _attempt_put(part_number: int, offset: int,
+                               length: int, buffered: bool):
+            query = {"partNumber": str(part_number),
+                     "uploadId": upload_id}
+            if not buffered and use_sendfile:
+                status, resp_headers, body = await self._sendfile_put(
+                    path, query, file_path, offset, length)
+                return status, resp_headers.get("etag", ""), body
+            if not buffered and source_map is not None:
+                payload = memoryview(source_map)[offset:offset + length]
+                try:
+                    resp = await self._put_part_streamed(
+                        path, query, payload, length)
+                    body = await resp.read()
+                finally:
+                    payload.release()
+                return (resp.status,
+                        resp.headers.get("ETag", ""), body)
+            # byte-exact fallback: re-read per attempt (in a thread: a
+            # 64 MiB read must not stall the event loop) — the file
+            # region is the source of truth, a shared buffer would pin
+            # memory for queued parts
+            data = await asyncio.to_thread(_read_region, offset, length)
+            resp = await self._request("PUT", path, query=query,
+                                       data=data)
+            body = await resp.read()
+            return resp.status, resp.headers.get("ETag", ""), body
+
         async def _one(part_number: int):
             offset = (part_number - 1) * part_size
             length = min(part_size, size - offset)
             async with sem:
-                # re-read per attempt (in a thread: a 64 MiB read must not
-                # stall the event loop) — the file region is the source of
-                # truth, a shared buffer would pin memory for queued parts
                 last: Optional[Exception] = None
+                buffered = False
                 for attempt in range(3):
-                    data = await asyncio.to_thread(
-                        _read_region, offset, length
-                    )
                     try:
-                        resp = await self._request(
-                            "PUT", path,
-                            query={
-                                "partNumber": str(part_number),
-                                "uploadId": upload_id,
-                            },
-                            data=data,
-                        )
-                        body = await resp.read()
-                        if resp.status == 200:
-                            etag = resp.headers.get("ETag", "").strip('"')
+                        status, etag, body = await _attempt_put(
+                            part_number, offset, length, buffered)
+                        if status == 200:
+                            etag = etag.strip('"')
                             if not etag:
                                 # fabricating a local md5 here would turn a
                                 # proxy quirk into a confusing InvalidPart
@@ -492,10 +721,16 @@ class S3ObjectStore(ObjectStore):
                                 await progress(length)
                             return part_number, etag
                         last = RuntimeError(
-                            f"part {part_number}: {resp.status} {body!r}"
+                            f"part {part_number}: {status} {body!r}"
                         )
-                    except (aiohttp.ClientError, OSError) as err:
+                    except (aiohttp.ClientError, OSError,
+                            ConnectionError, ValueError,
+                            IndexError) as err:
                         last = err
+                        # a zero-copy transport error retries on the
+                        # buffered path — correctness never depends on
+                        # the fast path working
+                        buffered = True
                     await asyncio.sleep(0.2 * (attempt + 1))
                 raise RuntimeError(
                     f"part {part_number} failed after retries: {last}"
@@ -514,6 +749,12 @@ class S3ObjectStore(ObjectStore):
                 task.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
+        finally:
+            if source_map is not None:
+                try:
+                    source_map.close()
+                except BufferError:
+                    pass  # a straggler view: dropped with the map by gc
         return sorted(results)
 
     async def stat_object(self, bucket: str, name: str) -> ObjectInfo:

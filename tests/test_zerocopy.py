@@ -1,0 +1,494 @@
+"""Zero-copy staging ratchet tests (ISSUE 19).
+
+Four disciplines, each proven byte-exact against its fallback:
+
+- mmap-fed / sendfile S3 uploads (``store.zero_copy``) vs the buffered
+  read() path — identical bytes AND identical etags (single-put md5
+  and multipart md5-of-part-md5s);
+- hash-on-land — the digest carried on ``job.landed_digests`` equals
+  an independent two-pass ``md5_file_hex``, on BOTH landing regimes
+  (kernel splice and the ``HTTP_NO_SPLICE`` chunked loop), with the
+  hop ledger proving ONE read pass per staged byte;
+- the peer hardlink shared tier — co-located fs-store materialization
+  links inodes instead of copying, and an ``EXDEV``-style link failure
+  falls back to the byte-exact ``fget_object`` stream;
+- the io_uring landing spike — probe-gated, byte-identical to pwrite.
+"""
+
+import errno
+import hashlib
+import os
+
+import pytest
+from helpers import start_http_server
+from minis3 import MiniS3
+
+from aiohttp import web
+
+from downloader_tpu import schemas
+from downloader_tpu.fleet import FleetPlane, MemoryCoordStore
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.stages.base import Job, StageContext
+from downloader_tpu.stages.download import stage_factory
+from downloader_tpu.stages.upload import STAGING_BUCKET
+from downloader_tpu.store import FilesystemObjectStore
+from downloader_tpu.store.cache import ContentCache, cache_key
+from downloader_tpu.store.s3 import S3ObjectStore
+from downloader_tpu.utils import EventEmitter
+from downloader_tpu.utils.hashing import md5_file_hex
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# mmap / sendfile upload parity (store.zero_copy A/B)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+async def server():
+    s3 = MiniS3()
+    await s3.start()
+    yield s3
+    await s3.stop()
+
+
+def _client(server, zero_copy: bool) -> S3ObjectStore:
+    return S3ObjectStore(
+        f"http://127.0.0.1:{server.port}", "AKIA", "SECRET",
+        zero_copy=zero_copy,
+    )
+
+
+async def test_multipart_mmap_vs_read_byte_exact_and_etag_equal(
+        server, tmp_path):
+    """zero_copy multipart (mmap slices / sendfile parts, unsigned
+    payload) must land the SAME bytes and the SAME multipart etag as
+    the buffered read() path."""
+    payload = bytes(range(256)) * 1024 + b"tail"  # 256 KiB + odd tail
+    src = tmp_path / "big.mkv"
+    src.write_bytes(payload)
+    etags = {}
+    for flag in (True, False):
+        client = _client(server, flag)
+        client.multipart_threshold = 1 << 16
+        client.multipart_part_size = 1 << 16
+        try:
+            if not await client.bucket_exists("staging"):
+                await client.make_bucket("staging")
+            key = f"zc/{flag}.mkv"
+            await client.fput_object("staging", key, str(src))
+            assert server.buckets["staging"][key] == payload
+            etags[flag] = (await client.stat_object("staging", key)).etag
+        finally:
+            await client.close()
+    assert not server.multipart_uploads  # both completed, none dangling
+    assert etags[True] == etags[False]
+    assert etags[True].endswith("-5")  # genuinely multipart both times
+    assert server.auth_failures == []
+
+
+async def test_single_put_sendfile_vs_read_byte_exact(server, tmp_path):
+    """Below the multipart threshold on plain http the whole PUT rides
+    os.sendfile; bytes and md5 etag must match the buffered path."""
+    payload = os.urandom(96 << 10)
+    src = tmp_path / "small.mkv"
+    src.write_bytes(payload)
+    etags = {}
+    for flag in (True, False):
+        client = _client(server, flag)
+        try:
+            if not await client.bucket_exists("staging"):
+                await client.make_bucket("staging")
+            key = f"single/{flag}.mkv"
+            await client.fput_object("staging", key, str(src))
+            assert server.buckets["staging"][key] == payload
+            etags[flag] = (await client.stat_object("staging", key)).etag
+        finally:
+            await client.close()
+    assert etags[True] == etags[False] == hashlib.md5(payload).hexdigest()
+    assert server.auth_failures == []
+
+
+async def test_fput_content_md5_hint_accepted(server, tmp_path):
+    """The landed-digest hint (hash-on-land -> Content-MD5) survives
+    SigV4 on both the sendfile and buffered paths."""
+    payload = b"landed-once" * 4096
+    src = tmp_path / "hinted.mkv"
+    src.write_bytes(payload)
+    digest = hashlib.md5(payload).hexdigest()
+    for flag in (True, False):
+        client = _client(server, flag)
+        try:
+            if not await client.bucket_exists("staging"):
+                await client.make_bucket("staging")
+            key = f"hint/{flag}.mkv"
+            await client.fput_object("staging", key, str(src),
+                                     content_md5=digest)
+            assert server.buckets["staging"][key] == payload
+            assert (await client.stat_object("staging",
+                                             key)).etag == digest
+        finally:
+            await client.close()
+    assert server.auth_failures == []
+
+
+async def test_get_object_caps_unbounded_bodies(server, monkeypatch):
+    """The in-memory GET path refuses to slurp a body past the cap
+    (PERMANENT, names fget_object) instead of ballooning the worker
+    heap.  Shrinking the module cap trips the Content-Length precheck
+    without allocating 64 MiB for real."""
+    import downloader_tpu.store.s3 as s3mod
+    from downloader_tpu.platform.errors import PERMANENT
+
+    client = _client(server, True)
+    try:
+        await client.make_bucket("b")
+        await client.put_object("b", "ok", b"x" * 1024)
+        assert await client.get_object("b", "ok") == b"x" * 1024
+        monkeypatch.setattr(s3mod, "GET_OBJECT_MAX_BYTES", 16)
+        with pytest.raises(RuntimeError, match="fget_object") as exc:
+            await client.get_object("b", "ok")
+        assert exc.value.fault_class is PERMANENT
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------------------
+# hash-on-land: one read pass per staged byte, digest identity
+# ---------------------------------------------------------------------------
+
+class _Record:
+    """Hop-ledger shaped test double for StageContext.record."""
+
+    def __init__(self):
+        self.hops = {}
+        self.events = []
+
+    def note_hop(self, hop, nbytes, seconds):
+        got = self.hops.setdefault(hop, [0, 0.0])
+        got[0] += int(nbytes)
+        got[1] += float(seconds)
+
+    def note_transfer(self, *a, **k):
+        pass
+
+    def add_bytes(self, *a, **k):
+        pass
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+async def _run_http_job(tmp_path, payload, media_id="job-z"):
+    async def serve(request):
+        return web.Response(body=payload, headers={"ETag": '"zc-1"'})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        mq = MemoryQueue(InMemoryBroker())
+        await mq.connect()
+        record = _Record()
+        ctx = StageContext(
+            config=ConfigNode({"instance": {
+                "download_path": str(tmp_path / "dl")}}),
+            emitter=EventEmitter(),
+            logger=NullLogger(),
+            telemetry=Telemetry(mq),
+            record=record,
+        )
+        stage = await stage_factory(ctx)
+        job = Job(media=schemas.Media(
+            id=media_id,
+            source=schemas.SourceType.Value("HTTP"),
+            source_uri=f"{base}/media/file.mkv",
+        ))
+        await stage(job)
+        out = tmp_path / "dl" / media_id / "file.mkv"
+        return job, record, out
+    finally:
+        await runner.cleanup()
+
+
+@pytest.mark.parametrize("no_splice", [False, True],
+                         ids=["splice", "chunked"])
+async def test_hash_on_land_digest_identity(tmp_path, monkeypatch,
+                                            no_splice):
+    """The landed digest equals an independent full re-read, on both
+    the splice landing and the HTTP_NO_SPLICE chunked loop."""
+    if no_splice:
+        monkeypatch.setenv("HTTP_NO_SPLICE", "1")
+    payload = bytes(range(256)) * 8192  # 2 MiB
+    job, record, out = await _run_http_job(tmp_path, payload)
+    assert out.read_bytes() == payload
+    digest = job.landed_digests.get(str(out))
+    assert digest == hashlib.md5(payload).hexdigest()
+    assert digest == md5_file_hex(str(out))
+    # one read pass per staged byte: the hash hop saw the file exactly
+    # once (inline on the chunked path; one hot post-promote pass on
+    # the splice path — never the historical two stat-side passes)
+    hashed = record.hops.get("hash", [0, 0.0])[0]
+    assert hashed == len(payload)
+
+
+async def test_hash_on_land_off_with_integrity_disabled(tmp_path):
+    """integrity.enabled: false restores the no-digest path (empty
+    landed_digests, no hash hop billed at the download stage)."""
+    payload = b"n" * (1 << 20)
+
+    async def serve(request):
+        return web.Response(body=payload)
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        mq = MemoryQueue(InMemoryBroker())
+        await mq.connect()
+        record = _Record()
+        ctx = StageContext(
+            config=ConfigNode({
+                "instance": {"download_path": str(tmp_path / "dl")},
+                "integrity": {"enabled": False},
+            }),
+            emitter=EventEmitter(),
+            logger=NullLogger(),
+            telemetry=Telemetry(mq),
+            record=record,
+        )
+        stage = await stage_factory(ctx)
+        job = Job(media=schemas.Media(
+            id="job-n", source=schemas.SourceType.Value("HTTP"),
+            source_uri=f"{base}/media/file.mkv",
+        ))
+        await stage(job)
+        assert job.landed_digests == {}
+        assert "hash" not in record.hops
+    finally:
+        await runner.cleanup()
+
+
+async def test_fs_store_memo_skips_rehash_after_hinted_fput(tmp_path,
+                                                           monkeypatch):
+    """fput with a content_md5 hint seeds the etag memo: the following
+    stat answers from (size, mtime, inode) without a full re-read."""
+    import downloader_tpu.store.fs as fs_mod
+
+    calls = {"n": 0}
+    real = fs_mod._stat_with_md5
+
+    def counting(path):
+        calls["n"] += 1
+        return real(path)
+
+    monkeypatch.setattr(fs_mod, "_stat_with_md5", counting)
+    store = FilesystemObjectStore(str(tmp_path / "store"))
+    payload = b"memo" * 4096
+    src = tmp_path / "src.bin"
+    src.write_bytes(payload)
+    digest = hashlib.md5(payload).hexdigest()
+    await store.make_bucket("b")
+    await store.fput_object("b", "k", str(src), content_md5=digest)
+    info = await store.stat_object("b", "k")
+    assert (info.etag, info.size) == (digest, len(payload))
+    assert calls["n"] == 0  # the hint retired the re-read
+    # an un-hinted foreign object still derives (and then memoizes)
+    (tmp_path / "src2.bin").write_bytes(b"foreign")
+    await store.fput_object("b", "k2", str(tmp_path / "src2.bin"))
+    info2 = await store.stat_object("b", "k2")
+    assert info2.etag == hashlib.md5(b"foreign").hexdigest()
+    assert calls["n"] == 1
+    await store.stat_object("b", "k2")
+    assert calls["n"] == 1  # memoized on the miss
+
+
+# ---------------------------------------------------------------------------
+# peer hardlink shared tier
+# ---------------------------------------------------------------------------
+
+PAYLOAD = b"H" * (192 << 10)
+
+
+def _fill_src(tmp_path, name="media.mkv", data=PAYLOAD):
+    src = tmp_path / "src"
+    src.mkdir(exist_ok=True)
+    (src / name).write_bytes(data)
+    return str(src)
+
+
+async def test_peer_fetch_hardlinks_colocated_fs_store(tmp_path):
+    """A co-located FilesystemObjectStore materializes by inode link —
+    zero bucket round-trip — and bills the shared_fetch hop's bytes."""
+    store = FilesystemObjectStore(str(tmp_path / "store"))
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/media.mkv", '"zc"')
+    cache_a = ContentCache(str(tmp_path / "cache-a"))
+    cache_b = ContentCache(str(tmp_path / "cache-b"))
+    plane_a = FleetPlane(MemoryCoordStore(), "wa", store=store)
+    plane_b = FleetPlane(MemoryCoordStore(), "wb", store=store)
+
+    await cache_a.insert(key, _fill_src(tmp_path))
+    assert await plane_a.publish_entry(key, cache_a)
+
+    record = _Record()
+    assert await plane_b.fetch_entry(key, cache_b, record=record)
+    entry = await cache_b.lookup(key)
+    assert entry is not None and entry.size == len(PAYLOAD)
+    # the materialized file shares the store object's inode
+    stored = store.local_object_path(
+        STAGING_BUCKET, plane_b._shared_name(key, "media.mkv"))
+    assert stored is not None
+    local = os.path.join(cache_b.entry_path(key), "media.mkv")
+    assert os.stat(local).st_ino == os.stat(stored).st_ino
+    # bytes noted on the shared_fetch hop (seconds ride the lease bill)
+    assert record.hops["shared_fetch"][0] == len(PAYLOAD)
+    # the flight-recorder origin event reports the linked count
+    kinds = {k: f for k, f in record.events}
+    assert kinds.get("shared_origin", {}).get("linked") == 1
+    # ... and serves byte-exact
+    dest = str(tmp_path / "job")
+    assert await cache_b.materialize(key, dest) == len(PAYLOAD)
+    assert open(os.path.join(dest, "media.mkv"), "rb").read() == PAYLOAD
+
+
+async def test_peer_fetch_falls_back_on_exdev(tmp_path, monkeypatch):
+    """A link failure (EXDEV: cache volume on another device) degrades
+    to the streamed fget_object copy — byte-exact, zero links."""
+    store = FilesystemObjectStore(str(tmp_path / "store"))
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/media.mkv", '"zc2"')
+    cache_a = ContentCache(str(tmp_path / "cache-a"))
+    cache_b = ContentCache(str(tmp_path / "cache-b"))
+    plane_a = FleetPlane(MemoryCoordStore(), "wa", store=store)
+    plane_b = FleetPlane(MemoryCoordStore(), "wb", store=store)
+    await cache_a.insert(key, _fill_src(tmp_path))
+    assert await plane_a.publish_entry(key, cache_a)
+
+    real_link = os.link
+
+    def exdev_link(src, dst, **kwargs):
+        if ".fleet-cache" in src.replace(os.sep, "/"):
+            raise OSError(errno.EXDEV, "cross-device link")
+        return real_link(src, dst, **kwargs)
+
+    monkeypatch.setattr(os, "link", exdev_link)
+    record = _Record()
+    assert await plane_b.fetch_entry(key, cache_b, record=record)
+    entry = await cache_b.lookup(key)
+    assert entry is not None and entry.size == len(PAYLOAD)
+    kinds = {k: f for k, f in record.events}
+    assert kinds.get("shared_origin", {}).get("linked") == 0
+    dest = str(tmp_path / "job")
+    assert await cache_b.materialize(key, dest) == len(PAYLOAD)
+    assert open(os.path.join(dest, "media.mkv"), "rb").read() == PAYLOAD
+
+
+async def test_peer_fetch_streams_from_remote_store(tmp_path):
+    """A store without local_object_path (real S3) streams exactly as
+    before the hardlink tier existed."""
+    from downloader_tpu.store import InMemoryObjectStore
+
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/media.mkv", '"zc3"')
+    cache_a = ContentCache(str(tmp_path / "cache-a"))
+    cache_b = ContentCache(str(tmp_path / "cache-b"))
+    plane_a = FleetPlane(MemoryCoordStore(), "wa", store=store)
+    plane_b = FleetPlane(MemoryCoordStore(), "wb", store=store)
+    await cache_a.insert(key, _fill_src(tmp_path))
+    assert await plane_a.publish_entry(key, cache_a)
+    assert await plane_b.fetch_entry(key, cache_b)
+    dest = str(tmp_path / "job")
+    assert await cache_b.materialize(key, dest) == len(PAYLOAD)
+    assert open(os.path.join(dest, "media.mkv"), "rb").read() == PAYLOAD
+
+
+# ---------------------------------------------------------------------------
+# io_uring landing spike
+# ---------------------------------------------------------------------------
+
+def test_uring_probe_is_a_clean_bool():
+    from downloader_tpu.utils import uring
+
+    assert uring.available() in (True, False)
+    assert uring.available() == uring.available()  # memoized
+
+
+def test_uring_pwrite_matches_os_pwrite(tmp_path):
+    from downloader_tpu.utils import uring
+
+    if not uring.available():
+        pytest.skip("io_uring unavailable (kernel/seccomp)")
+    data = os.urandom(3 << 20)
+    a = tmp_path / "uring.bin"
+    b = tmp_path / "pwrite.bin"
+    with uring.UringWriter() as writer:
+        fd = os.open(a, os.O_CREAT | os.O_WRONLY)
+        try:
+            assert writer.pwrite(fd, data, 4096) == len(data)
+            assert writer.pwrite(fd, b"head", 0) == 4
+        finally:
+            os.close(fd)
+    fd = os.open(b, os.O_CREAT | os.O_WRONLY)
+    try:
+        os.pwrite(fd, data, 4096)
+        os.pwrite(fd, b"head", 0)
+    finally:
+        os.close(fd)
+    assert a.read_bytes() == b.read_bytes()
+
+
+async def test_segmented_download_with_io_uring_knob(tmp_path,
+                                                    monkeypatch):
+    """download.io_uring lands segmented chunks through the ring (when
+    the probe allows) and the output stays byte-identical."""
+    from downloader_tpu.stages import download as dl_mod
+    from downloader_tpu.utils import uring
+
+    monkeypatch.setattr(dl_mod, "SEG_MIN_SIZE", 1 << 16)
+    monkeypatch.setenv("HTTP_SEGMENTS", "4")
+    monkeypatch.setenv("HTTP_NO_SPLICE", "1")  # force the chunk loop
+    payload = bytes(range(256)) * 4096  # 1 MiB, position-dependent
+    etag = '"seg-zc"'
+
+    async def serve(request):
+        rng = request.headers.get("Range")
+        if rng:
+            start_s, _, end_s = rng.removeprefix("bytes=").partition("-")
+            start = int(start_s)
+            end = (min(int(end_s), len(payload) - 1)
+                   if end_s else len(payload) - 1)
+            return web.Response(
+                status=206, body=payload[start:end + 1],
+                headers={"ETag": etag, "Content-Range":
+                         f"bytes {start}-{end}/{len(payload)}"})
+        return web.Response(body=payload, headers={"ETag": etag})
+
+    runner, base = await start_http_server(serve, path="/media/file.mkv")
+    try:
+        mq = MemoryQueue(InMemoryBroker())
+        await mq.connect()
+        ctx = StageContext(
+            config=ConfigNode({
+                "instance": {"download_path": str(tmp_path / "dl")},
+                "download": {"io_uring": True},
+            }),
+            emitter=EventEmitter(),
+            logger=NullLogger(),
+            telemetry=Telemetry(mq),
+        )
+        stage = await stage_factory(ctx)
+        job = Job(media=schemas.Media(
+            id="job-u", source=schemas.SourceType.Value("HTTP"),
+            source_uri=f"{base}/media/file.mkv",
+        ))
+        await stage(job)
+        out = tmp_path / "dl" / "job-u" / "file.mkv"
+        assert out.read_bytes() == payload
+        if uring.available():
+            # the landed digest doubles as the integrity check that the
+            # ring path wrote every byte where pwrite would have
+            assert job.landed_digests[str(out)] == hashlib.md5(
+                payload).hexdigest()
+    finally:
+        await runner.cleanup()
