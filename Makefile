@@ -1,7 +1,7 @@
 # Build/test entry points (reference Makefile renders CI config,
 # /root/reference/Makefile:1-7; here make drives the whole dev loop).
 
-.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-slo bench-zerocopy bench-multichip bench-incident compute-shard chaos crash degraded fleet fleet-v2 incident fuzz-scenarios obs origins slo soak soak-smoke soak-full proto lint run docker integration
+.PHONY: test bench bench-overlap bench-fleet bench-fairness bench-crash bench-obs bench-racing bench-soak bench-degraded bench-disk bench-slo bench-zerocopy bench-multichip bench-incident compute-shard chaos crash degraded disk fleet fleet-v2 incident fuzz-scenarios obs origins scrub slo soak soak-smoke soak-full proto lint run docker integration
 
 # hermetic gate: never touches localhost services, even when something
 # happens to be listening on 5672/9000
@@ -35,6 +35,22 @@ crash:
 # a real 2-worker subprocess fleet)
 degraded:
 	python -m pytest tests/test_degraded.py -v
+
+# storage fault plane suite (ISSUE 20): the disk fault kind + VFS shim
+# (ENOSPC/EIO/short/latency/torn at the landing, spill, promote and
+# sidecar seams), fsync-before-rename crash consistency + boot-time
+# torn-tail demotion, the background scrubber (clean/repair/quarantine,
+# copy-on-repair fresh inodes for hardlinked entries), and disk-full
+# graceful degradation (workdir free-space admission floors, the disk
+# breaker force-open, BULK shed via cache_headroom_bytes)
+disk:
+	python -m pytest tests/test_disk.py -v
+
+# one full scrub pass over the local store, from the CLI (point
+# DOWNLOADER_CONFIG at the instance config first; repairs pull from the
+# shared tier, mismatches without a healthy replica are quarantined)
+scrub:
+	python -m downloader_tpu.cli scrub
 
 # multi-worker fleet suite: coordination-store semantics, N-orchestrator
 # coalescing over MiniS3, lease takeover, coord-store chaos
@@ -185,6 +201,14 @@ bench-soak:
 # split_brain_stale_writes must stay 0)
 bench-degraded:
 	python bench.py --degraded
+
+# standalone storage-fault-plane bench (one JSON line: disk_ok = every
+# SLO guard green under the windowed ENOSPC brownout — including zero
+# corrupt bytes served — AND the scrubber repaired every seeded bit-rot
+# flip with zero quarantines; disk_scrub_repaired /
+# disk_scrub_quarantined / disk_corrupt_bytes_served alongside)
+bench-disk:
+	python bench.py --disk
 
 # standalone SLO-plane bench (one JSON line: slo_overhead_ms must stay
 # < 1 ms/job; fleet_overview_age_s must sit under 2x the heartbeat

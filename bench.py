@@ -220,7 +220,17 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #  budget: hardlink tier on a co-located fs store drives it toward
 #  zero), and `--zerocopy` A/Bs the whole staging pipeline's
 #  cpu_s_per_gb with the store's zero-copy upload path on vs off.
-HARNESS_VERSION = 24
+#
+# v25 (ISSUE 20 storage fault plane): new ``--disk`` section
+#  (`make bench-disk`): the disk soak profile runs a windowed transient
+#  ENOSPC brownout on the landing write seam, then seeds bit-rot into
+#  private cache inodes of shared-replicated keys and waits for the
+#  background scrubber to repair them.  disk_ok = every SLO guard green
+#  (including the exact-zero staged_byte_mismatches guard — zero
+#  corrupt bytes served) AND scrub repaired count == seeded corruption
+#  count AND zero quarantines; disk_scrub_repaired /
+#  disk_scrub_quarantined / disk_corrupt_bytes_served ride along.
+HARNESS_VERSION = 25
 
 # Self-baseline (MB/s): the round-1 number measured with the v2 harness
 # (sendfile fixture server, best-of-5) — BENCH_r01.json.
@@ -2747,6 +2757,69 @@ def _bench_degraded_safe() -> dict:
         }
 
 
+async def bench_disk() -> dict:
+    """Storage fault plane soak metrics (harness v25, ISSUE 20).
+
+    Runs the disk profile of the soak rig: a windowed transient ENOSPC
+    brownout on the landing write seam while the mixed workload runs,
+    then — once jobs settle — seeded bit-rot (byte flips in private
+    cache inodes of keys with a live shared-tier replica) that the
+    background scrubber must detect and repair before a wall deadline.
+    The headline guards are the ISSUE 20 acceptance triple: every job
+    settles (``report.ok`` — which folds in the exact-zero
+    ``staged_byte_mismatches`` guard, i.e. zero corrupt bytes ever
+    served), scrub repaired count == seeded corruption count, and zero
+    quarantines (every seeded flip had a healthy replica, so repair —
+    not quarantine — is the only acceptable outcome).
+    """
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from test_soak import SoakTestWorld
+
+    from downloader_tpu.soak import SoakProfile
+
+    profile = SoakProfile.disk()
+    with tempfile.TemporaryDirectory() as tmp:
+        world = await SoakTestWorld.create(tmp, profile)
+        try:
+            report = await world.rig.run(world.workload)
+            seeded = len(world.rig.seeded_corruptions)
+            base = world.rig.scrub_base
+            final = world.rig.scrub_final
+            stale = len(world.rig.world.byte_mismatches
+                        if world.rig.world else [])
+        finally:
+            await world.close()
+    repaired = final.get("repaired", 0) - base.get("repaired", 0)
+    quarantined = (final.get("quarantined", 0)
+                   - base.get("quarantined", 0))
+    out = {
+        "disk_ok": bool(report.ok and seeded > 0
+                        and repaired == seeded and quarantined == 0
+                        and stale == 0),
+        "disk_seeded_corruptions": seeded,
+        "disk_scrub_repaired": repaired,
+        "disk_scrub_quarantined": quarantined,
+        "disk_scrub_passes": final.get("passes", 0),
+        "disk_corrupt_bytes_served": stale,
+        "disk_jobs": int(report.stats.get("jobs", 0)),
+        "disk_wall_s": report.stats.get("wall_s", 0.0),
+    }
+    if not report.ok:
+        out["disk_failed_guards"] = [g.name for g in report.failures()]
+    return out
+
+
+def _bench_disk_safe() -> dict:
+    """A disk-bench failure must not discard the primary metric."""
+    try:
+        return asyncio.run(bench_disk())
+    except Exception as err:
+        return {"disk_bench_error": f"{type(err).__name__}: {err}"[:200]}
 
 
 async def bench_incident() -> dict:
@@ -3468,6 +3541,10 @@ def main() -> None:
         # standalone degraded-world soak run (`make bench-degraded`)
         print(json.dumps(_bench_degraded_safe()))
         return
+    if "--disk" in sys.argv:
+        # standalone storage-fault-plane run (`make bench-disk`)
+        print(json.dumps(_bench_disk_safe()))
+        return
     if "--incident" in sys.argv:
         # standalone incident round-trip run (`make bench-incident`)
         print(json.dumps(_bench_incident_safe()))
@@ -3515,6 +3592,7 @@ def main() -> None:
         **_bench_racing_safe(),
         **_bench_soak_safe(),
         **_bench_degraded_safe(),
+        **_bench_disk_safe(),
         **_bench_incident_safe(),
         **_bench_slo_safe(),
         **_bench_zerocopy_safe(reps=1),

@@ -72,7 +72,7 @@ CONFIG_SECTIONS = frozenset({
     "instance", "minio", "rabbitmq", "services", "store", "tracing",
     "health", "control", "retry", "breakers", "faults", "tenants",
     "overload", "origins", "fleet", "journal", "integrity", "obs",
-    "wire_remap", "slo", "incident", "download",
+    "wire_remap", "slo", "incident", "download", "scrub",
 })
 
 #: documented knobs that are deliberately not read via cfg_get /
@@ -424,16 +424,16 @@ KNOWN_DEPENDENCIES = frozenset({
 })
 
 #: families exempt from the WINDOWED-drillability requirement (every
-#: other family must carry at least one async ``faults.fire`` hook so
-#: the windowed kinds — brownout latency, blackhole partitions — can
+#: family must carry at least one async ``faults.fire`` hook so the
+#: windowed kinds — brownout latency, blackhole partitions — can
 #: inject; ``fire_sync`` cannot sleep without stalling the event
-#: loop).  Each entry names why the exemption is sound, so a new
-#: sync-only family is a finding, not a silent gap.
-WINDOWED_EXEMPT: Dict[str, str] = {
-    "disk": "synchronous preflight seam (utils/disk.py) — a blocking "
-            "brownout sleep would stall the event loop; local-disk "
-            "latency drills ride the async store family instead",
-}
+#: loop).  EMPTY since the storage fault plane landed: ``disk`` — the
+#: last holdout — now carries the async ``disk.land`` hook in the
+#: landing loop (stages/download.py) plus thread-side latency drills
+#: through the vfs shim, so every dependency family is windowed-
+#: drillable.  A new sync-only family is a finding, not a silent gap;
+#: adding an entry here requires naming why the exemption is sound.
+WINDOWED_EXEMPT: Dict[str, str] = {}
 
 
 def _seam_dependency(seam: str) -> str:

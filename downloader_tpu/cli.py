@@ -17,6 +17,7 @@ by hand.  This closes that gap:
     python -m downloader_tpu.cli fleet list|show WORKER [--url ...]
     python -m downloader_tpu.cli tenants [--url ...] [--json]
     python -m downloader_tpu.cli debug tasks|stacks [--url ...]
+    python -m downloader_tpu.cli scrub [--json] [--local-only]
     python -m downloader_tpu.cli watch [--id my-movie]
     python -m downloader_tpu.cli upscale in.y4m out.y4m [--checkpoint-dir D]
     python -m downloader_tpu.cli train --data media/ --steps 500 \
@@ -348,6 +349,19 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     debug_stacks.add_argument("--url", default="http://127.0.0.1:3401",
                               help="service base URL")
+
+    scrub = sub.add_parser(
+        "scrub", help="run one integrity scrub pass over the local store "
+                      "(cache entries, co-located shared tier, staged "
+                      "workdir outputs) and print the verdict counts"
+    )
+    scrub.add_argument("--json", action="store_true", dest="as_json",
+                       help="machine-readable verdict counts")
+    scrub.add_argument(
+        "--local-only", action="store_true",
+        help="skip the shared tier entirely: no shared-tier scan and no "
+             "repairs from it (mismatched cache entries quarantine "
+             "instead); use when the store is unreachable from here")
 
     watch = sub.add_parser(
         "watch", help="tail job status/progress telemetry from the queue"
@@ -762,6 +776,12 @@ def render_overview(body: dict) -> list:
     ratio = totals.get("hopReconcileRatioMixed")
     if ratio is not None:
         lines.append(f"hop/stage reconcile (mixed, unguarded): {ratio}")
+    scrub = totals.get("scrub") or {}
+    if any(scrub.get(k) for k in ("clean", "repaired", "quarantined")):
+        lines.append(
+            f"scrub: clean={scrub.get('clean', 0)} "
+            f"repaired={scrub.get('repaired', 0)} "
+            f"quarantined={scrub.get('quarantined', 0)}")
     if isinstance(plan, dict):
         admission = plan.get("admission") or {}
         shed = ("SHED BULK (" + str(admission.get("reason") or "") + ")"
@@ -1219,6 +1239,71 @@ async def _debug(args) -> int:
     return 0
 
 
+async def _scrub(args) -> int:
+    """One synchronous scrub pass, in-process (no running service).
+
+    Builds the same cache/fleet/workdir trio the orchestrator hands its
+    background scrubber and runs a single ``scan()`` — so an operator
+    can force a full integrity pass (post-incident, after swapping a
+    disk) without waiting out ``scrub.interval``, including against a
+    stopped instance.  ``scrub.enabled: false`` only removes the
+    BACKGROUND loop; an explicit invocation always runs.  Exit 0 when
+    nothing was quarantined (clean or repaired are both fine), 1 when
+    something was (bytes lost their last healthy replica — page on it),
+    2 when the shared tier is unreachable and ``--local-only`` wasn't
+    given (refusing to quarantine entries a reachable tier would have
+    repaired).
+    """
+    import json
+
+    from .fleet.plane import FleetPlane, resolve_worker_id
+    from .platform.config import cfg_get
+    from .stages.download import job_download_dir
+    from .store import new_client
+    from .store.cache import ContentCache
+    from .store.scrub import (DEFAULT_INTERVAL, DEFAULT_RATE_MB_S,
+                              Scrubber)
+
+    config = load_config("converter")
+    logger = get_logger("downloader-scrub")
+    cache = ContentCache.from_config(config, logger=logger)
+    fleet = None
+    if not args.local_only:
+        try:
+            fleet = FleetPlane.from_config(
+                config, worker_id=resolve_worker_id(config),
+                store=new_client(config), logger=logger,
+            )
+        except Exception as err:
+            print(
+                f"shared tier unavailable ({type(err).__name__}: {err}); "
+                "re-run with --local-only to scrub without repairs",
+                file=sys.stderr,
+            )
+            return 2
+    scrubber = Scrubber(
+        cache=cache, fleet=fleet,
+        workdir_root=os.path.dirname(job_download_dir(config, "_probe")),
+        quarantine_dir=cfg_get(config, "scrub.quarantine_dir", None),
+        interval=float(cfg_get(config, "scrub.interval",
+                               DEFAULT_INTERVAL)),
+        rate_bytes=float(cfg_get(config, "scrub.rate_mb_s",
+                                 DEFAULT_RATE_MB_S)) * 1e6,
+        logger=logger,
+    )
+    counts = await scrubber.scan()
+    snap = scrubber.snapshot()
+    if args.as_json:
+        print(json.dumps({**counts,
+                          "passSeconds": snap.get("lastPassSeconds")}))
+    else:
+        print(f"scrub pass complete: clean={counts['clean']} "
+              f"repaired={counts['repaired']} "
+              f"quarantined={counts['quarantined']} "
+              f"({snap.get('lastPassSeconds', 0.0)}s)")
+    return 0 if counts["quarantined"] == 0 else 1
+
+
 async def _watch(args) -> int:
     from .mq import new_queue, resolve_backend
 
@@ -1430,6 +1515,8 @@ def main(argv=None) -> int:
         return asyncio.run(_incident(args))
     if args.command == "debug":
         return asyncio.run(_debug(args))
+    if args.command == "scrub":
+        return asyncio.run(_scrub(args))
     if args.command == "watch":
         return asyncio.run(_watch(args))
     if args.command == "upscale":

@@ -69,6 +69,7 @@ from ..platform.config import cfg_get
 from ..platform.tracing import parse_traceparent
 from ..stages.upload import STAGING_BUCKET
 from ..store.base import ObjectNotFound
+from ..utils.hashing import md5_file_hex
 from .coord import (ABSENT, ANY, BucketCoordStore, CasBucketCoordStore,
                     CoordError, CoordStore, CoordWatch, MemoryCoordStore)
 
@@ -312,6 +313,7 @@ class FleetPlane:
             "leasesLed": 0, "leaseWaits": 0, "leaseTakeovers": 0,
             "sharedHits": 0, "sharedFills": 0,
             "sharedBytesIn": 0, "sharedBytesOut": 0,
+            "sharedCorrupt": 0,
             "coordErrors": 0, "uncoordinatedFallbacks": 0,
             "gcSharedEvicted": 0, "gcTombstonesCompacted": 0,
             "gcBytesReclaimed": 0,
@@ -954,6 +956,11 @@ class FleetPlane:
             return posixpath.join(self.shared_prefix + key, "files", rel)
         return posixpath.join(self.shared_prefix + key, MANIFEST_NAME)
 
+    def shared_name(self, key: str, rel: str = "") -> str:
+        """Public object-name resolver for external walkers (the
+        integrity scrubber re-hashes shared-tier payloads by name)."""
+        return self._shared_name(key, rel)
+
     async def publish_entry(self, key: str, cache,
                             trace: Optional[dict] = None,
                             fence: Optional[int] = None) -> bool:
@@ -1023,6 +1030,12 @@ class FleetPlane:
                     "worker": self.worker_id,
                     "created": round(time.time(), 3),
                 }
+                if getattr(entry, "digests", None):
+                    # per-file landing digests: fetchers verify BEFORE
+                    # serving (a corrupt leader copy must not hand out
+                    # bytes — or its inode), and the scrubber re-walks
+                    # these forever
+                    manifest["digests"] = dict(entry.digests)
                 if fence is not None:
                     # the writer's authority, carried on the document
                     # so any reader (and the read-back below) can
@@ -1123,6 +1136,9 @@ class FleetPlane:
                 # the byte-exact fallback
                 return False
 
+        digests = manifest.get("digests")
+        if not isinstance(digests, dict):
+            digests = {}
         try:
             size = 0
             linked = 0
@@ -1135,14 +1151,39 @@ class FleetPlane:
                 name = self._shared_name(key, rel)
                 src = local_path(self.shared_bucket, name) \
                     if local_path is not None else None
-                if src is not None and await asyncio.to_thread(
-                        _materialize_linked, src, local):
+                used_link = bool(
+                    src is not None and await asyncio.to_thread(
+                        _materialize_linked, src, local))
+                if used_link:
                     linked += 1
                 else:
                     await self.store.fget_object(
                         self.shared_bucket, name, local)
+                want = digests.get(rel)
+                if want is not None:
+                    # integrity gate BEFORE the bytes become servable
+                    # (and before cache.insert can hardlink them into
+                    # workdirs): a corrupt leader copy falls back to
+                    # the origin path, it never hands out its inode
+                    mark = time.monotonic()
+                    got_md5 = await asyncio.to_thread(md5_file_hex,
+                                                      local)
+                    if record is not None:
+                        record.note_hop("hash", os.path.getsize(local),
+                                        time.monotonic() - mark)
+                    if got_md5 != want:
+                        self.stats["sharedCorrupt"] += 1
+                        if record is not None:
+                            record.event("shared_corrupt", key=key[:16],
+                                         rel=rel, linked=used_link)
+                        if self.logger is not None:
+                            self.logger.warn(
+                                "fleet: shared-tier entry failed digest "
+                                "verification, falling back to origin",
+                                key=key[:16], rel=rel, linked=used_link)
+                        return False
                 size += os.path.getsize(local)
-            entry = await cache.insert(key, staging)
+            entry = await cache.insert(key, staging, digests=digests)
         except Exception as err:
             self._note_coord_error("shared_fetch", err)
             return False
@@ -2164,7 +2205,10 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
       ratchet's live headline, null until enough bytes moved;
     - ``hopReconcileRatioMixed`` — summed hop seconds over summed
       stage seconds across the fleet (the soak's unguarded mixed-phase
-      attribution stat, surfaced live so drift is at least visible).
+      attribution stat, surfaced live so drift is at least visible);
+    - ``scrub`` — summed integrity-scrubber verdict counters
+      (clean/repaired/quarantined) across the fleet: repaired/
+      quarantined climbing is a disk going bad somewhere.
     """
     from ..control.slo import top_hops
 
@@ -2178,6 +2222,7 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
     active_jobs = 0
     hop_seconds_sum = 0.0
     stage_seconds_sum = 0.0
+    scrub_totals = {"clean": 0, "repaired": 0, "quarantined": 0}
     for doc in workers:
         wid = doc.get("workerId")
         signals = doc.get("signals")
@@ -2222,6 +2267,14 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
                                          + int(depth))
             except (TypeError, ValueError):
                 pass
+        scrub_doc = digest.get("scrub")
+        if isinstance(scrub_doc, dict):
+            for outcome in ("clean", "repaired", "quarantined"):
+                try:
+                    scrub_totals[outcome] += int(
+                        scrub_doc.get(outcome, 0) or 0)
+                except (TypeError, ValueError):
+                    pass
         for hop, entry in (digest.get("hops") or {}).items():
             if not isinstance(entry, dict):
                 continue
@@ -2274,6 +2327,7 @@ def build_overview(worker_id: str, workers: List[dict]) -> dict:
             "openBreakers": open_breakers,
             "topHops": top_hops(hop_totals),
             "cpuSPerGb": cpu_s_per_gb,
+            "scrub": scrub_totals,
             "hopReconcileRatioMixed": round(
                 hop_seconds_sum / stage_seconds_sum, 4)
             if stage_seconds_sum > 0 else None,

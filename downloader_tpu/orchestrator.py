@@ -50,8 +50,8 @@ from .incident.bundle import TRIGGER_BREACH, IncidentStore, build_bundle
 from .mq.base import Delivery, MessageQueue
 from .platform import faults
 from .platform.config import cfg_get
-from .platform.errors import (PERMANENT, POISON, BreakerBoard, Retrier,
-                              classify)
+from .platform.errors import (OPEN_DISK, PERMANENT, POISON, BreakerBoard,
+                              Retrier, classify)
 from .platform.faults import FaultInjector
 from .platform.logging import Logger, get_logger
 from .platform.metrics import Metrics
@@ -68,6 +68,7 @@ from .stages.streaming import (PIPELINE_STAGE, pipeline_mode,
 from .stages.upload import STAGING_BUCKET, done_marker_name
 from .store.base import ObjectNotFound, ObjectStore
 from .store.cache import ContentCache
+from .store.scrub import Scrubber, verify_landed
 from .utils import EventEmitter, utcnow_iso as _utcnow_iso
 
 
@@ -297,6 +298,19 @@ class Orchestrator:
         # headroom before letting it proceed (the download stage's own
         # ensure_disk_space preflight still fails loudly if truly full)
         self.admission_timeout = admission_timeout
+        # disk-full graceful degradation (ISSUE 20): the cache's
+        # min_free_bytes discipline extended to the WORKDIR volume —
+        # ``download.min_free_bytes`` is the free-space floor admission
+        # holds for, ``download.reserve_bytes`` a per-job preflight
+        # reservation on top of it.  Both default 0 = off (exactly the
+        # prior behavior).  A deadline-forced admission that still
+        # fails the workdir floor force-opens the store breaker with
+        # the ``disk`` reason (surfaced on /readyz + the fleet
+        # overview), because eviction cannot reclaim workdir space.
+        self.workdir_min_free = int(cfg_get(
+            config, "download.min_free_bytes", 0))
+        self.workdir_reserve = int(cfg_get(
+            config, "download.reserve_bytes", 0))
 
         # (reference EmitterTable / activeJobs, lib/main.js:26,34)
         self.emitter_table: Dict[str, EventEmitter] = {}
@@ -398,6 +412,18 @@ class Orchestrator:
             lambda: getattr(self.loop_monitor, "last_lag", None),
             metrics=metrics, logger=self.logger,
         )
+        # integrity scrubber (store/scrub.py): rate-limited background
+        # re-hash of cache entries, co-located shared-tier objects, and
+        # staged workdir outputs against their landing digests —
+        # repairing from healthy replicas (always into a fresh inode)
+        # and quarantining the rest.  ``scrub.enabled: false`` removes
+        # it; its cumulative verdicts ride the SLO digest onto the
+        # fleet overview.
+        self.scrubber = Scrubber.from_config(
+            config, cache=self.cache, fleet=self.fleet,
+            workdir_root=self._download_root,
+            metrics=metrics, logger=self.logger,
+        )
         # autoscale signal trio on /metrics: the same snapshot the fleet
         # heartbeat carries (ROADMAP item 5's fleet-facing contract)
         if metrics is not None:
@@ -486,6 +512,8 @@ class Orchestrator:
                 .create_task(self._staged_probe_loop())
         if self.overload is not None:
             self.overload.start()
+        if self.scrubber is not None:
+            self.scrubber.start()
         if self.fleet is not None:
             # join the fleet LAST: by the time peers can route around or
             # toward this worker, it is actually consuming
@@ -515,29 +543,44 @@ class Orchestrator:
                 self.controller.start()
         self.logger.info("successfully connected to queue")
 
+    def _workdir_free_bytes(self) -> Optional[int]:
+        """Free bytes on the workdir (download-root) volume, probed at
+        the deepest existing ancestor; None when unprobeable — the
+        disk gates then stand down rather than block on a blind
+        spot."""
+        from .utils.disk import free_bytes
+
+        path = self._download_root
+        while path and not os.path.isdir(path):
+            parent = os.path.dirname(path)
+            if parent == path:
+                break
+            path = parent
+        try:
+            return free_bytes(path or os.sep)
+        except OSError:
+            return None
+
     # -- autoscale signals ----------------------------------------------
     def autoscale_signals(self) -> dict:
         """The scale-out/scale-down trio, one snapshot for BOTH surfaces
         (/metrics gauges and the fleet heartbeat payload): queue depth,
-        oldest-queued-job age, and disk headroom on the volume jobs
-        land on (cache volume when caching, download volume otherwise).
+        oldest-queued-job age, and disk headroom on the volumes jobs
+        land on (the TIGHTER of cache and workdir volumes when
+        caching, the download volume otherwise).
         """
         depth, oldest = self.registry.queued_snapshot()
+        workdir_free = self._workdir_free_bytes()
         if self.cache is not None:
+            # tightest volume wins: the cache may live on a different
+            # volume than the workdirs, and a full WORKDIR volume kills
+            # jobs just as surely (the overload controller's
+            # disk_headroom shed watches exactly this signal)
             headroom = self.cache.free_disk_bytes()
+            if workdir_free is not None:
+                headroom = min(headroom, workdir_free)
         else:
-            from .utils.disk import free_bytes
-
-            path = job_download_dir(self.config, "_probe")
-            while path and not os.path.isdir(path):
-                parent = os.path.dirname(path)
-                if parent == path:
-                    break
-                path = parent
-            try:
-                headroom = free_bytes(path or os.sep)
-            except OSError:
-                headroom = 0
+            headroom = workdir_free if workdir_free is not None else 0
         return {
             "queue_depth": depth,
             "oldest_queued_seconds": round(oldest, 3),
@@ -575,6 +618,12 @@ class Orchestrator:
             # this worker's last routing action (defer/shed/fairness):
             # the DECISION column on the overview doc / `fleet top`
             digest["lastDecision"] = dict(router.last)
+        scrubber = getattr(self, "scrubber", None)
+        if scrubber is not None:
+            # cumulative scrub verdicts: build_overview sums these
+            # fleet-wide (repaired/quarantined climbing = a disk going
+            # bad somewhere in the fleet)
+            digest["scrub"] = scrubber.snapshot()
         return digest
 
     async def assemble_trace(self, trace_id: str,
@@ -734,7 +783,7 @@ class Orchestrator:
             self._recovery_watchers.append(watcher)
             if self.metrics is not None:
                 self.metrics.jobs_recovered.labels(outcome="replayed").inc()
-        swept, resumed = await asyncio.to_thread(
+        swept, resumed, demoted = await asyncio.to_thread(
             self._sweep_workdirs,
             # cancelled tombstones are never resumable (their workdir,
             # if the kill beat the cancel's own rmtree, is an orphan),
@@ -751,6 +800,9 @@ class Orchestrator:
             if resumed:
                 self.metrics.jobs_recovered.labels(
                     outcome="resumable").inc(resumed)
+            if demoted:
+                self.metrics.jobs_recovered.labels(
+                    outcome="demoted").inc(demoted)
         # compact now that the history is replayed: the journal restarts
         # as one snapshot line of the still-live jobs (self-replaying,
         # so the placeholder lines just appended are part of the basis)
@@ -769,6 +821,7 @@ class Orchestrator:
             "restoredRetryCounters": restored,
             "sweptWorkdirs": swept,
             "resumableWorkdirs": resumed,
+            "demotedOutputs": demoted,
             "tornJournalLines": state.torn_lines,
             "reclaimedLeases": leases_reclaimed,
             "at": _utcnow_iso(),
@@ -776,19 +829,24 @@ class Orchestrator:
         if live or swept or state.torn_lines:
             self.logger.info("crash recovery complete", **self.recovery)
 
-    def _sweep_workdirs(self, live_ids: set) -> "tuple[int, int]":
+    def _sweep_workdirs(self, live_ids: set) -> "tuple[int, int, int]":
         """Reconcile the download root against the journal (thread-side).
 
         A workdir whose job still expects a redelivery is KEPT — its
         ``.partial``/piece state is content-keyed (validators in
         ``.partial.meta``, SHA-1 piece hashes) so the resumed attempt
-        pays only the missing bytes.  Everything else — ack-settled
-        terminal jobs, dirs the journal has never heard of — is an
-        orphan and is deleted: the journal is authoritative for this
-        root (dot-dirs, including the journal's own, are skipped).
-        Returns ``(swept, resumed)`` counts.
+        pays only the missing bytes.  Its PROMOTED outputs, though,
+        are re-verified against the landing recovery sidecar
+        (store/scrub.py): a digest mismatch is the torn-tail crash —
+        the rename outlived the data pages — and the output is
+        DEMOTED (deleted) so the redelivered job re-fetches instead of
+        serving the hole.  Everything else — ack-settled terminal
+        jobs, dirs the journal has never heard of — is an orphan and
+        is deleted: the journal is authoritative for this root
+        (dot-dirs, including the journal's own, are skipped).  Returns
+        ``(swept, resumed, demoted)`` counts.
         """
-        swept = resumed = 0
+        swept = resumed = demoted = 0
         # service dirs that legitimately live under the download root but
         # are NOT job workdirs: the journal's own dir and a configured
         # content cache (CACHE_DIR/instance.cache.path may point a
@@ -816,6 +874,13 @@ class Orchestrator:
                 if os.path.realpath(entry.path) in protected:
                     continue
                 if entry.name in live_ids:
+                    verified, torn = verify_landed(entry.path)
+                    if torn:
+                        demoted += torn
+                        self.logger.warn(
+                            "boot recovery: demoted torn outputs for "
+                            "re-fetch", workdir=entry.path,
+                            demoted=torn, verified=verified)
                     resumed += 1
                     continue
                 try:
@@ -824,7 +889,7 @@ class Orchestrator:
                 except OSError as err:
                     self.logger.warn("orphan workdir sweep failed",
                                      path=entry.path, error=str(err))
-        return swept, resumed
+        return swept, resumed, demoted
 
     async def _watch_recovered(self, record: JobRecord) -> None:
         """Settle a recovered placeholder that is cancelled before its
@@ -1072,6 +1137,8 @@ class Orchestrator:
         await self.loop_monitor.stop()
         if self.overload is not None:
             await self.overload.stop()
+        if self.scrubber is not None:
+            await self.scrubber.stop()
         if self.controller is not None:
             # stop planning before leaving the fleet: a departing
             # worker must not publish a plan mid-deregistration
@@ -1432,44 +1499,75 @@ class Orchestrator:
 
     async def _admit_job(self, logger: Logger,
                          record: Optional[JobRecord] = None) -> None:
-        """Gate job start on cache-volume disk headroom.
+        """Gate job start on disk headroom.
 
-        No cache -> no gate (the download stage's ensure_disk_space
-        preflight is then the only guard, as before).  With a cache, the
-        order is: evict LRU entries first (cached bytes are the one
-        reclaimable resource), then wait for running jobs to release
-        space, then — after ``admission_timeout`` — proceed anyway and
-        let the preflight make the loud per-job call.
+        Two floors: the cache volume's ``min_free_bytes`` (when
+        caching, as before) and the WORKDIR volume's
+        ``download.min_free_bytes`` plus the per-job
+        ``download.reserve_bytes`` space reservation (when configured
+        — both default off).  The order is: evict LRU cache entries
+        first (cached bytes are the one reclaimable resource), then
+        wait for running jobs to release space, then — after
+        ``admission_timeout`` — proceed anyway and let the download
+        stage's preflight make the loud per-job call.  A forced
+        admission that still fails the WORKDIR floor additionally
+        force-opens the store breaker with the ``disk`` reason
+        (eviction cannot reclaim workdir space, so this worker is
+        degraded until the volume drains): /readyz and the fleet
+        overview surface it, and follow-on deliveries park on the
+        breaker instead of marching into ENOSPC.
         """
-        if self.cache is None:
+        workdir_need = self.workdir_min_free + self.workdir_reserve
+        if self.cache is None and workdir_need <= 0:
             return
+
+        def _floors() -> "tuple[bool, bool]":
+            cache_ok = self.cache is None or self.cache.has_headroom()
+            workdir_ok = True
+            if workdir_need > 0:
+                free = self._workdir_free_bytes()
+                workdir_ok = free is None or free >= workdir_need
+            return cache_ok, workdir_ok
+
         deadline = time.monotonic() + self.admission_timeout
         warned = False
-        while not await asyncio.to_thread(self.cache.has_headroom):
-            evicted = await self.cache.evict_to_budget()
-            if evicted:
-                continue  # re-check headroom after the reclaim
+        while True:
+            cache_ok, workdir_ok = await asyncio.to_thread(_floors)
+            if cache_ok and workdir_ok:
+                return
+            if self.cache is not None:
+                evicted = await self.cache.evict_to_budget(
+                    extra_needed=self.workdir_reserve
+                    if not workdir_ok else 0)
+                if evicted:
+                    continue  # re-check the floors after the reclaim
+            free_now = (self.cache.free_disk_bytes()
+                        if self.cache is not None
+                        else (self._workdir_free_bytes() or 0))
             if time.monotonic() >= deadline:
                 logger.warn(
-                    "admitting job without cache disk headroom",
-                    free_bytes=self.cache.free_disk_bytes(),
-                    min_free_bytes=self.cache.min_free_bytes,
+                    "admitting job without disk headroom",
+                    free_bytes=free_now,
+                    cache_ok=cache_ok, workdir_ok=workdir_ok,
                 )
                 if record is not None:
                     record.event("admission_forced",
-                                 free_bytes=self.cache.free_disk_bytes())
+                                 free_bytes=free_now)
+                if not workdir_ok and self.breakers is not None:
+                    breaker = self.breakers.get("store")
+                    if breaker is not None:
+                        breaker.force_open(OPEN_DISK)
                 return
             if not warned:
                 warned = True
                 logger.warn(
-                    "job admission waiting for cache disk headroom",
-                    free_bytes=self.cache.free_disk_bytes(),
-                    min_free_bytes=self.cache.min_free_bytes,
+                    "job admission waiting for disk headroom",
+                    free_bytes=free_now,
+                    cache_ok=cache_ok, workdir_ok=workdir_ok,
                 )
                 if record is not None:
                     record.event("admission_wait",
-                                 free_bytes=self.cache.free_disk_bytes(),
-                                 min_free_bytes=self.cache.min_free_bytes)
+                                 free_bytes=free_now)
             await asyncio.sleep(0.25)
 
     # -- classified failure settlement ---------------------------------

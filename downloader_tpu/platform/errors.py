@@ -252,6 +252,7 @@ DEFAULT_SLOW_MIN_CALLS = 8
 # breaker open reasons (``breaker_opened_total{reason}`` / readyz)
 OPEN_FAILURE = "failure"
 OPEN_SLOW = "slow"
+OPEN_DISK = "disk"
 
 
 class CircuitBreaker:
@@ -359,6 +360,15 @@ class CircuitBreaker:
                 dependency=self.dependency, reason=reason
             ).inc()
         self._move(OPEN)
+
+    def force_open(self, reason: str) -> None:
+        """Open now on an out-of-band verdict the call counters never
+        see — the disk-headroom gate (``reason="disk"``): the volume
+        filling up fails no store call until the ENOSPC cascade is
+        already underway.  Re-forcing while open refreshes the reset
+        window; recovery is the normal half-open probe (the first
+        successful call after ``reset`` closes it)."""
+        self._open(reason)
 
     def note_latency(self, elapsed: Optional[float]) -> bool:
         """Land one answered attempt's latency in the slow ring;

@@ -63,6 +63,37 @@ window:
   rest.  Same ``mode``/``blackhole`` knobs.  The waiter-livelock
   regression (fleet.max_wait aging) drills with exactly this kind.
 
+**Disk faults** (the storage fault plane, ``make bench-disk``).  The
+zero-copy staging path (io_uring landing, sendfile/mmap uploads, the
+hardlinked peer tier) has failure modes no network kind can model —
+ENOSPC mid-part, EIO on a completion, a short write the caller must
+resume, a torn tail across a crash — so a ``disk`` kind injects them
+through the VFS shim (platform/vfs.py) every landing/staging write
+routes through.  ``disk_mode`` selects the failure shape:
+
+- ``enospc``  — raise :class:`DiskFault` carrying ``errno.ENOSPC``
+  (classified ``fault`` — PERMANENT by default for space exhaustion
+  drills, or transient when the window models an operator freeing
+  space)
+- ``eio``     — raise :class:`DiskFault` carrying ``errno.EIO``
+- ``short``   — the shim truncates one write syscall (the kernel
+  accepted fewer bytes than asked): the caller's resume loop must
+  carry on at the right offset, no error raised
+- ``latency`` — a slow device: sleep ``latency_ms`` (+ deterministic
+  ``jitter_ms``) around the write.  Only enacted where the write
+  already runs off the event loop (the io_pool landing thread); on-loop
+  writes skip the sleep rather than stall every job
+- ``torn``    — crash-consistency: at promote time, rename WITHOUT the
+  fsync, corrupt the tail of the renamed file, then SIGKILL — the
+  exact page-cache-loss state a power cut leaves, which boot recovery
+  must demote back to resumable instead of serving
+
+``disk`` rules are windowed like the network kinds (``start_s`` /
+``window_s`` against install time; both 0 = always on), so a drill can
+say "the disk is full for ten seconds" — and
+``analysis/drift.py``'s windowed-coverage lint enforces that the
+family stays drillable (its exemption list is empty).
+
 Count-scoped kinds stay fully deterministic — activation is by *call
 count* per rule, no randomness — so a chaos test
 (tests/test_faults.py, ``make chaos``) asserts exact retry/breaker
@@ -96,11 +127,13 @@ from .errors import FAULT_CLASSES, TRANSIENT
 _ENV_PLAN = "FAULT_PLAN"
 
 KINDS = ("error", "delay", "partial", "hang", "crash",
-         "brownout", "partition", "flap")
+         "brownout", "partition", "flap", "disk")
 #: kinds scoped by wall-clock window (anchored at injector install)
 WINDOWED_KINDS = frozenset({"brownout", "partition", "flap"})
 #: partition/flap asymmetry: which side of the dependency is degraded
 MODES = ("all", "writes", "reads")
+#: ``disk`` failure shapes (see module docstring)
+DISK_MODES = ("enospc", "eio", "short", "latency", "torn")
 
 #: seam ops (the last dotted component) that mutate shared state —
 #: what an asymmetric ``mode: writes`` partition refuses while reads
@@ -114,7 +147,8 @@ _WRITE_OPS = frozenset({"put", "delete", "remove", "bucket", "write",
 #: rule must re-load through from_dict on any later version.
 RULE_FIELDS = ("seam", "kind", "match", "count", "after", "fault",
                "delay_s", "start_s", "window_s", "latency_ms",
-               "jitter_ms", "mode", "blackhole", "period_s", "duty")
+               "jitter_ms", "mode", "blackhole", "period_s", "duty",
+               "disk_mode")
 
 #: brownout jitter: a fixed sample sequence standing in for a latency
 #: distribution — deterministic across reruns (indexed by per-rule
@@ -159,6 +193,24 @@ class InjectedFault(RuntimeError):
         super().__init__(f"injected {fault_class} fault at {seam} ({kind})")
 
 
+class DiskFault(OSError):
+    """An injected storage failure.  An :class:`OSError` subclass with a
+    REAL ``errno`` (ENOSPC/EIO) so every ``err.errno`` check on the
+    write path — the multipart abort classifier, the uring fallback,
+    the headroom breaker — handles a drill exactly like the kernel's
+    own error, while ``fault_class`` keeps the retrier taxonomy
+    deterministic per rule."""
+
+    def __init__(self, seam: str, disk_mode: str, err_no: int,
+                 fault_class: str):
+        self.fault_seam = seam
+        self.kind = "disk"
+        self.disk_mode = disk_mode
+        self.fault_class = fault_class
+        super().__init__(
+            err_no, f"injected disk fault at {seam} ({disk_mode})")
+
+
 @dataclass
 class FaultRule:
     """One line of the fault plan (see module docstring)."""
@@ -179,6 +231,8 @@ class FaultRule:
     blackhole: bool = False    # partition/flap: hang instead of raising
     period_s: float = 2.0      # flap cycle length
     duty: float = 0.5          # flap: partitioned fraction of each cycle
+    # -- disk kind only -------------------------------------------------
+    disk_mode: str = "enospc"  # enospc|eio|short|latency|torn
     # runtime counters (not config)
     calls: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
@@ -208,6 +262,10 @@ class FaultRule:
                 self.period_s <= 0 or not 0.0 < self.duty <= 1.0):
             raise ValueError(
                 "flap rule needs period_s > 0 and 0 < duty <= 1")
+        if self.disk_mode not in DISK_MODES:
+            raise ValueError(
+                f"fault rule disk_mode must be one of {DISK_MODES}, "
+                f"got {self.disk_mode!r}")
 
     @classmethod
     def from_dict(cls, raw: dict) -> "FaultRule":
@@ -271,6 +329,12 @@ class FaultRule:
                 return False
             if self.kind == "flap" and not self.flap_on(elapsed):
                 return False
+        elif self.kind == "disk":
+            # windowed like the network kinds; the 0/0 defaults make an
+            # unwindowed rule always-on, so count-scoped disk drills
+            # (``after``/``count``) still work unchanged
+            if elapsed is None or not self.window_active(elapsed):
+                return False
         n = self.calls
         self.calls += 1
         if n < self.after:
@@ -315,6 +379,35 @@ class FaultInjector:
         rules = [FaultRule.from_dict(dict(rule)) for rule in plan]
         return cls(rules, logger=logger)
 
+    def disk_action(self, seam: str, key: str = "",
+                    thread_ok: bool = False) -> Optional[str]:
+        """Consult ``disk`` rules for one write syscall (the VFS shim's
+        hook — platform/vfs.py).  Raising modes raise a
+        :class:`DiskFault` here; ``latency`` sleeps (only when
+        ``thread_ok`` — the caller attests it is off the event loop);
+        ``short``/``torn`` return their mode string for the shim to
+        enact, since only the shim knows the buffer/rename at hand.
+        Returns None when no rule fires."""
+        import errno as _errno
+
+        elapsed = time.monotonic() - self.installed_mono
+        for rule in self.rules:
+            if rule.kind != "disk" or not rule.applies(seam, key, elapsed):
+                continue
+            self._note_fired(rule)
+            mode = rule.disk_mode
+            if mode == "latency":
+                if thread_ok:
+                    time.sleep(rule.brownout_delay_s())
+                continue  # the write proceeds (slowly); later rules apply
+            self.last_fired_mono = time.monotonic()
+            if mode == "enospc":
+                raise DiskFault(seam, mode, _errno.ENOSPC, rule.fault)
+            if mode == "eio":
+                raise DiskFault(seam, mode, _errno.EIO, rule.fault)
+            return mode  # "short" | "torn": enacted by the shim
+        return None
+
     def _note_fired(self, rule: FaultRule) -> None:
         rule.fired += 1
         self.fired_total += 1
@@ -332,6 +425,23 @@ class FaultInjector:
             self._note_fired(rule)
             if rule.kind == "crash":
                 _crash_now(seam)
+            if rule.kind == "disk":
+                # async seams (e.g. ``disk.land``) honor the raising and
+                # latency modes; short/torn are write-shim mechanics the
+                # VFS layer enacts, meaningless at an async hook
+                import errno as _errno
+
+                if rule.disk_mode == "latency":
+                    await asyncio.sleep(rule.brownout_delay_s())
+                    continue
+                if rule.disk_mode == "enospc":
+                    self.last_fired_mono = time.monotonic()
+                    raise DiskFault(seam, "enospc", _errno.ENOSPC,
+                                    rule.fault)
+                if rule.disk_mode == "eio":
+                    self.last_fired_mono = time.monotonic()
+                    raise DiskFault(seam, "eio", _errno.EIO, rule.fault)
+                continue
             if rule.kind == "brownout":
                 # the call SUCCEEDS, slowly: sample the deterministic
                 # latency train, sleep, let it through (later rules —
@@ -353,10 +463,13 @@ class FaultInjector:
 
     def fire_sync(self, seam: str, key: str = "") -> None:
         """Synchronous seams (disk preflight) support ``error``,
-        ``crash``, and the refusing (non-blackhole) side of
-        ``partition``/``flap`` — a blocking sleep would stall the event
-        loop, so ``brownout`` latency never injects here (the drift
-        rule's windowed-coverage exemption list names such families)."""
+        ``crash``, the refusing (non-blackhole) side of
+        ``partition``/``flap``, and the raising ``disk`` modes
+        (ENOSPC/EIO) — a blocking sleep would stall the event loop, so
+        latency kinds never inject here (disk latency rides the VFS
+        shim's off-loop writes instead)."""
+        import errno as _errno
+
         elapsed = time.monotonic() - self.installed_mono
         for rule in self.rules:
             if not rule.applies(seam, key, elapsed):
@@ -364,6 +477,16 @@ class FaultInjector:
             if rule.kind == "crash":
                 self._note_fired(rule)
                 _crash_now(seam)
+            if rule.kind == "disk":
+                if rule.disk_mode in ("enospc", "eio"):
+                    self._note_fired(rule)
+                    self.last_fired_mono = time.monotonic()
+                    raise DiskFault(
+                        seam, rule.disk_mode,
+                        _errno.ENOSPC if rule.disk_mode == "enospc"
+                        else _errno.EIO,
+                        rule.fault)
+                continue
             if rule.kind in ("partition", "flap") and not rule.blackhole:
                 self._note_fired(rule)
                 self.last_fired_mono = time.monotonic()
@@ -415,3 +538,13 @@ async def fire(seam: str, key: str = "") -> None:
 def fire_sync(seam: str, key: str = "") -> None:
     if _ACTIVE is not None:
         _ACTIVE.fire_sync(seam, key)
+
+
+def disk_action(seam: str, key: str = "",
+                thread_ok: bool = False) -> Optional[str]:
+    """The VFS shim's per-syscall hook (see
+    :meth:`FaultInjector.disk_action`); None when no injector or no
+    matching ``disk`` rule."""
+    if _ACTIVE is not None:
+        return _ACTIVE.disk_action(seam, key, thread_ok=thread_ok)
+    return None

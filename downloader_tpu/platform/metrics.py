@@ -301,6 +301,15 @@ class Metrics:
             "Bytes LRU-evicted from the staging cache",
             registry=self.registry,
         )
+        self.scrub_objects = Counter(
+            f"{ns}_scrub_objects_total",
+            "Integrity-scrubber verdicts per object scanned (clean = "
+            "digest matched; repaired = re-copied from a healthy "
+            "replica into a fresh inode; quarantined = no healthy "
+            "source, moved aside — never served)",
+            ["outcome"],
+            registry=self.registry,
+        )
         # -- fleet coordination plane (fleet/) ------------------------
         self.fleet_workers_live = Gauge(
             f"{ns}_fleet_workers_live",
@@ -545,6 +554,7 @@ class Metrics:
             "Startup-reconciliation outcomes after a crash, by kind "
             "(replayed = journal job restored as a PARKED placeholder, "
             "resumable = workdir kept for its expected redelivery, "
+            "demoted = torn landed output deleted for re-fetch, "
             "swept = orphan workdir deleted, adopted = redelivery took "
             "over its placeholder, cancelled = placeholder cancelled "
             "during the replay window, expired = placeholder or cancel "

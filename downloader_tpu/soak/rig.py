@@ -46,6 +46,19 @@ _TERMINAL_NO_MARKER = ("EXPIRED", "FAILED", "DROPPED_POISON",
                        "CANCELLED")
 
 
+def _flip_byte(path: str) -> None:
+    """Deterministic single-byte bit-rot at the file's midpoint."""
+    size = os.path.getsize(path)
+    if size <= 0:
+        raise OSError(0, "empty file")
+    offset = size // 2
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        byte = fh.read(1)
+        fh.seek(offset)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+
+
 def _free_port() -> int:
     with socket.socket() as sock:
         sock.bind(("127.0.0.1", 0))
@@ -158,6 +171,12 @@ class SoakRig:
         #: pulled from every live worker's /v1/incidents just before
         #: drain — the replay diff's raw material
         self.incidents: List[dict] = []
+        #: the bit-rot phase's record (disk profile): seeded corrupt
+        #: paths, scrub totals before seeding, and the final totals —
+        #: the bench's ``scrub_repaired == seeded`` guard reads these
+        self.seeded_corruptions: List[str] = []
+        self.scrub_base: Dict[str, int] = {}
+        self.scrub_final: Dict[str, int] = {}
         self.slots = [self._make_slot(i) for i in range(profile.workers)]
         self._session: Optional[aiohttp.ClientSession] = None
 
@@ -239,7 +258,7 @@ class SoakRig:
                 "max_wait": 30.0,
                 "gc_interval": profile.gc_interval,
                 "telemetry_ttl": profile.telemetry_ttl,
-                "shared_max_age": 30.0,
+                "shared_max_age": profile.shared_max_age,
                 "shared_max_bytes": profile.shared_max_bytes,
             },
             "tenants": {
@@ -251,6 +270,14 @@ class SoakRig:
             "origins": {"manifest": {"min_poll": 0.1, "max_poll": 0.5,
                                      "stall_timeout": 15.0}},
         }
+        if profile.retry:
+            # the disk profile paces redelivery at disk-heal timescales
+            for section, knobs in profile.retry.items():
+                cfg["retry"].setdefault(section, {}).update(knobs)
+        if profile.scrub:
+            # the disk profile shrinks the scrub interval so repairs
+            # land inside the run's bit-rot phase
+            cfg["scrub"] = dict(profile.scrub)
         if profile.breakers:
             # the degraded profile arms the slow-call policy here
             cfg["breakers"] = dict(profile.breakers)
@@ -591,6 +618,100 @@ class SoakRig:
                         f"{outcome.spec.job_id}:{basename}:diverged")
         return mismatches
 
+    # -- the bit-rot phase (disk profile) -------------------------------
+    async def scrub_totals(self) -> Dict[str, int]:
+        """Fleet-summed scrubber verdict counters, read from each live
+        worker's own SLO digest (``local.digest.scrub`` on the fleet
+        overview endpoint — no aggregation TTL in the way)."""
+        totals = {"passes": 0, "clean": 0, "repaired": 0,
+                  "quarantined": 0}
+        for slot in self.live_workers():
+            try:
+                async with self._session.get(self._url(
+                        slot, "/v1/fleet/overview")) as resp:
+                    if resp.status != 200:
+                        continue
+                    body = await resp.json()
+            except (aiohttp.ClientError, OSError):
+                continue
+            snap = (((body.get("local") or {}).get("digest") or {})
+                    .get("scrub") or {})
+            for key in totals:
+                totals[key] += int(snap.get(key) or 0)
+        return totals
+
+    def _cache_entry_files(self, slot: WorkerSlot) -> List[tuple]:
+        """(key, path) for every payload file in this worker's cache."""
+        entries_dir = os.path.join(slot.cache_dir, "entries")
+        out: List[tuple] = []
+        try:
+            keys = sorted(os.listdir(entries_dir))
+        except OSError:
+            return out
+        for key in keys:
+            key_dir = os.path.join(entries_dir, key)
+            for dirpath, _dirnames, filenames in os.walk(key_dir):
+                for name in sorted(filenames):
+                    if name.startswith("."):
+                        continue  # .meta.json / transient temps
+                    out.append((key, os.path.join(dirpath, name)))
+        return out
+
+    async def _repairable_keys(self) -> set:
+        """Cache keys whose shared-tier manifest is live — the set the
+        scrubber can repair (not just quarantine)."""
+        keys = set()
+        async for info in self.store.list_objects(self.bucket,
+                                                  ".fleet-cache/"):
+            rest = info.name[len(".fleet-cache/"):]
+            if rest.endswith("/manifest.json"):
+                keys.add(rest[: -len("/manifest.json")])
+        return keys
+
+    async def seed_bitrot(self, count: int) -> List[str]:
+        """Flip one byte in up to ``count`` cache-entry files whose key
+        has a live shared-tier replica.  Returns the corrupted paths —
+        the oracle the ``scrub_repaired == seeded`` guard compares
+        against."""
+        repairable = await self._repairable_keys()
+        seeded: List[str] = []
+        for slot in self.slots:
+            for key, path in await asyncio.to_thread(
+                    self._cache_entry_files, slot):
+                if len(seeded) >= count:
+                    return seeded
+                if key not in repairable:
+                    continue
+                try:
+                    await asyncio.to_thread(_flip_byte, path)
+                except OSError:
+                    continue
+                seeded.append(path)
+        return seeded
+
+    async def _bitrot_phase(self) -> None:
+        """Seed bit-rot on the drained fleet, then hold it up until the
+        scrubber has accounted for every seed (repair or quarantine —
+        the guard that they were all *repairs* is the bench's)."""
+        profile = self.profile
+        if profile.corrupt_files <= 0:
+            return
+        self.scrub_base = await self.scrub_totals()
+        self.seeded_corruptions = await self.seed_bitrot(
+            profile.corrupt_files)
+        deadline = time.monotonic() + profile.scrub_wall
+        while True:
+            self.scrub_final = await self.scrub_totals()
+            found = ((self.scrub_final["repaired"]
+                      - self.scrub_base["repaired"])
+                     + (self.scrub_final["quarantined"]
+                        - self.scrub_base["quarantined"]))
+            if found >= len(self.seeded_corruptions):
+                return
+            if time.monotonic() >= deadline:
+                return  # the bench guard reports the shortfall
+            await asyncio.sleep(0.3)
+
     async def collect_world(self, scrape_failures: int) -> SoakWorld:
         world = SoakWorld(scrape_failures=scrape_failures,
                           kills_delivered=self.kills_delivered,
@@ -668,6 +789,9 @@ class SoakRig:
                 # quiescent-fleet attribution probe (the hop-ledger
                 # reconciliation guard's measurement set)
                 await self._attribution_probe(workload.probe_specs)
+                # disk profile: seed bit-rot between phases and hold
+                # the fleet up until the scrubber accounts for it
+                await self._bitrot_phase()
                 # let the elected sweeper age out telemetry digests and
                 # shared-tier entries before the final census
                 await asyncio.sleep(

@@ -73,6 +73,22 @@ class SoakProfile:
     #: extra ``slo`` config section for the workers (tests tighten
     #: objectives so a browned-out worker's burn rate visibly rises)
     slo: Dict[str, dict] = field(default_factory=dict)
+    #: ``retry`` config overrides merged over the rig defaults (the
+    #: disk profile paces redelivery so a full-disk window can't burn
+    #: a job's poison budget before the window closes)
+    retry: Dict[str, dict] = field(default_factory=dict)
+    #: extra ``scrub`` config section for the workers (the disk
+    #: profile shrinks the pass interval so repairs land in-run)
+    scrub: Dict[str, object] = field(default_factory=dict)
+    #: shared `.fleet-cache/` entry max age written into worker
+    #: configs (the disk profile stretches it so the scrubber's
+    #: repair source outlives the bit-rot phase)
+    shared_max_age: float = 30.0
+    #: cache-entry files to bit-rot AFTER the workload drains (the
+    #: scrubber must repair every one from the shared tier) — 0 = off
+    corrupt_files: int = 0
+    #: max seconds to wait for the scrubber to account for the seeds
+    scrub_wall: float = 25.0
     #: wall-clock offset (seconds after worker 0 installs its fault
     #: plan) at which the profile's brownout window opens — kept in
     #: sync with ``fault_plan`` so the rig can anchor the
@@ -195,6 +211,52 @@ class SoakProfile:
             # the elected GC sweeper, so the final telemetry census
             # runs up to ~2x jobs before aging out; the bound still
             # caps growth, just sized for this profile's chaos
+            telemetry_final_fraction=2.5,
+        )
+        params.update(overrides)
+        return cls(**params)
+
+    @classmethod
+    def disk(cls, **overrides) -> "SoakProfile":
+        """The storage-fault profile (``make bench-disk`` / bench v25
+        ``--disk``): no kill/stall chaos — instead worker 0's landing
+        writes hit a windowed ENOSPC (the disk is full for a few
+        seconds, then an operator frees space), and AFTER the workload
+        drains the rig flips one byte in several cache-entry files
+        whose keys have healthy shared-tier replicas.  Guards: every
+        job settles despite the full disk (zero FAILED/poisoned),
+        every staged byte is exact (zero corrupt bytes served), and
+        the scrubber's repair count equals the seeded corruption
+        count — measured, not projected."""
+        params = dict(
+            jobs=18, workers=2, kill_interval=0.0, kills=0,
+            max_wall=110.0, publish_rate=2.5,
+            # hot fan-in dominates so cache entries AND their shared-
+            # tier replicas exist for the bit-rot phase to corrupt and
+            # the scrubber to repair from
+            hot_fraction=0.5, racing_fraction=0.0, manifest_jobs=0,
+            bulk_fraction=0.25, probe_jobs=0,
+            # worker 0: the disk is full from t=1s for 6 s of landing
+            # writes, then space "frees up" (transient classification:
+            # redeliveries after the window land clean)
+            fault_plan=(
+                '[{"seam": "disk.write", "kind": "disk",'
+                ' "disk_mode": "enospc", "fault": "transient",'
+                ' "start_s": 1.0, "window_s": 6.0}]'
+            ),
+            brownout_start_s=1.0,
+            # pace redelivery at operator timescales: a full disk does
+            # not heal in 50 ms, and fast-looping redeliveries could
+            # burn the 5-failure poison budget inside the window
+            retry={"redelivery": {"base": 0.5, "cap": 2.5}},
+            corrupt_files=3,
+            scrub={"interval": 1.0, "rate_mb_s": 512},
+            # the repair source must outlive the bit-rot phase
+            shared_max_age=300.0,
+            # the full-disk window inflates worker 0's tail
+            # legitimately (paced redeliveries ride it out)
+            p99_ceiling={"HIGH": 35.0, "NORMAL": 45.0, "BULK": 80.0},
+            # breaker-shed jobs settle on both workers (see degraded)
             telemetry_final_fraction=2.5,
         )
         params.update(overrides)

@@ -39,8 +39,9 @@ import zlib
 import aiohttp
 
 from .. import schemas
-from ..platform import faults
+from ..platform import faults, vfs
 from ..platform.errors import Retrier
+from ..store import scrub
 from ..store.cache import ContentCache, Singleflight, cache_key
 from ..utils.disk import ensure_disk_space as _ensure_disk_space
 from ..utils.hashing import md5_file_hex
@@ -55,6 +56,24 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 PROGRESS_INTERVAL_SECONDS = 30.0
 
 _CHUNK = 1 << 20  # 1 MiB read chunks for streaming HTTP
+
+
+def _landed_rel_digests(job, root: str) -> "dict[str, str]":
+    """``job.landed_digests`` re-keyed relative to ``root`` (the
+    workdir), for the cache manifest: the landing-site digests become
+    the entry's scrub/verify ground truth.  Paths outside ``root`` —
+    and protocols that never stamp digests (torrent) — just yield
+    fewer entries; files without one are not re-verifiable, which is
+    exactly the pre-digest behavior."""
+    digests = getattr(job, "landed_digests", None) or {}
+    root = os.path.abspath(root)
+    out = {}
+    for path, digest in digests.items():
+        if os.path.commonpath([os.path.abspath(path), root]) != root:
+            continue
+        rel = os.path.relpath(os.path.abspath(path), root)
+        out[rel.replace(os.sep, "/")] = digest
+    return out
 
 
 class _LandHasher:
@@ -137,16 +156,13 @@ SEG_MIN_SIZE = 8 << 20
 SEG_STATE_INTERVAL = 2.0
 
 
-def _write_all(fd: int, view, pos: "int | None") -> None:
-    """Write a full buffer at ``pos`` (None = the fd's own offset)."""
-    view = memoryview(view)
-    while view:
-        if pos is None:
-            n = os.write(fd, view)
-        else:
-            n = os.pwrite(fd, view, pos)
-            pos += n
-        view = view[n:]
+def _write_all(fd: int, view, pos: "int | None",
+               thread_ok: bool = False) -> None:
+    """Write a full buffer at ``pos`` (None = the fd's own offset),
+    through the VFS shim so disk drills (platform/vfs.py) reach the
+    landing loop.  ``thread_ok`` attests the caller is off the event
+    loop (latency drills only enact there)."""
+    vfs.write_all(fd, view, pos, seam="disk.write", thread_ok=thread_ok)
 
 
 def _spliceable(resp) -> bool:
@@ -713,9 +729,55 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             else:
                 _remove_meta()
 
-        def _promote() -> None:
-            os.replace(partial, output)
+        async def _path_digest(path: str) -> "str | None":
+            """md5 of the completed entity at ``path``, for the
+            pre-promote recovery sidecar and ``job.landed_digests``.
+            Free when the inline hasher provably saw every written
+            byte; otherwise one read pass while the landing is still
+            page-cache hot, billed to the ``hash`` hop."""
+            if not hash_on_land:
+                return None
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                return None
+            hasher = land_hasher[0]
+            if hasher is not None and hasher.nbytes == size:
+                return hasher.hexdigest()
+            mark = time.monotonic()
+            # graftlint: disable=second-pass-read -- the blessed landing-site hash: resumed/spliced/segmented landings have no complete inline hasher, and the torn-tail recovery sidecar must hold the digest BEFORE the rename
+            digest = await asyncio.to_thread(md5_file_hex, path)
+            if record is not None:
+                record.note_hop("hash", size, time.monotonic() - mark)
+            return digest
+
+        def _stamp_digest(digest: "str | None") -> None:
+            digests = getattr(job, "landed_digests", None)
+            if digest is not None and digests is not None:
+                digests[os.path.abspath(output)] = digest
+
+        def _note_sidecar(digest: "str | None") -> None:
+            if digest is not None:
+                scrub.note_landed(download_path,
+                                  os.path.basename(output), digest)
+
+        async def _promote() -> None:
+            # crash-consistent publish: the entity's digest is first
+            # persisted DURABLY to the workdir recovery sidecar
+            # (.landed.json), THEN the data rename runs
+            # fsync-before-rename through the VFS shim, off the loop
+            # (a multi-GB landing's fsync would stall every other
+            # job's transfer).  Boot recovery (store/scrub.py
+            # verify_landed) re-hashes sidecar-named outputs and
+            # demotes any mismatch — the torn-tail crash, where the
+            # size still checks out but the tail pages never reached
+            # the disk — back to re-fetch instead of serving the hole.
+            digest = await _path_digest(partial)
+            await asyncio.to_thread(_note_sidecar, digest)
+            await asyncio.to_thread(vfs.promote, partial, output,
+                                    key=output)
             _remove_meta()
+            _stamp_digest(digest)
 
         def _decoder_for(resp):
             # the session never decompresses (auto_decompress=False) and we
@@ -735,25 +797,23 @@ async def stage_factory(ctx: StageContext) -> StageFn:
         land_hasher: list = [None]
 
         async def _settle_digest() -> None:
-            """Stamp ``job.landed_digests[output]`` at promote time, so
-            the upload stage and the staged manifest never re-read the
-            file just to hash it (the r3-r5 second pass).  An inline
-            hasher that provably saw every written byte is free;
-            otherwise one chunked read while the landing is still hot
-            in the page cache, billed to the ``hash`` hop."""
+            """Stamp ``job.landed_digests[output]`` for the exit paths
+            that never ran ``_promote`` (a validated pre-existing
+            output from an earlier attempt), so the upload stage and
+            the staged manifest never re-read the file just to hash it
+            (the r3-r5 second pass).  Promoting paths stamped the
+            digest — and the recovery sidecar — at promote time."""
             if not hash_on_land:
                 return
             digests = getattr(job, "landed_digests", None)
             if digests is None:
                 return  # job double without the carrier: nobody
                 # downstream could consume the digest, don't burn a pass
+            if os.path.abspath(output) in digests:
+                return  # stamped (and sidecar-noted) at promote time
             try:
                 size = os.path.getsize(output)
             except OSError:
-                return
-            hasher = land_hasher[0]
-            if hasher is not None and hasher.nbytes == size:
-                digests[os.path.abspath(output)] = hasher.hexdigest()
                 return
             mark = time.monotonic()
             # graftlint: disable=second-pass-read -- the blessed landing-site hash: bytes are hot in cache and this digest retires every later re-read
@@ -761,6 +821,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             if record is not None:
                 record.note_hop("hash", size, time.monotonic() - mark)
             digests[os.path.abspath(output)] = digest
+            await asyncio.to_thread(_note_sidecar, digest)
 
         def _note_origin_wait(mark: float) -> None:
             # request -> response-headers latency: the origin's
@@ -853,7 +914,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         # volume must not stall the event loop (r5)
                         await asyncio.to_thread(
                             _write_all, out_dup, memoryview(head)[:cap],
-                            offset)
+                            offset, True)
                     if record is not None:
                         record.note_hop("disk_write", landed,
                                         time.monotonic() - write_mark)
@@ -935,6 +996,12 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             return total
 
         async def _stream_body(resp, mode: str, hasher=None) -> int:
+            # the async face of the disk family: windowed ``disk`` rules
+            # (latency/ENOSPC/EIO) drill the landing loop here, where a
+            # brownout-style sleep is legal — the sync shim below only
+            # enacts what a syscall can (drift.py windowed coverage)
+            if faults.enabled():
+                await faults.fire("disk.land", key=partial)
             total = 0
             decoder = _decoder_for(resp)
             use_splice = decoder is None and _spliceable(resp)
@@ -968,7 +1035,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     data = decoder.decompress(raw) if decoder else raw
                     if data:
                         write_mark = time.monotonic()
-                        fh.write(data)
+                        vfs.fh_write_all(fh, data, key=partial)
                         if record is not None:
                             record.note_hop("disk_write", len(data),
                                             time.monotonic() - write_mark)
@@ -979,7 +1046,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                 if decoder is not None:
                     tail = decoder.flush()
                     if tail:
-                        fh.write(tail)
+                        vfs.fh_write_all(fh, tail, key=partial)
                         if hasher is not None:
                             hasher.update(tail)
                         total += len(tail)
@@ -1255,9 +1322,15 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     try:
                         return _w.pwrite(fd, data, off)
                     except (OSError, RuntimeError):
-                        return os.pwrite(fd, data, off)
+                        # whole-chunk fallback (ring setup/teardown
+                        # trouble); per-CQE short/EIO fallback lives
+                        # inside UringWriter.pwrite itself
+                        vfs.write_all(fd, data, off, thread_ok=True)
+                        return len(data)
             else:
-                _land_chunk = os.pwrite
+                def _land_chunk(fd, data, off):
+                    vfs.write_all(fd, data, off, thread_ok=True)
+                    return len(data)
 
             async def _save_state() -> None:
                 # snapshot on the loop thread (segment tasks mutate
@@ -1448,7 +1521,17 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                     if uring_writer is not None:
                         uring_writer.close()
                     os.close(fd)
-            os.replace(seg_partial, output)
+            # same crash-consistent publish as the sequential promote:
+            # sidecar note durably BEFORE the rename.  Segments land by
+            # positioned writes with no inline hasher (and a stale
+            # sequential hasher from an earlier attempt must not be
+            # trusted here), so the digest is one hot-cache pass.
+            land_hasher[0] = None
+            digest = await _path_digest(seg_partial)
+            await asyncio.to_thread(_note_sidecar, digest)
+            await asyncio.to_thread(vfs.promote, seg_partial, output,
+                                    key=output)
+            _stamp_digest(digest)
             try:
                 os.remove(seg_state_path)
             except OSError:
@@ -1565,7 +1648,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                             # than its Content-Range advertises without
                             # raising
                             if os.path.getsize(partial) >= total_len:
-                                _promote()
+                                await _promote()
                                 return fetched[0]
                             if got <= 0:
                                 raise RuntimeError(
@@ -1591,14 +1674,14 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                                 else None)
                             await _stream_body(resp, "wb",
                                                hasher=land_hasher[0])
-                            _promote()
+                            await _promote()
                             return fetched[0]
                         if resp.status == 416:
                             # If-Range was sent, so a 416 means the
                             # validator matched; length == offset proves the
                             # partial is the complete entity
                             if _entity_complete(resp, offset):
-                                _promote()
+                                await _promote()
                                 return fetched[0]
                             # oversized/stale partial: clean restart below
                         elif resp.status != 206:
@@ -1622,7 +1705,7 @@ async def stage_factory(ctx: StageContext) -> StageFn:
                         _LandHasher(record) if hash_on_land else None)
                     await _stream_body(resp, "wb",
                                        hasher=land_hasher[0])
-                    _promote()
+                    await _promote()
                     return fetched[0]
 
         async def _attempt() -> int:
@@ -1980,7 +2063,9 @@ async def stage_factory(ctx: StageContext) -> StageFn:
             # partial workdir is never inserted.  A fill failure (disk)
             # must not fail a job that already has its bytes.
             try:
-                entry = await cache.insert(key, download_path)
+                entry = await cache.insert(
+                    key, download_path,
+                    digests=_landed_rel_digests(job, download_path))
                 if ctx.record is not None:
                     ctx.record.event("cache", outcome="fill", key=key[:16],
                                      bytes=entry.size if entry else 0)

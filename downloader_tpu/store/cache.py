@@ -205,12 +205,17 @@ class Singleflight:
 
 
 class CacheEntry:
-    __slots__ = ("key", "size", "files")
+    __slots__ = ("key", "size", "files", "digests")
 
-    def __init__(self, key: str, size: int, files: List[str]):
+    def __init__(self, key: str, size: int, files: List[str],
+                 digests: Optional[Dict[str, str]] = None):
         self.key = key
         self.size = size
         self.files = files  # entry-relative paths
+        # per-file landing digests (rel -> md5 hex) when the fill
+        # carried them: the integrity scrubber's ground truth, and the
+        # shared-tier manifest's provenance for fetch-time verification
+        self.digests = digests or {}
 
 
 class ContentCache:
@@ -320,8 +325,11 @@ class ContentCache:
                               ignore_errors=True)
 
     def _entry_from_meta(self, key: str, meta: dict) -> CacheEntry:
+        digests = meta.get("digests")
         return CacheEntry(key=key, size=int(meta.get("size", 0)),
-                          files=list(meta.get("files", [])))
+                          files=list(meta.get("files", [])),
+                          digests=dict(digests)
+                          if isinstance(digests, dict) else None)
 
     # -- introspection --------------------------------------------------
     def total_bytes(self) -> int:
@@ -339,6 +347,22 @@ class ContentCache:
     def has_headroom(self) -> bool:
         """True when the cache volume holds the admission floor."""
         return self.free_disk_bytes() >= self.min_free_bytes
+
+    def keys(self) -> List[str]:
+        """Completed entry keys on disk — the scrubber's walk
+        inventory (thread-side; call via ``asyncio.to_thread``)."""
+        return [name for name in _listdir(self.entries_dir)
+                if self._read_meta(name) is not None]
+
+    async def peek(self, key: str) -> Optional[CacheEntry]:
+        """Like :meth:`lookup` but WITHOUT the LRU touch: a scrubber
+        walk must not promote every entry it verifies to
+        most-recently-used (that would turn eviction order into scan
+        order)."""
+        meta = await asyncio.to_thread(self._read_meta, key)
+        if meta is None:
+            return None
+        return self._entry_from_meta(key, meta)
 
     def entry_path(self, key: str) -> str:
         """Absolute directory of entry ``key`` (the fleet shared tier
@@ -443,16 +467,22 @@ class ContentCache:
                      for rel in entry.files]
             return entry.size, dests
 
-    async def insert(self, key: str, src_dir: str) -> Optional[CacheEntry]:
+    async def insert(self, key: str, src_dir: str,
+                     digests: Optional[Dict[str, str]] = None
+                     ) -> Optional[CacheEntry]:
         """Fill ``key`` from a completed job workdir.
 
         Hardlinks (or copies) every regular file under ``src_dir`` into a
         staging dir, writes the manifest inside it, then atomically
         renames the whole dir into ``entries/``.  Dotfiles and in-flight
         temp suffixes (``.partial``/``.partial.meta``/segment state) are
-        skipped — only verified payload is cacheable.  Returns the new
-        entry, or None when there was nothing to cache or the key lost an
-        insert race (another leader's fill is equally valid).
+        skipped — only verified payload is cacheable.  ``digests``
+        (entry-relative path -> md5 hex, from the landing-site hash)
+        rides the manifest so the integrity scrubber — and shared-tier
+        fetchers — can re-verify these bytes forever without a trusted
+        re-read.  Returns the new entry, or None when there was nothing
+        to cache or the key lost an insert race (another leader's fill
+        is equally valid).
         """
         async with self._lock:
             if await asyncio.to_thread(self._read_meta, key) is not None:
@@ -488,6 +518,9 @@ class ContentCache:
                 "files": files,
                 "created": time.time(),
             }
+            if digests:
+                meta["digests"] = {rel: digests[rel] for rel in files
+                                   if rel in digests}
             # manifest rides INSIDE the dir: one rename publishes entry
             # and manifest together, so a torn publish is impossible
             tmp = os.path.join(staging, META_NAME + ".tmp")
@@ -522,6 +555,45 @@ class ContentCache:
         # LRU like any other (and is the most recently used)
         await self.evict_to_budget()
         return self._entry_from_meta(key, meta)
+
+    async def quarantine(self, key: str, dest_dir: Optional[str]) -> bool:
+        """Move entry ``key`` out of the cache for triage (integrity
+        scrub verdict: corrupt with no healthy replica).  One rename
+        retires the whole directory — manifest included, so the
+        quarantined copy stays inspectable — and the entry is
+        invisible the instant the rename lands (the same one-rename
+        discipline as publish/evict).  ``dest_dir`` None just evicts.
+        Pinned (mid-materialize) entries are left alone: False."""
+        async with self._lock:
+            if self._pins.get(key):
+                return False
+            entry_dir = self._entry_dir(key)
+
+            def _move() -> bool:
+                if not os.path.isdir(entry_dir):
+                    return False
+                if not dest_dir:
+                    _unlink_quiet(os.path.join(entry_dir, META_NAME))
+                    shutil.rmtree(entry_dir, ignore_errors=True)
+                    return True
+                dest = os.path.join(dest_dir,
+                                    f"{key}.{int(time.time())}")
+                try:
+                    os.makedirs(dest_dir, exist_ok=True)
+                    os.rename(entry_dir, dest)
+                    return True
+                except OSError:
+                    # cross-device quarantine volume: fall back to the
+                    # evict discipline (manifest first) rather than
+                    # leave corrupt bytes servable
+                    _unlink_quiet(os.path.join(entry_dir, META_NAME))
+                    shutil.move(entry_dir, dest)
+                    return True
+
+            try:
+                return await asyncio.to_thread(_move)
+            except OSError:
+                return False
 
     async def evict_to_budget(self, extra_needed: int = 0) -> int:
         """LRU-evict until total size fits ``max_bytes - extra_needed``
@@ -579,7 +651,7 @@ def _is_transient(name: str) -> bool:
     return name.endswith((
         ".partial", ".partial.meta", ".partial-seg", ".partial-seg.state",
         ".resume", ".tmp",
-    )) or ".cachetmp." in name
+    )) or ".cachetmp." in name or ".scrubtmp." in name
 
 
 def _listdir(path: str) -> List[str]:

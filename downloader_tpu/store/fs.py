@@ -19,6 +19,7 @@ import time
 from typing import AsyncIterator, Optional
 
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
+from ..platform import vfs
 from ..utils.stale import STALE_GRACE_S as _STALE_GRACE_S
 from ..utils.stale import STALE_MAX_AGE_S as _STALE_MAX_AGE_S
 from ..utils.stale import probe_stale
@@ -350,6 +351,19 @@ def _reclaim_dir(dirpath: str) -> None:
                     pass
 
 
+def _copy_file(src: str, dst: str) -> None:
+    """Byte-copy through the write shim so ENOSPC/EIO/short-write
+    drills on ``disk.spill`` exercise the spill byte path, not just
+    the rename."""
+    with open(src, "rb") as rfh, open(dst, "wb") as wfh:
+        while True:
+            chunk = rfh.read(1 << 20)
+            if not chunk:
+                break
+            vfs.fh_write_all(wfh, chunk, seam="disk.spill", key=dst,
+                             thread_ok=True)
+
+
 def _write_file_atomic(path: str, data: bytes, suffix: str,
                        sweep: bool = True) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -358,8 +372,12 @@ def _write_file_atomic(path: str, data: bytes, suffix: str,
     tmp = f"{path}.tmp.{suffix}"
     try:
         with open(tmp, "wb") as fh:
-            fh.write(data)
-        os.replace(tmp, path)
+            vfs.fh_write_all(fh, data, seam="disk.spill", key=path,
+                             thread_ok=True)
+        # fsync-before-rename: the store's objects are the durable tier
+        # the scrubber repairs FROM, so a spilled name must never point
+        # at bytes the disk does not hold
+        vfs.promote(tmp, path, seam="disk.spill", key=path)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -381,10 +399,13 @@ def _ingest_file_atomic(src: str, dst: str, link_ok: bool, suffix: str,
             except OSError:
                 # cross-device (EXDEV), no-hardlink fs (EPERM), link cap
                 # (EMLINK): fall through to the byte copy
-                shutil.copyfile(src, tmp)
+                _copy_file(src, tmp)
         else:
-            shutil.copyfile(src, tmp)
-        os.replace(tmp, dst)
+            _copy_file(src, tmp)
+        # a hardlinked ingest shares the source inode, whose bytes the
+        # landing path already fsynced; the copy path's durability comes
+        # from promote's fsync-before-rename either way
+        vfs.promote(tmp, dst, seam="disk.spill", key=dst)
     except BaseException:
         # tmp names are unique per call, so a failed put (ENOSPC, kill
         # signal unwinding) must remove its own leftover — nothing will

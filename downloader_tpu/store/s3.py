@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import datetime
+import errno
 import hashlib
 import hmac
 import mmap
@@ -30,7 +31,7 @@ from typing import AsyncIterator, Dict, Optional
 import aiohttp
 import yarl
 
-from ..platform.errors import PERMANENT, TRANSIENT
+from ..platform.errors import PERMANENT, TRANSIENT, tag_fault
 from .base import ObjectInfo, ObjectNotFound, ObjectStore
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
@@ -726,6 +727,23 @@ class S3ObjectStore(ObjectStore):
                     except (aiohttp.ClientError, OSError,
                             ConnectionError, ValueError,
                             IndexError) as err:
+                        if (isinstance(err, OSError)
+                                and err.errno == errno.ENOSPC):
+                            # local disk full reading/staging the part:
+                            # every further attempt re-reads the same
+                            # full volume.  Fail fast PERMANENT so the
+                            # retry budget isn't burned and the caller's
+                            # except-path AbortMultipartUpload drops the
+                            # already-stored parts NOW (no orphans
+                            # billing storage with no visible object)
+                            raise tag_fault(err, PERMANENT)
+                        if getattr(err, "fault_class", None) == PERMANENT:
+                            # explicitly pre-classified (injected disk
+                            # faults, status-coded errors): fail fast.
+                            # NOT classify()-based — a bare ValueError/
+                            # IndexError here is a zero-copy slice quirk
+                            # whose cure IS the buffered retry below.
+                            raise err
                         last = err
                         # a zero-copy transport error retries on the
                         # buffered path — correctness never depends on

@@ -178,8 +178,14 @@ class UringWriter:
         """``os.pwrite(fd, data, offset)`` through the ring.
 
         Submits IORING_OP_WRITE and waits for its completion before
-        returning, looping on short writes so the caller always lands
-        the full buffer (matching ``_write_all`` discipline).
+        returning.  A degraded completion — an error CQE (e.g. ``-EIO``
+        from a ring the kernel has soured on this fd) or a short/zero
+        write — does NOT re-drive the ring: the remainder lands through
+        one plain ``os.pwrite`` loop at the resumed offset, so the
+        buffer is landed exactly once at exactly the right bytes and a
+        sick ring never gets a second chance to corrupt the landing.
+        Errors that are real disk errors (ENOSPC, hard EIO) reproduce
+        in the fallback and surface with their ordinary errno.
         """
         if not isinstance(data, bytes):
             data = bytes(data)
@@ -192,12 +198,30 @@ class UringWriter:
         while total < length:
             res = self._submit_write(
                 fd, addr + total, length - total, offset + total)
-            if res < 0:
-                raise OSError(-res, os.strerror(-res))
-            if res == 0:
-                raise OSError(errno.EIO, "io_uring: zero-byte write")
-            total += res
+            if res == length - total:
+                total += res
+                continue
+            if res > 0:
+                total += res
+            total = self._pwrite_fallback(fd, data, offset, total)
+            break
         del ref
+        return total
+
+    @staticmethod
+    def _pwrite_fallback(fd: int, data: bytes, offset: int,
+                         total: int) -> int:
+        """Finish ``data[total:]`` with plain ``pwrite`` at the resumed
+        offset (through the vfs shim, so disk drills still apply)."""
+        from ..platform import vfs
+
+        length = len(data)
+        while total < length:
+            n = vfs.pwrite(fd, memoryview(data)[total:], offset + total,
+                           thread_ok=True)
+            if n <= 0:
+                raise OSError(errno.EIO, "pwrite fallback: zero-byte write")
+            total += n
         return total
 
     def _submit_write(self, fd: int, addr: int, length: int,

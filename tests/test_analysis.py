@@ -478,9 +478,13 @@ def test_seam_coverage_async_hook_satisfies_windowed_drillability():
                          operations=SEAM_DOCS) == []
 
 
-def test_seam_coverage_windowed_exemption_is_honored():
-    # `disk` is sync-only by design, with the justification on record
-    # in drift.WINDOWED_EXEMPT — no finding
+def test_seam_coverage_windowed_exemption_ratchet(monkeypatch):
+    # the storage fault plane emptied drift.WINDOWED_EXEMPT: a
+    # sync-only `disk` hook is now a finding like any other family
+    # (ISSUE 20 acceptance — the ratchet must not quietly regrow)
+    from downloader_tpu.analysis import drift
+
+    assert drift.WINDOWED_EXEMPT == {}
     mod = """
         from ..platform import faults
 
@@ -488,6 +492,13 @@ def test_seam_coverage_windowed_exemption_is_honored():
             faults.fire_sync("disk.preflight", key="/tmp")
     """
     docs = SEAM_DOCS + "\nretry.disk covers the preflight\n"
+    found = run_repo_rule("seam-coverage", sources={LIB: mod},
+                          operations=docs)
+    assert any("windowed" in f.message and "disk" in f.message
+               for f in found)
+    # the exemption mechanism itself still works when justified
+    monkeypatch.setattr(drift, "WINDOWED_EXEMPT",
+                        {"disk": "sync-only by design (test)"})
     found = run_repo_rule("seam-coverage", sources={LIB: mod},
                           operations=docs)
     assert not any("windowed" in f.message for f in found)

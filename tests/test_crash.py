@@ -538,3 +538,61 @@ async def test_sigkill_lease_holder_restart_reclaims(tmp_path):
     finally:
         await rig.stop()
         await origin.cleanup()
+
+
+async def test_torn_tail_promote_demoted_on_restart(tmp_path):
+    """ISSUE 20: the ``torn`` disk drill at the promote seam — the
+    rename outlives the data pages (zeroed tail), then SIGKILL, the
+    exact state a power cut leaves.  Boot recovery must re-verify the
+    landing sidecar, DEMOTE the torn output (delete it for re-fetch,
+    never promote the hole to staging), and the redelivered job must
+    settle DONE exactly once with staged bytes hash-identical to the
+    origin."""
+    from downloader_tpu.platform.vfs import TORN_TAIL_BYTES
+    from downloader_tpu.store import scrub
+
+    rig = CrashRig(tmp_path)
+    await rig.start_backends()
+    origin, uri, gets = await start_origin()
+    try:
+        rig.write_config()
+        await rig.spawn_worker(fault_plan=(
+            '[{"seam": "disk.promote", "kind": "disk",'
+            ' "disk_mode": "torn", "count": 1}]'
+        ))
+        await rig.publish("torn-dl", uri)
+        await rig.wait_killed()
+
+        # the torn world: the output IS renamed into place, its size
+        # checks out, but the tail pages never reached the disk — and
+        # the durably-promoted sidecar still holds the true digest
+        workdir = os.path.join(rig.downloads, "torn-dl")
+        out = os.path.join(workdir, "show.mkv")
+        assert os.path.exists(out)
+        data = open(out, "rb").read()
+        assert len(data) == len(PAYLOAD)
+        assert data != PAYLOAD
+        assert data[-TORN_TAIL_BYTES:] == b"\0" * TORN_TAIL_BYTES
+        landed = scrub.read_landed(workdir)
+        assert landed.get("show.mkv")  # the digest survived the crash
+        # nothing reached staging before the crash
+        with pytest.raises(Exception):
+            await rig.staged_bytes("torn-dl")
+
+        await rig.spawn_worker()  # clean second life: no fault plan
+        _status, ready = await rig.admin("/readyz")
+        recovery = ready.get("recovery") or {}
+        assert recovery.get("demotedOutputs", 0) >= 1
+        assert recovery.get("resumableWorkdirs", 0) >= 1
+
+        body = await rig.wait_job_state("torn-dl", "DONE")
+        assert body.get("recovered") is True
+        await rig.assert_staged_ok("torn-dl")
+        assert gets[0] == 2  # demoted -> full re-fetch from origin
+        assert rig.orphan_workdirs() == []
+        final = rig.journal_state().jobs.get("torn-dl")
+        assert final is not None and final.state == "DONE"
+        assert final.settle == "ack"
+    finally:
+        await rig.stop()
+        await origin.cleanup()

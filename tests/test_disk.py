@@ -1,0 +1,736 @@
+"""Storage fault plane suite (ISSUE 20; ``make disk``).
+
+The zero-copy staging path proven against the failure modes disks
+actually have: the windowed ``disk`` fault kind + VFS shim
+(ENOSPC / EIO / short / latency / torn at the landing, spill, promote
+and sidecar seams), fsync-before-rename crash consistency with
+boot-time torn-tail demotion, the background scrubber
+(clean / repair / quarantine, copy-on-repair fresh inodes for
+hardlinked entries), the PR 19 satellite hazards (hardlink-tier
+corruption propagation, ENOSPC mid-multipart, io_uring degraded
+completions), and disk-full graceful degradation (the workdir
+free-space admission floor force-opening the store breaker with the
+``disk`` reason).
+"""
+
+import ctypes
+import errno
+import hashlib
+import os
+import time
+
+import pytest
+
+from downloader_tpu.fleet import FleetPlane, MemoryCoordStore
+from downloader_tpu.mq import InMemoryBroker, MemoryQueue
+from downloader_tpu.orchestrator import Orchestrator
+from downloader_tpu.platform import faults, vfs
+from downloader_tpu.platform import metrics as prom
+from downloader_tpu.platform.config import ConfigNode
+from downloader_tpu.platform.errors import OPEN_DISK, PERMANENT, TRANSIENT
+from downloader_tpu.platform.faults import DiskFault, FaultInjector, FaultRule
+from downloader_tpu.platform.logging import NullLogger
+from downloader_tpu.platform.telemetry import Telemetry
+from downloader_tpu.stages.upload import STAGING_BUCKET
+from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store import scrub
+from downloader_tpu.store.cache import ContentCache, cache_key
+from downloader_tpu.store.s3 import S3ObjectStore
+from downloader_tpu.utils import uring
+
+from minis3 import MiniS3
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_injector():
+    """Every test must leave the process-global injector uninstalled."""
+    yield
+    assert faults.active() is None, "test leaked an installed fault plan"
+    faults.uninstall()
+
+
+def _install(*rules) -> FaultInjector:
+    return faults.install(FaultInjector(list(rules)))
+
+
+def _md5(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# The disk fault kind: rule semantics + injector actions
+# ---------------------------------------------------------------------------
+
+def test_disk_rule_is_windowed_like_the_network_kinds():
+    """A ``disk`` rule is gated by the wall-clock window, and calls
+    outside the window are not counted against ``after``/``count``."""
+    rule = FaultRule(seam="disk.write", kind="disk", disk_mode="enospc",
+                     start_s=5.0, window_s=10.0, count=1)
+    assert not rule.applies("disk.write", "", 0.0)
+    assert rule.calls == 0  # pre-window calls don't burn the count
+    assert not rule.applies("disk.write", "", None)
+    assert rule.applies("disk.write", "", 6.0)
+    assert not rule.applies("disk.write", "", 6.5)  # count=1 exhausted
+    assert not rule.applies("disk.write", "", 20.0)  # window closed
+
+
+def test_disk_rule_defaults_always_on():
+    """start_s/window_s 0/0 = always on, so count-scoped disk drills
+    work unchanged (the crash-harness placement idiom)."""
+    rule = FaultRule(seam="disk.promote", kind="disk", disk_mode="torn")
+    assert rule.applies("disk.promote", "", 0.0)
+    assert rule.applies("disk.promote", "", 9999.0)
+    assert not rule.applies("disk.write", "", 0.0)  # fnmatch on the seam
+
+
+def test_disk_rule_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        FaultRule(seam="disk.write", kind="disk", disk_mode="gremlins")
+
+
+def test_disk_fault_carries_real_errno_and_class():
+    """DiskFault is an OSError with the REAL errno, so every
+    ``err.errno`` check on the write path treats a drill exactly like
+    the kernel's own error."""
+    inj = FaultInjector([FaultRule(seam="disk.write", kind="disk",
+                                   disk_mode="enospc", fault=PERMANENT)])
+    with pytest.raises(DiskFault) as exc:
+        inj.disk_action("disk.write", "k")
+    err = exc.value
+    assert isinstance(err, OSError)
+    assert err.errno == errno.ENOSPC
+    assert err.fault_class == PERMANENT
+    assert err.disk_mode == "enospc"
+
+    inj = FaultInjector([FaultRule(seam="disk.fsync", kind="disk",
+                                   disk_mode="eio")])
+    with pytest.raises(DiskFault) as exc:
+        inj.disk_action("disk.fsync", "k")
+    assert exc.value.errno == errno.EIO
+    assert exc.value.fault_class == TRANSIENT
+
+
+def test_disk_action_short_torn_and_latency():
+    """short/torn return their mode for the shim to enact; latency
+    sleeps only where the caller attests it is off the event loop."""
+    inj = FaultInjector([FaultRule(seam="disk.write", kind="disk",
+                                   disk_mode="short")])
+    assert inj.disk_action("disk.write", "k") == "short"
+
+    inj = FaultInjector([FaultRule(seam="disk.promote", kind="disk",
+                                   disk_mode="torn")])
+    assert inj.disk_action("disk.promote", "k") == "torn"
+
+    inj = FaultInjector([FaultRule(seam="disk.write", kind="disk",
+                                   disk_mode="latency", latency_ms=1.0)])
+    # on-loop (thread_ok=False): no sleep, the write proceeds
+    mark = time.monotonic()
+    assert inj.disk_action("disk.write", "k", thread_ok=False) is None
+    assert time.monotonic() - mark < 0.5
+    assert inj.disk_action("disk.write", "k", thread_ok=True) is None
+    assert inj.rules[0].fired == 2
+
+
+def test_windowed_exempt_ratchet_is_empty():
+    """ISSUE 20 acceptance: ``disk`` was the last WINDOWED_EXEMPT
+    holdout — the table must be (and stay) empty, so every injectable
+    fault family accepts windowed drills."""
+    from downloader_tpu.analysis.drift import WINDOWED_EXEMPT
+
+    assert WINDOWED_EXEMPT == {}
+
+
+# ---------------------------------------------------------------------------
+# The VFS shim: short-write resume, raising modes, promote discipline
+# ---------------------------------------------------------------------------
+
+def test_vfs_short_writes_resume_at_the_right_offset(tmp_path):
+    """Two injected short writes must cost extra syscalls, never bytes:
+    write_all resumes each truncated write at the right offset."""
+    inj = _install(FaultRule(seam="disk.write", kind="disk",
+                             disk_mode="short", count=2))
+    try:
+        data = bytes(range(256)) * 64  # 16 KiB
+        path = tmp_path / "landed.bin"
+        fd = os.open(str(path), os.O_CREAT | os.O_WRONLY)
+        try:
+            vfs.write_all(fd, data, 0)
+        finally:
+            os.close(fd)
+        assert path.read_bytes() == data
+        assert inj.rules[0].fired == 2
+    finally:
+        faults.uninstall(inj)
+
+
+def test_vfs_fh_short_writes_resume(tmp_path):
+    inj = _install(FaultRule(seam="disk.write", kind="disk",
+                             disk_mode="short", count=3))
+    try:
+        data = b"q" * 8192
+        path = tmp_path / "spill.bin"
+        with open(str(path), "wb", buffering=0) as fh:
+            assert vfs.fh_write_all(fh, data) == len(data)
+        assert path.read_bytes() == data
+    finally:
+        faults.uninstall(inj)
+
+
+def test_vfs_enospc_raises_through_the_shim(tmp_path):
+    inj = _install(FaultRule(seam="disk.write", kind="disk",
+                             disk_mode="enospc"))
+    try:
+        fd = os.open(str(tmp_path / "x"), os.O_CREAT | os.O_WRONLY)
+        try:
+            with pytest.raises(DiskFault) as exc:
+                vfs.pwrite(fd, b"data", 0)
+            assert exc.value.errno == errno.ENOSPC
+        finally:
+            os.close(fd)
+    finally:
+        faults.uninstall(inj)
+
+
+def test_vfs_promote_is_atomic_and_faultable(tmp_path):
+    """Clean promote renames into place; an ENOSPC rule at the promote
+    seam raises BEFORE the rename, leaving src intact and dst absent
+    (the publish never points at bytes the fault ate)."""
+    src, dst = str(tmp_path / "a.partial"), str(tmp_path / "a.mkv")
+    open(src, "wb").write(b"payload")
+    vfs.promote(src, dst)
+    assert not os.path.exists(src)
+    assert open(dst, "rb").read() == b"payload"
+
+    open(src, "wb").write(b"second")
+    inj = _install(FaultRule(seam="disk.promote", kind="disk",
+                             disk_mode="enospc"))
+    try:
+        with pytest.raises(DiskFault):
+            vfs.promote(src, dst)
+        assert os.path.exists(src)
+        assert open(dst, "rb").read() == b"payload"  # old publish intact
+    finally:
+        faults.uninstall(inj)
+
+
+def test_vfs_torn_promote_zeroes_the_tail_then_crashes(tmp_path,
+                                                       monkeypatch):
+    """The ``torn`` drill: rename WITHOUT the fsync, zero the tail
+    pages, then die.  The crash point is monkeypatched so the test can
+    inspect the torn world the real SIGKILL leaves."""
+    crashed = []
+
+    def fake_crash(seam):
+        crashed.append(seam)
+        raise RuntimeError("simulated power cut")
+
+    monkeypatch.setattr(faults, "_crash_now", fake_crash)
+    src, dst = str(tmp_path / "b.partial"), str(tmp_path / "b.mkv")
+    payload = b"\xff" * (vfs.TORN_TAIL_BYTES * 2)
+    open(src, "wb").write(payload)
+    inj = _install(FaultRule(seam="disk.promote", kind="disk",
+                             disk_mode="torn", count=1))
+    try:
+        with pytest.raises(RuntimeError, match="power cut"):
+            vfs.promote(src, dst)
+    finally:
+        faults.uninstall(inj)
+    assert crashed == ["disk.promote"]
+    data = open(dst, "rb").read()
+    assert len(data) == len(payload)  # size still checks out...
+    assert data[-vfs.TORN_TAIL_BYTES:] == b"\0" * vfs.TORN_TAIL_BYTES
+    assert data[:-vfs.TORN_TAIL_BYTES] == payload[:-vfs.TORN_TAIL_BYTES]
+
+
+def test_vfs_fsync_seam_is_drillable(tmp_path):
+    path = str(tmp_path / "f.bin")
+    open(path, "wb").write(b"x")
+    inj = _install(FaultRule(seam="disk.fsync", kind="disk",
+                             disk_mode="eio"))
+    try:
+        with pytest.raises(DiskFault) as exc:
+            vfs.fsync_path(path)
+        assert exc.value.errno == errno.EIO
+    finally:
+        faults.uninstall(inj)
+
+
+# ---------------------------------------------------------------------------
+# Landing sidecars + boot-time torn-tail demotion (crash-consistent landing)
+# ---------------------------------------------------------------------------
+
+def test_sidecar_roundtrip(tmp_path):
+    d = str(tmp_path)
+    scrub.note_landed(d, "show.mkv", "a" * 32)
+    scrub.note_landed(d, "extra.srt", "b" * 32)
+    scrub.note_landed(d, "show.mkv", "a" * 32)  # idempotent
+    assert scrub.read_landed(d) == {"show.mkv": "a" * 32,
+                                    "extra.srt": "b" * 32}
+    scrub.drop_landed(d, "extra.srt")
+    assert scrub.read_landed(d) == {"show.mkv": "a" * 32}
+    scrub.drop_landed(d, "show.mkv")
+    assert scrub.read_landed(d) == {}
+    # empty note -> no sidecar file left behind
+    assert not os.path.exists(os.path.join(d, scrub.LANDED_SIDECAR))
+
+
+def test_read_landed_tolerates_torn_sidecar(tmp_path):
+    d = str(tmp_path)
+    open(os.path.join(d, scrub.LANDED_SIDECAR), "wb").write(b"{\"trunc")
+    assert scrub.read_landed(d) == {}
+
+
+def test_verify_landed_demotes_torn_outputs(tmp_path):
+    """Boot recovery: a sidecar-named output whose bytes no longer
+    match its landing digest is the torn-tail crash — deleted (demoted
+    to re-fetch); healthy outputs verify; missing files prune."""
+    d = str(tmp_path)
+    good, torn = b"G" * 4096, b"T" * 4096
+    open(os.path.join(d, "good.mkv"), "wb").write(good)
+    open(os.path.join(d, "torn.mkv"), "wb").write(torn)
+    scrub.note_landed(d, "good.mkv", _md5(good))
+    scrub.note_landed(d, "torn.mkv", _md5(b"what was promised"))
+    scrub.note_landed(d, "gone.mkv", _md5(b"already uploaded"))
+    verified, demoted = scrub.verify_landed(d)
+    assert (verified, demoted) == (1, 1)
+    assert os.path.exists(os.path.join(d, "good.mkv"))
+    assert not os.path.exists(os.path.join(d, "torn.mkv"))
+    # the demoted and missing notes are pruned; the healthy one stays
+    assert scrub.read_landed(d) == {"good.mkv": _md5(good)}
+
+
+# ---------------------------------------------------------------------------
+# The background scrubber: clean / repair / quarantine
+# ---------------------------------------------------------------------------
+
+class _StubSharedStore:
+    """A co-located shared tier reduced to the one call the cache
+    repair path makes (no ``local_object_path``: the shared-tier walk
+    stands down, exactly like a remote MiniS3)."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.fetches = []
+
+    async def fget_object(self, bucket, name, path):
+        self.fetches.append((bucket, name))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(self.payload)
+
+
+class _StubFleet:
+    shared_bucket = STAGING_BUCKET
+
+    def __init__(self, payload: bytes):
+        self.store = _StubSharedStore(payload)
+
+    def shared_name(self, key, rel=""):
+        return f".fleet-cache/{key}/files/{rel}"
+
+
+async def _seed_cache(tmp_path, payload: bytes, name="media.mkv"):
+    cache = ContentCache(str(tmp_path / "cache"))
+    key = cache_key("http", "http://x/media.mkv", '"scrub-1"')
+    src = tmp_path / "src"
+    src.mkdir(exist_ok=True)
+    (src / name).write_bytes(payload)
+    entry = await cache.insert(key, str(src), digests={name: _md5(payload)})
+    assert entry is not None
+    return cache, key
+
+
+async def test_scrub_clean_pass_counts_and_snapshot(tmp_path):
+    payload = b"C" * 8192
+    cache, _key = await _seed_cache(tmp_path, payload)
+    metrics = prom.new(f"disk{os.urandom(3).hex()}")
+    scrubber = scrub.Scrubber(cache=cache, interval=60, rate_bytes=1e12,
+                              workdir_root=str(tmp_path / "dl"),
+                              metrics=metrics)
+    counts = await scrubber.scan()
+    assert counts == {"clean": 1, "repaired": 0, "quarantined": 0}
+    snap = scrubber.snapshot()
+    assert snap["passes"] == 1 and snap["clean"] == 1
+    assert snap["lastPassAt"] is not None
+    assert metrics.scrub_objects.labels(
+        outcome="clean")._value.get() == 1
+
+
+async def test_scrub_repairs_with_fresh_inode_hardlink_regression(
+        tmp_path):
+    """ISSUE 20 acceptance (copy-on-repair): a corrupted cache entry
+    hardlinked into a peer view is repaired from the shared tier into
+    a FRESH inode — the hardlinked peer keeps its own (still
+    detectably corrupt) view instead of having bytes silently change
+    under a reader."""
+    payload = b"R" * 8192
+    cache, key = await _seed_cache(tmp_path, payload)
+    path = os.path.join(cache.entry_path(key), "media.mkv")
+    peer = str(tmp_path / "peer-view.mkv")
+    os.link(path, peer)  # the PR 19 hardlink tier's inode sharing
+    with open(path, "r+b") as fh:  # bit-rot hits the SHARED inode
+        fh.seek(100)
+        fh.write(b"\x00")
+    assert open(peer, "rb").read() != payload  # peer sees it too
+    old_ino = os.stat(path).st_ino
+
+    fleet = _StubFleet(payload)
+    scrubber = scrub.Scrubber(cache=cache, fleet=fleet, interval=60,
+                              rate_bytes=1e12,
+                              workdir_root=str(tmp_path / "dl"))
+    counts = await scrubber.scan()
+    assert counts["repaired"] == 1 and counts["quarantined"] == 0
+    assert fleet.store.fetches == [
+        (STAGING_BUCKET, f".fleet-cache/{key}/files/media.mkv")]
+    # the cache copy is healthy again — on a NEW inode
+    assert open(path, "rb").read() == payload
+    assert os.stat(path).st_ino != old_ino
+    assert os.stat(path).st_nlink == 1
+    # the peer's hardlinked view still holds the corrupt inode: its
+    # own digest check (fetch_entry / verify_landed) can still catch it
+    assert os.stat(peer).st_ino == old_ino
+    assert open(peer, "rb").read() != payload
+
+
+async def test_scrub_quarantines_without_a_healthy_replica(tmp_path):
+    """No fleet (or no replica): the corrupt file is quarantined and
+    the whole entry leaves the cache — a later job re-fetches from
+    origin, which IS the repair-from-origin path."""
+    payload = b"Q" * 8192
+    cache, key = await _seed_cache(tmp_path, payload)
+    path = os.path.join(cache.entry_path(key), "media.mkv")
+    with open(path, "r+b") as fh:
+        fh.write(b"rot")
+    qdir = str(tmp_path / "quarantine")
+    metrics = prom.new(f"disk{os.urandom(3).hex()}")
+    scrubber = scrub.Scrubber(cache=cache, interval=60, rate_bytes=1e12,
+                              quarantine_dir=qdir, metrics=metrics)
+    counts = await scrubber.scan()
+    assert counts["quarantined"] == 1 and counts["repaired"] == 0
+    assert await cache.lookup(key) is None
+    moved = os.listdir(qdir)
+    assert any(name.startswith(key) for name in moved)
+    assert metrics.scrub_objects.labels(
+        outcome="quarantined")._value.get() == 1
+
+    # a second pass over the now-empty world is clean and cheap
+    counts = await scrubber.scan()
+    assert counts == {"clean": 0, "repaired": 0, "quarantined": 0}
+    assert scrubber.state["passes"] == 2
+
+
+async def test_scrub_shared_repair_refuses_the_same_inode(tmp_path):
+    """_repair_shared must refuse a cache copy hardlinked to the
+    corrupt shared object (the corruption IS that inode) and repair by
+    copy — fresh inode — when the cache copy is healthy."""
+    payload = b"S" * 4096
+    cache, key = await _seed_cache(tmp_path, payload)
+    cache_path = os.path.join(cache.entry_path(key), "media.mkv")
+    scrubber = scrub.Scrubber(cache=cache, interval=60, rate_bytes=1e12,
+                              workdir_root=str(tmp_path / "dl"))
+
+    linked = str(tmp_path / "shared-linked.bin")
+    os.link(cache_path, linked)
+    assert not await scrubber._repair_shared(
+        key, "media.mkv", _md5(payload), linked)
+
+    shared = str(tmp_path / "shared-copy.bin")
+    open(shared, "wb").write(b"rotted bytes")
+    assert await scrubber._repair_shared(
+        key, "media.mkv", _md5(payload), shared)
+    assert open(shared, "rb").read() == payload
+    assert os.stat(shared).st_ino != os.stat(cache_path).st_ino
+
+
+async def test_scrub_workdir_outputs_quarantined_and_note_dropped(
+        tmp_path):
+    """Staged-but-not-yet-uploaded outputs (long BULK queues) are
+    re-verified via their landing sidecars; a mismatch has no healthy
+    replica by definition — quarantine + drop the note, the job's
+    redelivery re-fetches."""
+    root = str(tmp_path / "downloads")
+    workdir = os.path.join(root, "job-77")
+    os.makedirs(workdir)
+    good, rotted = b"g" * 2048, b"r" * 2048
+    open(os.path.join(workdir, "ok.mkv"), "wb").write(good)
+    open(os.path.join(workdir, "rot.mkv"), "wb").write(rotted)
+    scrub.note_landed(workdir, "ok.mkv", _md5(good))
+    scrub.note_landed(workdir, "rot.mkv", _md5(b"landed bytes"))
+    scrubber = scrub.Scrubber(workdir_root=root, interval=60,
+                              rate_bytes=1e12)
+    counts = await scrubber.scan()
+    assert counts == {"clean": 1, "repaired": 0, "quarantined": 1}
+    assert not os.path.exists(os.path.join(workdir, "rot.mkv"))
+    assert scrub.read_landed(workdir) == {"ok.mkv": _md5(good)}
+    qdir = os.path.join(root, ".quarantine")  # the default location
+    assert any(n.startswith("workdir-job-77")
+               for n in os.listdir(qdir))
+    # service dirs (the quarantine itself) are skipped on later passes
+    counts = await scrubber.scan()
+    assert counts["quarantined"] == 0
+
+
+def test_scrub_config_gates(tmp_path):
+    with pytest.raises(ValueError):
+        scrub.Scrubber(interval=0)
+    assert scrub.Scrubber.from_config(
+        ConfigNode({"scrub": {"enabled": False}})) is None
+    s = scrub.Scrubber.from_config(
+        ConfigNode({"scrub": {"interval": 7, "rate_mb_s": 2}}),
+        workdir_root=str(tmp_path))
+    assert s is not None and s.interval == 7 and s.rate_bytes == 2e6
+    assert s.quarantine_dir == os.path.join(str(tmp_path), ".quarantine")
+
+
+# ---------------------------------------------------------------------------
+# Satellite: hardlink-tier corruption must not propagate (fleet/plane.py)
+# ---------------------------------------------------------------------------
+
+async def test_fetch_entry_rejects_corrupt_leader_copy(tmp_path):
+    """A corrupt shared-tier copy must fall back to origin — fetch
+    returns False and the bytes never become servable (and never get
+    hardlinked into a workdir)."""
+    payload = b"L" * (64 << 10)
+    store = InMemoryObjectStore()
+    await store.make_bucket(STAGING_BUCKET)
+    key = cache_key("http", "http://x/media.mkv", '"hot-1"')
+    cache_a = ContentCache(str(tmp_path / "cache-a"))
+    cache_b = ContentCache(str(tmp_path / "cache-b"))
+    plane_a = FleetPlane(MemoryCoordStore(), "wa", store=store)
+    plane_b = FleetPlane(MemoryCoordStore(), "wb", store=store)
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "media.mkv").write_bytes(payload)
+    await cache_a.insert(key, str(src),
+                         digests={"media.mkv": _md5(payload)})
+    assert await plane_a.publish_entry(key, cache_a)
+
+    # bit-rot on the leader's published object (same length: a
+    # size-only check would happily serve it)
+    name = plane_a.shared_name(key, "media.mkv")
+    rotted = b"X" + payload[1:]
+    await store.put_object(STAGING_BUCKET, name, rotted)
+
+    assert not await plane_b.fetch_entry(key, cache_b)
+    assert plane_b.stats["sharedCorrupt"] == 1
+    assert await cache_b.lookup(key) is None
+
+    # heal the object: the same peer materializes fine afterwards
+    await store.put_object(STAGING_BUCKET, name, payload)
+    assert await plane_b.fetch_entry(key, cache_b)
+    entry = await cache_b.lookup(key)
+    assert entry is not None and entry.size == len(payload)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: ENOSPC mid-multipart fails fast and aborts the MPU
+# ---------------------------------------------------------------------------
+
+async def test_multipart_enospc_aborts_and_classifies_permanent(tmp_path):
+    """Local disk full mid-part: PERMANENT fail-fast (no retry burns a
+    full re-read of an already-full volume) and the except-path abort
+    leaves zero dangling parts billing storage on the server."""
+    server = MiniS3()
+    await server.start()
+    client = S3ObjectStore(f"http://127.0.0.1:{server.port}",
+                           "AKIA", "SECRET")
+    try:
+        client.multipart_threshold = 1 << 16
+        client.multipart_part_size = 1 << 16
+        client.zero_copy = False  # pin the parts to the _request path
+        payload = b"e" * (3 * (1 << 16))
+        srcfile = tmp_path / "big.mkv"
+        srcfile.write_bytes(payload)
+        await client.make_bucket("staging")
+
+        part_attempts = {}
+        orig_request = client._request
+
+        async def flaky_request(method, path, query=None, **kwargs):
+            if query and "partNumber" in query:
+                n = int(query["partNumber"])
+                part_attempts[n] = part_attempts.get(n, 0) + 1
+                if n == 2:
+                    raise OSError(errno.ENOSPC,
+                                  "No space left on device")
+            return await orig_request(method, path, query=query,
+                                      **kwargs)
+
+        client._request = flaky_request
+        with pytest.raises(OSError) as exc:
+            await client.fput_object("staging", "big.mkv", str(srcfile))
+        assert exc.value.errno == errno.ENOSPC
+        assert getattr(exc.value, "fault_class", None) == PERMANENT
+        # fail-fast: the ENOSPC part was attempted exactly once
+        assert part_attempts.get(2) == 1
+        # part census: aborted server-side, nothing dangling/visible
+        assert not server.multipart_uploads
+        assert "big.mkv" not in server.buckets.get("staging", {})
+    finally:
+        await client.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: io_uring degraded completions take the pwrite fallback
+# ---------------------------------------------------------------------------
+
+def _fake_writer(results):
+    """A UringWriter whose ring is scripted: each _submit_write call
+    pops the next (behavior) entry — an int error/zero result, or
+    "land" to actually write ``n`` bytes like a short-accepting
+    kernel."""
+    w = uring.UringWriter.__new__(uring.UringWriter)
+    script = list(results)
+
+    def submit(fd, addr, length, offset):
+        step = script.pop(0)
+        if isinstance(step, int):
+            return step
+        kind, n = step
+        assert kind == "land"
+        n = min(n, length)
+        os.pwrite(fd, ctypes.string_at(addr, n), offset)
+        return n
+
+    w._submit_write = submit
+    return w
+
+
+def test_uring_error_cqe_lands_via_pwrite_fallback(tmp_path):
+    """An error CQE (-EIO: the kernel soured on this fd) does not
+    re-drive the ring — the whole buffer lands through the plain
+    pwrite loop at the right offset."""
+    data = bytes(range(256)) * 40
+    path = str(tmp_path / "u.bin")
+    fd = os.open(path, os.O_CREAT | os.O_RDWR)
+    try:
+        os.pwrite(fd, b"\xaa" * 64, 0)  # pre-existing leading bytes
+        w = _fake_writer([-errno.EIO])
+        assert w.pwrite(fd, data, 64) == len(data)
+    finally:
+        os.close(fd)
+    blob = open(path, "rb").read()
+    assert blob[:64] == b"\xaa" * 64
+    assert blob[64:] == data
+
+
+def test_uring_short_cqe_resumes_at_the_right_offset(tmp_path):
+    """A short completion's accepted bytes are kept; the remainder
+    lands through the fallback at the resumed offset — exactly once,
+    byte-exact."""
+    data = bytes(range(256)) * 64  # 16 KiB
+    path = str(tmp_path / "s.bin")
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY)
+    try:
+        w = _fake_writer([("land", 5000)])
+        assert w.pwrite(fd, data, 0) == len(data)
+    finally:
+        os.close(fd)
+    assert open(path, "rb").read() == data
+
+
+def test_uring_full_cqes_never_touch_the_fallback(tmp_path):
+    data = b"k" * 3000
+    path = str(tmp_path / "f.bin")
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY)
+    try:
+        w = _fake_writer([("land", 2000), ("land", 1000)])
+        assert w.pwrite(fd, data, 0) == len(data)
+    finally:
+        os.close(fd)
+    assert open(path, "rb").read() == data
+
+
+def test_uring_fallback_zero_byte_write_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(vfs, "pwrite",
+                        lambda fd, data, offset, **kw: 0)
+    fd = os.open(str(tmp_path / "z.bin"), os.O_CREAT | os.O_WRONLY)
+    try:
+        w = _fake_writer([-errno.EIO])
+        with pytest.raises(OSError) as exc:
+            w.pwrite(fd, b"data", 0)
+        assert exc.value.errno == errno.EIO
+    finally:
+        os.close(fd)
+
+
+def test_uring_fallback_routes_through_the_disk_drills(tmp_path):
+    """The fallback goes through the VFS shim, so a windowed disk
+    drill reaches writes that began life on the ring."""
+    inj = _install(FaultRule(seam="disk.write", kind="disk",
+                             disk_mode="enospc"))
+    try:
+        fd = os.open(str(tmp_path / "d.bin"), os.O_CREAT | os.O_WRONLY)
+        try:
+            w = _fake_writer([-errno.EIO])
+            with pytest.raises(DiskFault) as exc:
+                w.pwrite(fd, b"data", 0)
+            assert exc.value.errno == errno.ENOSPC
+        finally:
+            os.close(fd)
+    finally:
+        faults.uninstall(inj)
+
+
+# ---------------------------------------------------------------------------
+# Disk-full graceful degradation: the workdir admission floor
+# ---------------------------------------------------------------------------
+
+async def _bare_orchestrator(tmp_path, config):
+    broker = InMemoryBroker()
+    telem_mq = MemoryQueue(broker)
+    await telem_mq.connect()
+    return Orchestrator(
+        config=config, mq=MemoryQueue(broker),
+        store=InMemoryObjectStore(), telemetry=Telemetry(telem_mq),
+        metrics=prom.new(f"disk{os.urandom(4).hex()}"),
+        logger=NullLogger(), admission_timeout=0.3)
+
+
+async def test_workdir_floor_force_opens_the_disk_breaker(tmp_path):
+    """A deadline-forced admission that still fails the WORKDIR floor
+    force-opens the store breaker with the ``disk`` reason (eviction
+    cannot reclaim workdir space) — follow-on deliveries park instead
+    of marching into ENOSPC."""
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")},
+        "download": {"min_free_bytes": 1 << 20, "reserve_bytes": 4096},
+    })
+    orchestrator = await _bare_orchestrator(tmp_path, config)
+    assert orchestrator.workdir_min_free == 1 << 20
+    assert orchestrator.workdir_reserve == 4096
+    orchestrator._workdir_free_bytes = lambda: 0  # the full volume
+    mark = time.monotonic()
+    await orchestrator._admit_job(NullLogger())
+    assert time.monotonic() - mark >= 0.25  # it HELD for the timeout
+    breaker = orchestrator.breakers.get("store")
+    assert breaker is not None and breaker.open_reason == OPEN_DISK
+
+
+async def test_workdir_floor_admits_with_headroom(tmp_path):
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")},
+        "download": {"min_free_bytes": 1 << 20},
+    })
+    orchestrator = await _bare_orchestrator(tmp_path, config)
+    orchestrator._workdir_free_bytes = lambda: 10 << 20
+    mark = time.monotonic()
+    await orchestrator._admit_job(NullLogger())
+    assert time.monotonic() - mark < 0.25  # no hold, no breaker
+    breaker = orchestrator.breakers.get("store")
+    assert breaker is None or breaker.open_reason is None
+
+
+async def test_workdir_floor_defaults_off(tmp_path):
+    """Both knobs default 0 = the exact prior behavior: no gate."""
+    config = ConfigNode({
+        "instance": {"download_path": str(tmp_path / "downloads")}})
+    orchestrator = await _bare_orchestrator(tmp_path, config)
+    orchestrator._workdir_free_bytes = lambda: 0
+    mark = time.monotonic()
+    await orchestrator._admit_job(NullLogger())
+    assert time.monotonic() - mark < 0.25

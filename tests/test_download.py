@@ -17,6 +17,7 @@ from downloader_tpu.platform.telemetry import PROGRESS_QUEUE, Telemetry
 from downloader_tpu.stages.base import Job, StageContext
 from downloader_tpu.stages.download import parse_bucket_uri, stage_factory
 from downloader_tpu.store import InMemoryObjectStore
+from downloader_tpu.store import scrub
 from downloader_tpu.utils import EventEmitter
 
 pytestmark = pytest.mark.anyio
@@ -696,8 +697,10 @@ async def test_http_segmented_download(tmp_path, broker, range_server,
         for lo in range(0, len(payload), span)
     }
     assert set(requests[1:]) == expected
-    # no stray working files
-    assert sorted(p.name for p in target.parent.iterdir()) == ["file.mkv"]
+    # no stray working files besides the durable landing sidecar
+    assert sorted(p.name for p in target.parent.iterdir()) == [
+        scrub.LANDED_SIDECAR, "file.mkv"]
+    assert "file.mkv" in scrub.read_landed(target.parent)
 
 
 async def test_http_segmented_splice_engaged_and_byte_identical(
@@ -919,7 +922,8 @@ async def test_http_segmented_entity_change_midflight(
         await runner.cleanup()
     target = tmp_path / "downloads" / "job-1" / "file.mkv"
     assert target.read_bytes() == new
-    assert sorted(p.name for p in target.parent.iterdir()) == ["file.mkv"]
+    assert sorted(p.name for p in target.parent.iterdir()) == [
+        scrub.LANDED_SIDECAR, "file.mkv"]
 
 
 async def test_http_segments_config_validation(tmp_path, broker,
